@@ -1,0 +1,27 @@
+"""TPU-native distributed-training framework.
+
+A brand-new JAX/XLA/pjit/Pallas framework providing the capabilities of the
+reference example suite ``Xingskcs/Distributed-TensorFlow-Examples`` (five
+distributed-training workloads: MNIST MLP sync data-parallel, CIFAR-10 CNN
+async parameter-server, ResNet-50 ImageNet, word2vec with a PS-sharded
+embedding table, PTB LSTM multi-worker) — re-designed TPU-first:
+
+- PS/worker gRPC topology       -> single-controller SPMD over a named ``Mesh``
+- ``replica_device_setter``     -> ``NamedSharding`` placement rules
+- ``SyncReplicasOptimizer``     -> ``psum`` over ICI inside the compiled step
+- ``MirroredStrategy``/NCCL     -> XLA collectives emitted by ``jit``
+- ``MonitoredTrainingSession``  -> ``train.TrainSession`` + hook system
+- ``tf.data`` input pipelines   -> per-host sharded pipelines + device infeed
+
+Reference capability map: see ``SURVEY.md`` (repo root) sections 1-3; the
+blueprint for this layout is ``SURVEY.md`` section 7.
+"""
+
+__version__ = "0.1.0"
+
+from . import parallel  # noqa: F401
+from . import data  # noqa: F401
+from . import models  # noqa: F401
+from . import ops  # noqa: F401
+from . import train  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,17 @@
+"""Input layer: the ``tf.data`` replacement (SURVEY.md section 1, L0).
+
+Per-host sharding + batching + shuffling + device infeed with background
+prefetch.  The reference's pipeline machinery (``Dataset.shard/batch/prefetch``,
+``DistributedDataset`` per-replica iterators — SURVEY.md T7/D14) maps to:
+
+- ``datasets``  — workload datasets (real files if present in ``--data_dir``,
+  deterministic synthetic fallback otherwise, since this environment has no
+  network egress).
+- ``pipeline``  — ``InMemoryPipeline``/``prefetch_to_mesh``: every host loads
+  only its shard, batches are assembled into *global* sharded ``jax.Array``s
+  via ``make_array_from_process_local_data``, with a depth-2 background
+  prefetcher overlapping host->HBM transfer with the running step.
+"""
+
+from .pipeline import InMemoryPipeline, prefetch_to_mesh  # noqa: F401
+from . import datasets  # noqa: F401

@@ -1,0 +1,245 @@
+"""Workload datasets for the five reference configs (SURVEY.md section 2a).
+
+Each loader looks for the standard on-disk format under ``data_dir`` and
+falls back to a *deterministic synthetic* dataset with the same shapes/dtypes
+and a learnable signal (so loss curves fall and accuracy targets are
+meaningful in tests/benchmarks even with zero network egress).  The synthetic
+fallback is clearly reported via the returned ``source`` field.
+
+Formats accepted when real data is present:
+- MNIST:   ``mnist.npz`` (keras layout: x_train/y_train/x_test/y_test)
+- CIFAR10: ``cifar10.npz`` (same layout) or the python pickle batches dir
+- PTB:     ``ptb.train.txt`` / ``ptb.valid.txt`` (word-level, <eos> per line)
+- word2vec corpus: ``text8`` or any whitespace-tokenised text file
+- ImageNet: not expected on disk; synthetic 224x224 stream at ResNet-50
+  shapes (standard practice for infeed/throughput benchmarking).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+from typing import Iterator
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ArrayDataset:
+    train: dict[str, np.ndarray]
+    test: dict[str, np.ndarray]
+    source: str  # "file:<path>" or "synthetic"
+    num_classes: int = 0
+    vocab: dict | None = None
+
+
+def _synth_image_splits(rng: np.random.Generator, n_train, n_test, h, w, c, num_classes):
+    """Class-conditional Gaussian blobs: linearly separable enough that a
+    correct model's accuracy rises quickly, while staying image-shaped.
+    Train and test share the class prototypes (same distribution), so test
+    accuracy is a meaningful generalisation signal."""
+    protos = rng.normal(0.0, 1.0, size=(num_classes, h, w, c)).astype(np.float32)
+
+    def draw(n):
+        y = rng.integers(0, num_classes, size=n).astype(np.int32)
+        x = 0.5 * protos[y] + rng.normal(0.0, 1.0, size=(n, h, w, c)).astype(np.float32)
+        return x, y
+
+    return draw(n_train), draw(n_test)
+
+
+def mnist(data_dir: str | None = None, *, seed: int = 0) -> ArrayDataset:
+    path = os.path.join(data_dir or "", "mnist.npz")
+    if data_dir and os.path.exists(path):
+        with np.load(path) as d:
+            xt = (d["x_train"].astype(np.float32) / 255.0).reshape(-1, 28, 28, 1)
+            xe = (d["x_test"].astype(np.float32) / 255.0).reshape(-1, 28, 28, 1)
+            return ArrayDataset(
+                {"image": xt, "label": d["y_train"].astype(np.int32)},
+                {"image": xe, "label": d["y_test"].astype(np.int32)},
+                f"file:{path}",
+                num_classes=10,
+            )
+    rng = np.random.default_rng(seed)
+    (xt, yt), (xe, ye) = _synth_image_splits(rng, 8192, 1024, 28, 28, 1, 10)
+    return ArrayDataset(
+        {"image": xt, "label": yt}, {"image": xe, "label": ye}, "synthetic", 10
+    )
+
+
+def cifar10(data_dir: str | None = None, *, seed: int = 0) -> ArrayDataset:
+    if data_dir:
+        npz = os.path.join(data_dir, "cifar10.npz")
+        if os.path.exists(npz):
+            with np.load(npz) as d:
+                return ArrayDataset(
+                    {
+                        "image": d["x_train"].astype(np.float32) / 255.0,
+                        "label": d["y_train"].reshape(-1).astype(np.int32),
+                    },
+                    {
+                        "image": d["x_test"].astype(np.float32) / 255.0,
+                        "label": d["y_test"].reshape(-1).astype(np.int32),
+                    },
+                    f"file:{npz}",
+                    10,
+                )
+        batches = os.path.join(data_dir, "cifar-10-batches-py")
+        if os.path.isdir(batches):
+            xs, ys = [], []
+            for i in range(1, 6):
+                with open(os.path.join(batches, f"data_batch_{i}"), "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                xs.append(d[b"data"]), ys.append(d[b"labels"])
+            x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+            with open(os.path.join(batches, "test_batch"), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xe = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+            return ArrayDataset(
+                {
+                    "image": x.astype(np.float32) / 255.0,
+                    "label": np.concatenate(ys).astype(np.int32),
+                },
+                {
+                    "image": xe.astype(np.float32) / 255.0,
+                    "label": np.asarray(d[b"labels"], np.int32),
+                },
+                f"file:{batches}",
+                10,
+            )
+    rng = np.random.default_rng(seed)
+    (xt, yt), (xe, ye) = _synth_image_splits(rng, 8192, 1024, 32, 32, 3, 10)
+    return ArrayDataset(
+        {"image": xt, "label": yt}, {"image": xe, "label": ye}, "synthetic", 10
+    )
+
+
+def imagenet_synthetic(
+    *, image_size: int = 224, n_train: int = 2048, n_test: int = 256, seed: int = 0
+) -> ArrayDataset:
+    """Synthetic ImageNet-shaped stream (W3 ResNet-50 throughput workload)."""
+    rng = np.random.default_rng(seed)
+    (xt, yt), (xe, ye) = _synth_image_splits(
+        rng, n_train, n_test, image_size, image_size, 3, 1000
+    )
+    return ArrayDataset(
+        {"image": xt, "label": yt}, {"image": xe, "label": ye}, "synthetic", 1000
+    )
+
+
+# ----------------------------------------------------------------------------
+# Text corpora (W4 word2vec, W5 PTB LSTM)
+# ----------------------------------------------------------------------------
+
+
+def _tokenize_corpus(words: list[str], vocab_size: int):
+    from collections import Counter
+
+    counts = Counter(words)
+    keep = [w for w, _ in counts.most_common(vocab_size - 1)]
+    vocab = {w: i + 1 for i, w in enumerate(keep)}  # 0 = <unk>
+    ids = np.asarray([vocab.get(w, 0) for w in words], dtype=np.int32)
+    return ids, {"<unk>": 0, **vocab}
+
+
+def _synthetic_token_stream(n: int, vocab_size: int, seed: int) -> np.ndarray:
+    """Zipf-distributed token stream with bigram structure (so both skip-gram
+    co-occurrence and LSTM next-token prediction have learnable signal)."""
+    rng = np.random.default_rng(seed)
+    # Markov chain: each token prefers a fixed successor half the time.
+    succ = rng.permutation(vocab_size)
+    zipf = rng.zipf(1.3, size=n).astype(np.int64) % vocab_size
+    out = np.empty(n, dtype=np.int32)
+    out[0] = zipf[0]
+    follow = rng.random(n) < 0.5
+    for i in range(1, n):
+        out[i] = succ[out[i - 1]] if follow[i] else zipf[i]
+    return out
+
+
+def text_corpus(
+    data_dir: str | None = None,
+    *,
+    filename_candidates=("text8", "corpus.txt"),
+    vocab_size: int = 10000,
+    synth_tokens: int = 200_000,
+    seed: int = 0,
+):
+    """Token-id stream + vocab for word2vec (W4)."""
+    if data_dir:
+        for name in filename_candidates:
+            path = os.path.join(data_dir, name)
+            if os.path.exists(path):
+                with open(path, "r", encoding="utf-8", errors="replace") as f:
+                    words = f.read().split()
+                ids, vocab = _tokenize_corpus(words, vocab_size)
+                return ids, vocab, f"file:{path}"
+    ids = _synthetic_token_stream(synth_tokens, vocab_size, seed)
+    vocab = {f"tok{i}": i for i in range(vocab_size)}
+    return ids, vocab, "synthetic"
+
+
+def ptb(data_dir: str | None = None, *, vocab_size: int = 10000, seed: int = 0):
+    """PTB word-level LM streams (W5): (train_ids, valid_ids, vocab, source)."""
+    if data_dir:
+        tr = os.path.join(data_dir, "ptb.train.txt")
+        va = os.path.join(data_dir, "ptb.valid.txt")
+        if os.path.exists(tr):
+            with open(tr) as f:
+                train_words = f.read().replace("\n", " <eos> ").split()
+            valid_words = []
+            if os.path.exists(va):
+                with open(va) as f:
+                    valid_words = f.read().replace("\n", " <eos> ").split()
+            ids, vocab = _tokenize_corpus(train_words, vocab_size)
+            vids = np.asarray([vocab.get(w, 0) for w in valid_words], np.int32)
+            return ids, vids, vocab, f"file:{tr}"
+    ids = _synthetic_token_stream(120_000, vocab_size, seed)
+    vids = _synthetic_token_stream(12_000, vocab_size, seed + 1)
+    return ids, vids, {f"tok{i}": i for i in range(vocab_size)}, "synthetic"
+
+
+def lm_batches(
+    ids: np.ndarray, *, batch_size: int, seq_len: int, seed: int = 0
+) -> Iterator[dict[str, np.ndarray]]:
+    """Truncated-BPTT batching: contiguous streams per batch row (the PTB
+    convention), yielding {"x": [B,T], "y": [B,T]} forever."""
+    n = len(ids)
+    rows = batch_size
+    per_row = n // rows
+    if per_row < seq_len + 1:
+        raise ValueError(
+            f"token stream too short: {n} ids over {rows} rows gives "
+            f"{per_row} tokens/row, need seq_len+1={seq_len + 1}"
+        )
+    data = ids[: rows * per_row].reshape(rows, per_row)
+    pos = 0
+    while True:
+        if pos + seq_len + 1 > per_row:
+            pos = 0
+        x = data[:, pos : pos + seq_len]
+        y = data[:, pos + 1 : pos + seq_len + 1]
+        pos += seq_len
+        yield {"x": x.astype(np.int32), "y": y.astype(np.int32)}
+
+
+def skipgram_batches(
+    ids: np.ndarray,
+    *,
+    batch_size: int,
+    window: int = 5,
+    seed: int = 0,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Skip-gram (center, context) pair stream for word2vec (W4)."""
+    rng = np.random.default_rng(seed)
+    n = len(ids)
+    while True:
+        centers = rng.integers(window, n - window, size=batch_size)
+        offsets = rng.integers(1, window + 1, size=batch_size)
+        signs = rng.choice([-1, 1], size=batch_size)
+        contexts = centers + offsets * signs
+        yield {
+            "center": ids[centers].astype(np.int32),
+            "context": ids[contexts].astype(np.int32),
+        }

@@ -1,0 +1,18 @@
+"""Model zoo: the five reference workload models (SURVEY.md section 2a)
+plus framework growth models.
+
+All models are *pure functional*: ``init(cfg, rng) -> params`` and
+``apply(cfg, params, ...) -> outputs`` over plain dict pytrees — no module
+objects, no tracing magic.  This keeps every parameter addressable by path for
+sharding rules (``parallel.sharding``) and makes the whole train step a single
+traced function XLA can fuse end-to-end.
+
+- ``mlp``      — W1 MNIST MLP (ref: sync PS/worker, SyncReplicasOptimizer)
+- ``cnn``      — W2 CIFAR-10 CNN (ref: async parameter-server)
+- ``resnet``   — W3 ResNet-50 ImageNet (ref: MirroredStrategy/NCCL)
+- ``word2vec`` — W4 skip-gram with mesh-sharded embedding (ref: PS-sharded)
+- ``lstm``     — W5 PTB LSTM LM (ref: MultiWorkerMirroredStrategy)
+"""
+
+from . import layers  # noqa: F401
+from . import mlp  # noqa: F401

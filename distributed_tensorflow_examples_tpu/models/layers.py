@@ -1,0 +1,193 @@
+"""Functional layer library: init/apply pairs over plain dict pytrees.
+
+The building blocks the reference gets from TF ops/Keras (dense, conv2d,
+batch-norm, LSTM cell, embedding — SURVEY.md section 1 L4) rebuilt as pure
+functions.  Compute-dtype policy: params live in float32; ``apply`` functions
+accept a ``dtype`` to run activations/matmuls in bfloat16 on the MXU while
+accumulating in float32 (``preferred_element_type``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# ----------------------------------------------------------------------------
+# Initializers (TF analogs: glorot_uniform, he_normal, truncated_normal)
+# ----------------------------------------------------------------------------
+
+
+def glorot_uniform(rng, shape, in_axis=-2, out_axis=-1, dtype=jnp.float32):
+    fan_in, fan_out = shape[in_axis], shape[out_axis]
+    limit = jnp.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+
+def he_normal_conv(rng, shape, dtype=jnp.float32):
+    """He init for HWIO conv kernels (fan_in = h*w*cin)."""
+    fan_in = shape[0] * shape[1] * shape[2]
+    std = jnp.sqrt(2.0 / fan_in)
+    return std * jax.random.normal(rng, shape, dtype)
+
+
+def uniform_embedding(rng, shape, scale=None, dtype=jnp.float32):
+    """word2vec-style U[-1/dim, 1/dim] embedding init."""
+    scale = scale if scale is not None else 1.0 / shape[-1]
+    return jax.random.uniform(rng, shape, dtype, -scale, scale)
+
+
+# ----------------------------------------------------------------------------
+# Dense
+# ----------------------------------------------------------------------------
+
+
+def dense_init(rng, in_dim: int, out_dim: int, *, use_bias: bool = True):
+    kr, _ = jax.random.split(rng)
+    p = {"kernel": glorot_uniform(kr, (in_dim, out_dim))}
+    if use_bias:
+        p["bias"] = jnp.zeros((out_dim,), jnp.float32)
+    return p
+
+
+def dense(params, x, *, dtype=None):
+    k = params["kernel"]
+    if dtype is not None:
+        x, k = x.astype(dtype), k.astype(dtype)
+    y = jnp.matmul(x, k, preferred_element_type=jnp.float32)
+    if "bias" in params:
+        y = y + params["bias"]
+    return y
+
+
+# ----------------------------------------------------------------------------
+# Conv2D (NHWC x HWIO -> NHWC; the MXU-friendly layout)
+# ----------------------------------------------------------------------------
+
+
+def conv_init(rng, kh: int, kw: int, cin: int, cout: int, *, use_bias: bool = True):
+    p = {"kernel": he_normal_conv(rng, (kh, kw, cin, cout))}
+    if use_bias:
+        p["bias"] = jnp.zeros((cout,), jnp.float32)
+    return p
+
+
+def conv2d(params, x, *, stride=1, padding="SAME", dtype=None):
+    k = params["kernel"]
+    if dtype is not None:
+        x, k = x.astype(dtype), k.astype(dtype)
+    strides = (stride, stride) if isinstance(stride, int) else stride
+    y = lax.conv_general_dilated(
+        x,
+        k,
+        window_strides=strides,
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )
+    if "bias" in params:
+        y = y + params["bias"]
+    return y
+
+
+# ----------------------------------------------------------------------------
+# BatchNorm (params + mutable running stats threaded through model_state)
+# ----------------------------------------------------------------------------
+
+
+def batchnorm_init(c: int):
+    params = {"scale": jnp.ones((c,), jnp.float32), "bias": jnp.zeros((c,), jnp.float32)}
+    stats = {"mean": jnp.zeros((c,), jnp.float32), "var": jnp.ones((c,), jnp.float32)}
+    return params, stats
+
+
+def batchnorm(params, stats, x, *, train: bool, momentum=0.9, eps=1e-5):
+    """Returns (y, new_stats).  In train mode the batch statistics are
+    computed over the *global* batch: under jit with the batch sharded on the
+    data axis, the mean/var reductions become cross-replica (XLA inserts the
+    all-reduce) — matching SyncBatchNorm semantics, which is what mirrored
+    data-parallel training wants."""
+    if train:
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x.astype(jnp.float32), axis=axes)
+        var = jnp.var(x.astype(jnp.float32), axis=axes)
+        new_stats = {
+            "mean": momentum * stats["mean"] + (1 - momentum) * mean,
+            "var": momentum * stats["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = stats["mean"], stats["var"]
+        new_stats = stats
+    inv = lax.rsqrt(var + eps) * params["scale"]
+    y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype) + params["bias"].astype(x.dtype)
+    return y, new_stats
+
+
+# ----------------------------------------------------------------------------
+# Embedding
+# ----------------------------------------------------------------------------
+
+
+def embedding_init(rng, vocab: int, dim: int):
+    return {"table": uniform_embedding(rng, (vocab, dim))}
+
+
+def embedding_lookup(params, ids, *, dtype=None):
+    """Gather rows.  When the table is sharded over the ``model`` mesh axis
+    (rule: ``("embedding/table", P("model", None))``), XLA turns this into a
+    per-shard gather + collective — the in-compiler equivalent of the
+    reference's cross-network PS-shard gather (SURVEY.md section 3.5)."""
+    t = params["table"]
+    if dtype is not None:
+        t = t.astype(dtype)
+    return jnp.take(t, ids, axis=0)
+
+
+# ----------------------------------------------------------------------------
+# LSTM cell (the legacy_rnn BasicLSTMCell analog, scan-ready)
+# ----------------------------------------------------------------------------
+
+
+def lstm_cell_init(rng, in_dim: int, hidden: int):
+    kr, _ = jax.random.split(rng)
+    return {
+        "kernel": glorot_uniform(kr, (in_dim + hidden, 4 * hidden)),
+        "bias": jnp.zeros((4 * hidden,), jnp.float32),
+    }
+
+
+def lstm_cell(params, carry, x, *, forget_bias=1.0, dtype=None):
+    """One LSTM step: carry = (c, h).  Gate order i, g, f, o.  Designed to be
+    the body of ``lax.scan`` over time (compiler-friendly control flow — no
+    Python loops inside jit)."""
+    c, h = carry
+    k = params["kernel"]
+    if dtype is not None:
+        x, h, k = x.astype(dtype), h.astype(dtype), k.astype(dtype)
+    z = jnp.matmul(jnp.concatenate([x, h], axis=-1), k, preferred_element_type=jnp.float32)
+    z = z + params["bias"]
+    i, g, f, o = jnp.split(z, 4, axis=-1)
+    new_c = jax.nn.sigmoid(f + forget_bias) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    new_h = jax.nn.sigmoid(o) * jnp.tanh(new_c)
+    return (new_c, new_h), new_h
+
+
+# ----------------------------------------------------------------------------
+# Losses / metrics
+# ----------------------------------------------------------------------------
+
+
+def softmax_cross_entropy(logits, labels, num_classes=None):
+    """Mean cross-entropy over the batch (global mean under jit+sharding —
+    this mean is what makes data-parallel gradient averaging automatic)."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def accuracy(logits, labels):
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))

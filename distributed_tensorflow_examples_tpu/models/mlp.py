@@ -1,0 +1,69 @@
+"""W1: MNIST MLP — the reference's first workload (SURVEY.md section 2a W1).
+
+Reference shape: 2-layer MLP, sync SGD, 1 PS + 2 workers, between-graph
+replication over gRPC.  Here the same model trains sync data-parallel: batch
+sharded over the ``data`` mesh axis, parameters replicated, gradient
+all-reduce emitted by XLA — the SyncReplicasOptimizer accumulate/average/
+token-queue machinery (SURVEY.md section 3.1) collapses into one ``psum``
+inside the compiled step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from . import layers
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    input_dim: int = 784
+    hidden: tuple[int, ...] = (128, 128)
+    num_classes: int = 10
+    compute_dtype: str = "bfloat16"
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.compute_dtype)
+
+
+def init(cfg: Config, rng: jax.Array):
+    params = {}
+    dims = (cfg.input_dim, *cfg.hidden, cfg.num_classes)
+    rngs = jax.random.split(rng, len(dims) - 1)
+    for i, (din, dout) in enumerate(zip(dims[:-1], dims[1:])):
+        params[f"dense_{i}"] = layers.dense_init(rngs[i], din, dout)
+    return params
+
+
+def apply(cfg: Config, params, x):
+    """x: [B, 28, 28, 1] or [B, input_dim] -> logits [B, num_classes]."""
+    x = x.reshape(x.shape[0], -1)
+    n = len(cfg.hidden) + 1
+    for i in range(n):
+        x = layers.dense(params[f"dense_{i}"], x, dtype=cfg.dtype)
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def loss_fn(cfg: Config):
+    """Returns the framework-standard loss callable:
+    ``(params, model_state, batch, rng) -> (loss, (new_model_state, metrics))``.
+    """
+
+    def f(params, model_state, batch, rng):
+        logits = apply(cfg, params, batch["image"])
+        loss = layers.softmax_cross_entropy(logits, batch["label"])
+        acc = layers.accuracy(logits, batch["label"])
+        return loss, (model_state, {"loss": loss, "accuracy": acc})
+
+    return f
+
+
+#: Sharding rules: everything replicated (mirrored variables).  Kept explicit
+#: so examples read uniformly across workloads.
+SHARDING_RULES: tuple = ()

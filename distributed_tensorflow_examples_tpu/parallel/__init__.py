@@ -1,0 +1,31 @@
+"""Parallelism layer: device meshes, sharding rules, collectives, multi-host.
+
+This package is the TPU-native replacement for the reference's distribution
+stack (SURVEY.md section 1, layers L1/L2): ``tf.train.ClusterSpec`` /
+``tf.train.Server`` / ``replica_device_setter`` / ``tf.distribute`` strategies
+/ gRPC+NCCL collectives all collapse into (mesh, sharding rules, XLA
+collectives, jax.distributed bootstrap).
+"""
+
+from .mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_MODEL,
+    AXIS_PIPE,
+    AXIS_SEQ,
+    MeshSpec,
+    build_mesh,
+    local_mesh_for_testing,
+)
+from .sharding import (  # noqa: F401
+    ShardingRules,
+    named_sharding,
+    shard_pytree,
+    sharding_tree,
+    spec_for_path,
+)
+from .partitioner import (  # noqa: F401
+    fixed_size_partitioner,
+    min_max_variable_partitioner,
+)
+from . import collectives  # noqa: F401

@@ -1,0 +1,51 @@
+"""Variable partitioners: API-parity shims for TF's partitioned variables.
+
+The reference shards its word2vec embedding table across parameter servers
+with ``tf.fixed_size_partitioner`` (SURVEY.md sections 2b D4 and 3.5).  On a
+TPU mesh the same intent — "split this big table over N memory domains" — is a
+``PartitionSpec`` over the ``model`` axis, so these helpers return rule
+entries rather than device placements.  The forward-pass network hop of the
+reference (per-shard gather executed on the owning PS, results sent back over
+gRPC) becomes an XLA gather + collective over ICI, fused into the step.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec
+
+P = PartitionSpec
+
+
+def fixed_size_partitioner(axis_name: str = "model", dim: int = 0):
+    """Shard dimension ``dim`` over mesh axis ``axis_name``.
+
+    TF analog: ``tf.fixed_size_partitioner(num_shards, axis=dim)`` — except
+    shard count comes from the mesh, not a flag, so the same model code runs
+    on any topology.
+    Returns a ``PartitionSpec`` usable directly in a sharding rule table.
+    """
+    entries: list = [None] * dim + [axis_name]
+    return P(*entries)
+
+
+def min_max_variable_partitioner(
+    max_partitions: int | None = None,
+    min_slice_bytes: int = 256 << 10,
+    axis_name: str = "model",
+):
+    """TF-analog heuristic partitioner: returns a *function* of (shape, dtype)
+    deciding whether the leading dim is worth sharding.  Small variables stay
+    replicated (sharding a tiny bias would only add collective latency).
+    """
+
+    def decide(shape, dtype_bytes: int = 4) -> PartitionSpec:
+        if not shape:
+            return P()
+        nbytes = dtype_bytes
+        for s in shape:
+            nbytes *= s
+        if nbytes < min_slice_bytes:
+            return P()
+        return P(axis_name)
+
+    return decide

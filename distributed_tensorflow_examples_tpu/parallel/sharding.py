@@ -1,0 +1,105 @@
+"""Sharding rules: the TPU-native ``replica_device_setter``.
+
+The reference places every variable on a parameter-server task via a
+round-robin device function (``tf.train.replica_device_setter``; SURVEY.md
+section 2b, D3) and splits big variables across PS tasks with partitioners
+(D4).  Here placement is declarative: a rule table maps parameter *paths*
+(``"dense_1/kernel"``) to ``PartitionSpec``s, and arrays are laid out in mesh
+HBM with ``NamedSharding``.  The "PS role" disappears — a sharded parameter
+lives distributed across the chips that compute with it, and XLA inserts the
+gathers/reduce-scatters the gRPC rendezvous used to perform (SURVEY.md
+section 3.5).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+#: A rule table: ordered (path-regex, PartitionSpec) pairs.  First match wins;
+#: no match means fully replicated — the analog of an un-partitioned mirrored
+#: variable.
+ShardingRules = Sequence[tuple[str, PartitionSpec]]
+
+REPLICATED = P()
+
+
+def path_of(key_path: tuple) -> str:
+    """Render a jax tree key-path as ``"a/b/0"``."""
+    parts = []
+    for k in key_path:
+        if isinstance(k, jax.tree_util.DictKey):
+            parts.append(str(k.key))
+        elif isinstance(k, jax.tree_util.SequenceKey):
+            parts.append(str(k.idx))
+        elif isinstance(k, jax.tree_util.GetAttrKey):
+            parts.append(str(k.name))
+        elif isinstance(k, jax.tree_util.FlattenedIndexKey):
+            parts.append(str(k.key))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def spec_for_path(path: str, rules: ShardingRules) -> PartitionSpec:
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            return spec
+    return REPLICATED
+
+
+def _clamp_spec(spec: PartitionSpec, ndim: int, shape, mesh: Mesh) -> PartitionSpec:
+    """Drop trailing axes beyond ndim; drop shardings that don't divide the
+    dimension (falls back to replication on that dim, mirroring how TF
+    partitioners refuse to split a dim unevenly)."""
+    entries = list(spec)[:ndim]
+    out: list[Any] = []
+    for dim, entry in enumerate(entries):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        size = 1
+        for a in axes:
+            size *= mesh.shape[a]
+        out.append(entry if shape[dim] % size == 0 else None)
+    return P(*out)
+
+
+def named_sharding(mesh: Mesh, *spec_entries) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec_entries))
+
+
+def sharding_tree(tree: Any, mesh: Mesh, rules: ShardingRules) -> Any:
+    """Pytree of ``NamedSharding`` matching ``tree`` — usable as jit
+    in/out shardings, checkpoint restore layouts, or device_put targets."""
+
+    def _one(key_path, leaf):
+        spec = spec_for_path(path_of(key_path), rules)
+        shape = getattr(leaf, "shape", ())
+        spec = _clamp_spec(spec, len(shape), shape, mesh)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(_one, tree)
+
+
+def shard_pytree(tree: Any, mesh: Mesh, rules: ShardingRules = ()) -> Any:
+    """Lay a pytree out in mesh HBM per the rule table (device_put)."""
+    shardings = sharding_tree(tree, mesh, rules)
+    return jax.device_put(tree, shardings)
+
+
+def batch_sharding(mesh: Mesh, data_axes=("data",)) -> NamedSharding:
+    """Input-batch sharding: leading (batch) dim split over the data axes —
+    the analog of ``Dataset.shard``/``DistributedDataset`` per-replica splits
+    (SURVEY.md section 2b, D14)."""
+    present = tuple(a for a in data_axes if a in mesh.shape and mesh.shape[a] > 1)
+    if not present:
+        return NamedSharding(mesh, P())
+    entry = present[0] if len(present) == 1 else present
+    return NamedSharding(mesh, P(entry))

@@ -1,0 +1,18 @@
+"""Training-loop layer: the ``MonitoredTrainingSession`` stack rebuilt
+TPU-native (SURVEY.md section 1 L3, section 2c T1-T4).
+
+- ``state``      — ``TrainState`` pytree (step, params, opt_state,
+                   model_state, rng) + sharded initialisation.
+- ``step``       — ``build_train_step``: one fully-jitted SPMD training step
+                   (grad, all-reduce via sharding, optimizer update), with
+                   optional multi-step unrolling via ``lax.scan``.
+- ``loop``       — ``TrainSession``: hook dispatch, should_stop, auto-resume.
+- ``hooks``      — StopAtStep / StepCounter / Logging / CheckpointSaver /
+                   Summary hook equivalents.
+"""
+
+from .state import TrainState, create_state, create_sharded_state  # noqa: F401
+from .step import build_eval_step, build_train_step  # noqa: F401
+from .loop import TrainSession  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import hooks  # noqa: F401

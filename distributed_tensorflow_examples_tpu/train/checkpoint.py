@@ -1,0 +1,72 @@
+"""Sharding-aware checkpoint/auto-resume (SURVEY.md T3, section 5.4).
+
+Reference stack: ``tf.train.Saver`` sharded V2 checkpoints, where each PS task
+writes the variables it owns, ``CheckpointSaverHook`` triggers saves, and
+``MonitoredTrainingSession`` restores the newest checkpoint on start.  Here
+Orbax provides the same properties natively on a mesh: every host writes only
+its local shards (OCDBT), saves are asynchronous (training continues during
+the write — the reference's saver blocks the session), and restore re-shards
+to whatever mesh layout the restoring job uses (``restore_latest`` takes the
+target state/shardings as the template).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from .state import TrainState
+
+log = logging.getLogger("dtx.checkpoint")
+
+
+class CheckpointManager:
+    """Thin policy wrapper over ``ocp.CheckpointManager``.
+
+    - ``save(step, state)``: async, deduped, honors max_to_keep.
+    - ``restore_latest(template)``: returns restored state with the
+      *template's* shardings (elastic re-shard on restore), or None.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_to_keep: int = 5,
+        save_interval_steps: int = 1,
+        async_save: bool = True,
+    ):
+        opts = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(os.path.abspath(directory), options=opts)
+
+    def save(self, step: int, state: TrainState, *, force: bool = False) -> bool:
+        step = int(step)
+        if self._mgr.latest_step() == step:
+            return False  # already saved this step (periodic + final overlap)
+        return self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
+
+    def restore_latest(self, template: TrainState) -> TrainState | None:
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        log.info("restored checkpoint at step %d", step)
+        return restored
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,197 @@
+"""Hook system: the reference's session-run-hook stack (SURVEY.md T2,
+``basic_session_run_hooks.py``) rebuilt for an SPMD loop.
+
+Hooks observe the host-side loop (they never enter the compiled step):
+
+- ``StopAtStepHook``     (ref ``:393``) — stop at a global step.
+- ``StepCounterHook``    (ref ``:674``) — steps/sec and examples/sec/chip,
+                          the benchmark instrument.
+- ``LoggingHook``        (ref ``:169``) — periodic metric logging.
+- ``CheckpointHook``     (ref ``:524``) — periodic save via train.checkpoint.
+- ``SummaryHook``        (ref ``:793``) — metric series to the metrics writer.
+- ``ProfilerHook``       — jax.profiler trace for a step window (SURVEY.md
+                          section 5.1).
+
+Citations are to the TF files the reference relies on, per SURVEY.md; the
+reference tree itself is an empty mount (SURVEY.md section 0).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable
+
+import jax
+
+log = logging.getLogger("dtx.hooks")
+
+
+class Hook:
+    """Lifecycle: begin(loop) -> [before_step / after_step]* -> end(loop)."""
+
+    def begin(self, loop) -> None: ...
+
+    def before_step(self, loop) -> None: ...
+
+    def after_step(self, loop, metrics: dict[str, Any]) -> None: ...
+
+    def end(self, loop) -> None: ...
+
+
+class StopAtStepHook(Hook):
+    def __init__(self, last_step: int):
+        self.last_step = last_step
+
+    def after_step(self, loop, metrics):
+        if loop.step >= self.last_step:
+            loop.request_stop(f"reached step {self.last_step}")
+
+
+class StepCounterHook(Hook):
+    """steps/sec + examples/sec(/chip) counter — the instrument behind the
+    headline images/sec/chip metric (BASELINE.md)."""
+
+    def __init__(self, every_steps: int = 100, batch_size: int | None = None):
+        self.every = every_steps
+        self.batch_size = batch_size
+        self._t0 = None
+        self._s0 = 0
+        self.last_steps_per_sec: float | None = None
+        self.last_examples_per_sec_per_chip: float | None = None
+
+    def begin(self, loop):
+        self._t0 = time.perf_counter()
+        self._s0 = loop.step
+
+    def after_step(self, loop, metrics):
+        if loop.step - self._s0 < self.every:
+            return
+        now = time.perf_counter()
+        dt = now - self._t0
+        steps = loop.step - self._s0
+        self.last_steps_per_sec = steps / dt
+        msg = f"step {loop.step}: {self.last_steps_per_sec:.1f} steps/sec"
+        if self.batch_size:
+            eps = self.last_steps_per_sec * self.batch_size
+            n_chips = max(1, len(jax.devices()))
+            self.last_examples_per_sec_per_chip = eps / n_chips
+            msg += (
+                f", {eps:.0f} examples/sec"
+                f" ({self.last_examples_per_sec_per_chip:.0f}/chip)"
+            )
+        log.info(msg)
+        loop.record(
+            steps_per_sec=self.last_steps_per_sec,
+            examples_per_sec_per_chip=self.last_examples_per_sec_per_chip,
+        )
+        self._t0, self._s0 = now, loop.step
+
+
+class LoggingHook(Hook):
+    """Every N steps, fetch the (device) metrics and log them.  The fetch is
+    the only host sync in the loop, so its cadence bounds dispatch overlap —
+    keep N modest (ref LoggingTensorHook's every_n_iter).  Cadence is
+    delta-based so it holds under unroll>1 (step advances by k per call)."""
+
+    def __init__(self, every_steps: int = 100, formatter: Callable | None = None):
+        self.every = every_steps
+        self.formatter = formatter
+        self._last = 0
+
+    def begin(self, loop):
+        self._last = loop.step
+
+    def after_step(self, loop, metrics):
+        if loop.step - self._last < self.every:
+            return
+        self._last = loop.step
+        host = {k: float(v) for k, v in metrics.items() if _is_scalar(v)}
+        if self.formatter:
+            log.info(self.formatter(loop.step, host))
+        else:
+            parts = ", ".join(f"{k}={v:.4f}" for k, v in sorted(host.items()))
+            log.info("step %d: %s", loop.step, parts)
+
+
+class CheckpointHook(Hook):
+    """Periodic + final save through a ``checkpoint.CheckpointManager``."""
+
+    def __init__(self, manager, every_steps: int = 1000, every_secs: float | None = None):
+        self.mgr = manager
+        self.every_steps = every_steps
+        self.every_secs = every_secs
+        self._last_t = time.monotonic()
+        self._last_s = 0
+
+    def begin(self, loop):
+        self._last_s = loop.step
+
+    def after_step(self, loop, metrics):
+        due = loop.step - self._last_s >= self.every_steps
+        if self.every_secs is not None:
+            due = due or (time.monotonic() - self._last_t) >= self.every_secs
+        if due:
+            self.mgr.save(loop.step, loop.state)
+            self._last_t = time.monotonic()
+            self._last_s = loop.step
+
+    def end(self, loop):
+        self.mgr.save(loop.step, loop.state, force=True)
+        self.mgr.wait()
+
+
+class SummaryHook(Hook):
+    """Writes scalar metrics to a ``utils.metrics.MetricsWriter`` every N
+    steps (ref SummarySaverHook -> event files)."""
+
+    def __init__(self, writer, every_steps: int = 100):
+        self.writer = writer
+        self.every = every_steps
+        self._last = 0
+
+    def begin(self, loop):
+        self._last = loop.step
+
+    def after_step(self, loop, metrics):
+        if loop.step - self._last < self.every:
+            return
+        self._last = loop.step
+        self.writer.scalars(
+            loop.step, {k: float(v) for k, v in metrics.items() if _is_scalar(v)}
+        )
+
+    def end(self, loop):
+        self.writer.flush()
+
+
+class ProfilerHook(Hook):
+    """Captures a jax.profiler trace for steps [start, start+count)."""
+
+    def __init__(self, log_dir: str, start_step: int = 10, num_steps: int = 5):
+        self.log_dir = log_dir
+        self.start = start_step
+        self.stop = start_step + num_steps
+        self._active = False
+
+    def before_step(self, loop):
+        if loop.step == self.start and not self._active:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+
+    def after_step(self, loop, metrics):
+        if self._active and loop.step >= self.stop:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def end(self, loop):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+def _is_scalar(v) -> bool:
+    try:
+        return getattr(v, "ndim", None) == 0 or isinstance(v, (int, float))
+    except Exception:
+        return False

@@ -1,0 +1,117 @@
+"""TrainSession: the ``MonitoredTrainingSession`` analog (SURVEY.md T1).
+
+The reference's session (``monitored_session.py:428``) provides: chief-led
+init, worker wait-for-chief, hook dispatch around every ``sess.run``, stop
+signalling, and crash-recovery restore from the latest checkpoint.  On a
+single-controller SPMD runtime there is no chief/worker split to coordinate —
+init happens once, identically, on every process (same seeds => same values;
+sharded init via ``create_sharded_state``).  What remains, and lives here:
+
+- hook dispatch around each compiled step (``should_stop`` protocol),
+- auto-resume from the newest checkpoint before the first step,
+- async-dispatch-aware metric handling (metrics stay on device; hooks decide
+  when to block on them).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Iterable, Sequence
+
+from .hooks import Hook
+from .state import TrainState
+
+log = logging.getLogger("dtx.loop")
+
+
+class TrainSession:
+    """Runs ``state, metrics = step_fn(state, batch)`` until a hook requests
+    stop.
+
+    Usage (mirrors the reference loop shape, SURVEY.md section 3.1)::
+
+        session = TrainSession(step_fn, state, hooks=[StopAtStepHook(1000)])
+        session.run(batches)          # or: step-at-a-time via run_step
+    """
+
+    def __init__(
+        self,
+        step_fn: Callable,
+        state: TrainState,
+        *,
+        hooks: Sequence[Hook] = (),
+        checkpoint_manager=None,
+        steps_per_call: int = 1,
+    ):
+        self.step_fn = step_fn
+        self.state = state
+        self.hooks = list(hooks)
+        self.ckpt = checkpoint_manager
+        self.steps_per_call = steps_per_call
+        self._stop_reason: str | None = None
+        self.records: dict[str, Any] = {}
+        self.last_metrics: dict[str, Any] = {}
+        # Host-side step mirror: reading state.step would block on the
+        # freshly-dispatched device computation every step, serialising the
+        # pipeline.  Synced from the device only at begin/restore.
+        self._host_step = int(state.step)
+
+    # -- MonitoredSession-compatible surface ---------------------------------
+
+    def should_stop(self) -> bool:
+        return self._stop_reason is not None
+
+    def request_stop(self, reason: str = "") -> None:
+        if self._stop_reason is None:
+            self._stop_reason = reason or "requested"
+
+    @property
+    def step(self) -> int:
+        """Host-side mirror of the global step (no device sync)."""
+        return self._host_step
+
+    def record(self, **kv) -> None:
+        """Hooks publish summary values here (e.g. steps/sec) for callers."""
+        self.records.update({k: v for k, v in kv.items() if v is not None})
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _begin(self):
+        if self.ckpt is not None:
+            restored = self.ckpt.restore_latest(self.state)
+            if restored is not None:
+                self.state = restored
+                self._host_step = int(restored.step)
+                log.info("auto-resumed at step %d", self.step)
+        for h in self.hooks:
+            h.begin(self)
+
+    def _end(self):
+        for h in self.hooks:
+            h.end(self)
+
+    def run_step(self, batch) -> dict[str, Any]:
+        for h in self.hooks:
+            h.before_step(self)
+        self.state, metrics = self.step_fn(self.state, batch)
+        self._host_step += self.steps_per_call
+        self.last_metrics = metrics
+        for h in self.hooks:
+            h.after_step(self, metrics)
+        return metrics
+
+    def run(self, batches: Iterable) -> TrainState:
+        """Full managed run: begin (restore + hooks), loop, end (final save)."""
+        self._begin()
+        try:
+            if not self.should_stop():
+                for batch in batches:
+                    self.run_step(batch)
+                    if self.should_stop():
+                        break
+                else:
+                    self.request_stop("data exhausted")
+        finally:
+            self._end()
+        log.info("training stopped: %s", self._stop_reason)
+        return self.state

@@ -1,0 +1,83 @@
+"""TrainState: the framework's unit of trainable state.
+
+Replaces the reference's scattered graph state — global_step variable,
+model variables placed by ``replica_device_setter``, optimizer slot variables
+on PS tasks (SURVEY.md sections 2b D3, 3.1) — with one pytree whose layout is
+governed by sharding rules and which checkpointing/restoring treats atomically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..parallel.sharding import ShardingRules, sharding_tree
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    step: jax.Array  # int32 scalar — the global_step analog
+    params: Any
+    opt_state: Any
+    model_state: Any  # mutable non-trainable state (e.g. batchnorm stats)
+    rng: jax.Array  # per-step randomness source, folded with step
+
+
+def create_state(init_params_fn: Callable, optimizer, rng: jax.Array) -> TrainState:
+    """Host-side (unsharded) state init; for tests and single-chip runs."""
+    params, model_state = _split_init(init_params_fn, rng)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=optimizer.init(params),
+        model_state=model_state,
+        rng=rng,
+    )
+
+
+def _split_init(init_params_fn, rng):
+    out = init_params_fn(rng)
+    if isinstance(out, tuple):
+        params, model_state = out
+    else:
+        params, model_state = out, {}
+    return params, model_state
+
+
+def create_sharded_state(
+    init_params_fn: Callable,
+    optimizer,
+    rng: jax.Array,
+    *,
+    mesh: Mesh,
+    rules: ShardingRules = (),
+) -> tuple[TrainState, Any]:
+    """Initialise the state *directly sharded*: the init function is jitted
+    with ``out_shardings`` from the rule table, so large sharded parameters
+    (e.g. W4's embedding table) are born distributed in mesh HBM and never
+    materialise on one host — the analog of each PS task initialising only its
+    own variables.
+
+    Returns ``(state, state_shardings)``; the shardings tree is reused as the
+    train step's in/out shardings and the checkpoint restore layout.
+    """
+
+    def _init(rng):
+        params, model_state = _split_init(init_params_fn, rng)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=optimizer.init(params),
+            model_state=model_state,
+            rng=rng,
+        )
+
+    abstract = jax.eval_shape(_init, rng)
+    shardings = sharding_tree(abstract, mesh, rules)
+    state = jax.jit(_init, out_shardings=shardings)(rng)
+    return state, shardings

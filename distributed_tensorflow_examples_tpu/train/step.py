@@ -1,0 +1,131 @@
+"""Jitted SPMD train/eval step builders.
+
+One compiled function is the whole per-step hot path — forward, backward,
+cross-replica gradient reduction, optimizer update — where the reference
+crosses process boundaries multiple times per step (worker->master RunStep,
+worker->PS gradient push/variable fetch; SURVEY.md section 3.1).  The
+gradient all-reduce is *implicit*: the loss is a global-batch mean over a
+batch sharded on the ``data`` axis, so XLA emits the ICI all-reduce where
+``SyncReplicasOptimizer``/NCCL did it by hand.
+
+Multi-step unrolling (``unroll=k``): runs k steps per dispatch via
+``lax.scan`` over a [k, ...] super-batch — amortising host dispatch for
+microsecond-scale models (MNIST MLP at v5e-64; SURVEY.md section 7 hard-part
+#2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.sharding import ShardingRules, batch_sharding, sharding_tree
+from .state import TrainState
+
+#: loss_fn signature: (params, model_state, batch, rng)
+#:                    -> (loss, (new_model_state, metrics_dict))
+LossFn = Callable[..., tuple[jax.Array, tuple[Any, dict[str, jax.Array]]]]
+
+
+def build_train_step(
+    loss_fn: LossFn,
+    optimizer: optax.GradientTransformation,
+    *,
+    mesh: Mesh | None = None,
+    rules: ShardingRules = (),
+    state_shardings: Any = None,
+    donate: bool = True,
+    unroll: int = 1,
+):
+    """Returns ``step(state, batch) -> (state, metrics)``, fully jitted.
+
+    With ``mesh``: in/out shardings are pinned (params per rule table, batch
+    over the data axis) so the compiled executable is the same SPMD program on
+    1 chip or a pod.  ``donate`` releases the input state's buffers to the
+    output (halves peak HBM — the in-place variable update analog).
+    """
+
+    def one_step(state: TrainState, batch) -> tuple[TrainState, dict]:
+        step_rng = jax.random.fold_in(state.rng, state.step)
+        (loss, (new_model_state, metrics)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params, state.model_state, batch, step_rng)
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            model_state=new_model_state,
+            rng=state.rng,
+        )
+        return new_state, metrics
+
+    if unroll > 1:
+
+        def stepper(state: TrainState, super_batch):
+            def body(s, b):
+                return one_step(s, b)
+
+            state, metrics = jax.lax.scan(body, state, super_batch)
+            # Report the last step's metrics (cheap; full series available
+            # under the "series/" keys for callers that want them).
+            last = jax.tree.map(lambda m: m[-1], metrics)
+            return state, last
+
+    else:
+        stepper = one_step
+
+    if mesh is None:
+        return jax.jit(stepper, donate_argnums=(0,) if donate else ())
+
+    if state_shardings is None:
+        raise ValueError(
+            "build_train_step(mesh=...) needs state_shardings= (from "
+            "create_sharded_state) so jit can pin the state layout; pass it "
+            "or omit mesh for sharding-free jit."
+        )
+    b_sharding = batch_sharding(mesh)
+    if unroll > 1:
+        spec = b_sharding.spec
+        b_sharding = NamedSharding(mesh, P(None, *spec))
+    return jax.jit(
+        stepper,
+        in_shardings=(state_shardings, _tree_of(b_sharding)),
+        out_shardings=(state_shardings, _tree_of_replicated(mesh)),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def _tree_of(sharding):
+    # Batches are dicts of arrays; one sharding broadcasts over the dict via
+    # jit's prefix-pytree rules.
+    return sharding
+
+
+def _tree_of_replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def build_eval_step(
+    eval_fn: Callable,
+    *,
+    mesh: Mesh | None = None,
+    state_shardings: Any = None,
+):
+    """``eval(state, batch) -> metrics`` (replicated outputs)."""
+
+    def stepper(state: TrainState, batch):
+        return eval_fn(state.params, state.model_state, batch)
+
+    if mesh is None:
+        return jax.jit(stepper)
+    return jax.jit(
+        stepper,
+        in_shardings=(state_shardings, batch_sharding(mesh)),
+        out_shardings=_tree_of_replicated(mesh),
+    )

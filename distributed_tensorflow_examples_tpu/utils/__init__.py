@@ -1,0 +1,4 @@
+"""Utilities: flags/CLI, metrics writers, logging setup (SURVEY.md T4/T5)."""
+
+from . import flags  # noqa: F401
+from .metrics import MetricsWriter  # noqa: F401

@@ -1,0 +1,99 @@
+"""Flag/CLI layer: absl-flags based, preserving the reference CLIs.
+
+Contract (SURVEY.md section 5.6, BASELINE.json:5): every example keeps its
+existing CLI.  The reference scripts take TF-1 cluster flags
+(``--ps_hosts/--worker_hosts/--job_name/--task_index``); on TPU the cluster is
+a mesh, so those flags are *accepted and mapped*:
+
+- ``--ps_hosts``/``--worker_hosts``/``--job_name``/``--task_index`` are
+  parsed, logged, and translated: the worker count informs a requested data-
+  parallel size when ``--mesh`` is unset; PS hosts map to nothing (the PS role
+  is absorbed by mesh-sharded variables) and a notice explains that.
+- New-style control: ``--mesh "data=8,model=2"``, ``--coordinator`` etc.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from absl import flags
+
+log = logging.getLogger("dtx.flags")
+
+FLAGS = flags.FLAGS
+
+
+def _define(kind, name, default, help_str):
+    """Define unless an identical-named flag exists (absl.logging already owns
+    --log_dir; the reference CLI reuses that name, so we adopt it)."""
+    if name in flags.FLAGS:
+        return
+    getattr(flags, f"DEFINE_{kind}")(name, default, help_str)
+
+
+def define_training_flags(default_batch_size: int = 128, default_steps: int = 1000):
+    """The shared surface every example exposes (ref flag set, SURVEY.md L5).
+    Idempotent (``_define``) so bench drivers/tests may import several example
+    modules into one process."""
+    _define("integer", "batch_size", default_batch_size, "GLOBAL batch size.")
+    _define("integer", "train_steps", default_steps, "Stop after this many steps.")
+    _define("string", "data_dir", None, "Dataset directory (synthetic if absent).")
+    _define("string", "log_dir", None, "Checkpoints + metrics directory.")
+    _define("float", "learning_rate", 0.01, "Base learning rate.")
+    _define("integer", "seed", 0, "Global RNG seed (determinism knob).")
+    _define(
+        "integer", "log_every_steps", 100, "Metric logging cadence (LoggingTensorHook analog)."
+    )
+    _define(
+        "integer", "checkpoint_every_steps", 1000, "CheckpointSaverHook save cadence."
+    )
+    _define(
+        "integer", "unroll", 1, "Steps fused per dispatch (lax.scan multi-step trains)."
+    )
+    _define(
+        "string",
+        "mesh",
+        "",
+        'Mesh spec, e.g. "data=8,model=2"; empty = all devices on the data axis.',
+    )
+    _define("bool", "profile", False, "Capture a jax.profiler trace window.")
+
+
+def define_legacy_cluster_flags():
+    """TF-1 PS/worker cluster flags: accepted for CLI compatibility, mapped to
+    mesh topology (SURVEY.md D1/D9 -> mesh)."""
+    _define("string", "ps_hosts", "", "(legacy) comma-separated PS host:port list.")
+    _define(
+        "string", "worker_hosts", "", "(legacy) comma-separated worker host:port list."
+    )
+    _define("string", "job_name", "", '(legacy) "ps" or "worker".')
+    _define("integer", "task_index", 0, "(legacy) task index within the job.")
+    _define(
+        "bool", "sync_replicas", True, "(legacy) SyncReplicasOptimizer on/off -> sync/async DP."
+    )
+
+
+def resolve_legacy_cluster(FLAGS) -> dict:
+    """Interpret legacy cluster flags against the mesh world; returns info for
+    the example to log.  A process launched as a PS task has no role in SPMD:
+    we exit 0 immediately (the analog of ``server.join()`` never being needed).
+    """
+    info = {}
+    if getattr(FLAGS, "ps_hosts", ""):
+        info["ps_hosts"] = FLAGS.ps_hosts.split(",")
+        log.warning(
+            "--ps_hosts given: parameter servers are obsolete on TPU — "
+            "variables are mesh-sharded in HBM (replica_device_setter -> "
+            "sharding rules). Ignoring %d PS hosts.",
+            len(info["ps_hosts"]),
+        )
+    if getattr(FLAGS, "worker_hosts", ""):
+        info["worker_hosts"] = FLAGS.worker_hosts.split(",")
+        log.info(
+            "--worker_hosts given (%d workers): on TPU the equivalent "
+            "data-parallel degree comes from the mesh; launch one process "
+            "per host with jax.distributed (see parallel.dist).",
+            len(info["worker_hosts"]),
+        )
+    info["is_legacy_ps_process"] = getattr(FLAGS, "job_name", "") == "ps"
+    return info

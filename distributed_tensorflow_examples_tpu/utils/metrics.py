@@ -1,0 +1,64 @@
+"""Metrics writer: the tf.summary event-file role (SURVEY.md T4, section 5.5).
+
+Primary sink is JSONL (``<log_dir>/metrics.jsonl``) — trivially parseable by
+the bench harness and tests.  If TensorBoard's pure-python writer is importable
+(it ships with the baked TF install), scalars are mirrored into real event
+files so standard tooling works; its absence degrades silently.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+class MetricsWriter:
+    def __init__(self, log_dir: str | None, *, tensorboard: bool = True):
+        self.log_dir = log_dir
+        self._f = None
+        self._tb = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            self._f = open(os.path.join(log_dir, "metrics.jsonl"), "a", buffering=1)
+            if tensorboard:
+                try:  # optional dependency — degrade to JSONL-only
+                    from tensorboard.summary.writer.event_file_writer import (
+                        EventFileWriter,
+                    )
+                    from tensorboard.compat.proto.summary_pb2 import Summary
+                    from tensorboard.compat.proto.event_pb2 import Event
+
+                    self._tb = EventFileWriter(log_dir)
+                    self._Summary, self._Event = Summary, Event
+                except Exception:
+                    self._tb = None
+
+    def scalars(self, step: int, values: dict[str, float]) -> None:
+        if self._f is not None:
+            self._f.write(
+                json.dumps({"step": step, "time": time.time(), **values}) + "\n"
+            )
+        if self._tb is not None:
+            summ = self._Summary(
+                value=[
+                    self._Summary.Value(tag=k, simple_value=float(v))
+                    for k, v in values.items()
+                ]
+            )
+            self._tb.add_event(
+                self._Event(step=step, wall_time=time.time(), summary=summ)
+            )
+
+    def flush(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+        if self._tb is not None:
+            self._tb.flush()
+
+    def close(self) -> None:
+        self.flush()
+        if self._f is not None:
+            self._f.close()
+        if self._tb is not None:
+            self._tb.close()

@@ -1,0 +1,40 @@
+"""Mesh construction tests (parallel.mesh)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_tensorflow_examples_tpu.parallel import MeshSpec, build_mesh
+from distributed_tensorflow_examples_tpu.parallel.mesh import local_mesh_for_testing
+
+
+def test_meshspec_resolve_infers_data_axis():
+    sizes = MeshSpec(model=2).resolved(8)
+    assert sizes["data"] == 4 and sizes["model"] == 2
+
+
+def test_meshspec_rejects_bad_product():
+    with pytest.raises(ValueError):
+        MeshSpec(data=3, model=2).resolved(8)
+
+
+def test_meshspec_parse():
+    s = MeshSpec.parse("data=2,model=4")
+    assert s.data == 2 and s.model == 4
+    assert MeshSpec.parse("").data == -1
+    with pytest.raises(ValueError):
+        MeshSpec.parse("bogus=2")
+
+
+def test_build_mesh_cpu_devices():
+    mesh = build_mesh(MeshSpec(data=8), devices=jax.devices("cpu"))
+    assert mesh.shape["data"] == 8
+    assert mesh.size == 8
+
+
+def test_local_mesh_for_testing_axes():
+    mesh = local_mesh_for_testing({"data": 2, "model": 2})
+    assert mesh.shape["data"] == 2 and mesh.shape["model"] == 2
+    # unlisted axes exist with size 1 so PartitionSpecs referencing them work
+    assert mesh.shape["seq"] == 1

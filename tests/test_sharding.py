@@ -1,0 +1,62 @@
+"""Sharding rules tests (parallel.sharding, parallel.partitioner)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_examples_tpu.parallel import (
+    fixed_size_partitioner,
+    shard_pytree,
+    sharding_tree,
+    spec_for_path,
+)
+from distributed_tensorflow_examples_tpu.parallel.sharding import batch_sharding
+
+
+RULES = (
+    ("embedding/table", P("model", None)),
+    (r"dense_\d+/kernel", P(None, "model")),
+)
+
+
+def test_spec_for_path_first_match_and_default():
+    assert spec_for_path("embedding/table", RULES) == P("model", None)
+    assert spec_for_path("dense_0/kernel", RULES) == P(None, "model")
+    assert spec_for_path("dense_0/bias", RULES) == P()
+
+
+def test_fixed_size_partitioner_spec():
+    assert fixed_size_partitioner("model", dim=0) == P("model")
+    assert fixed_size_partitioner("model", dim=1) == P(None, "model")
+
+
+def test_shard_pytree_places_leaves(mesh_4x2):
+    tree = {
+        "embedding": {"table": jnp.ones((16, 8))},
+        "dense_0": {"kernel": jnp.ones((8, 4)), "bias": jnp.ones((4,))},
+    }
+    sharded = shard_pytree(tree, mesh_4x2, RULES)
+    table = sharded["embedding"]["table"]
+    assert table.sharding.spec == P("model", None)
+    # each model-shard holds 16/2 rows
+    assert table.addressable_shards[0].data.shape == (8, 8)
+    assert sharded["dense_0"]["bias"].sharding.spec == P()
+
+
+def test_clamping_indivisible_dims_falls_back_to_replication(mesh_4x2):
+    # 7 rows can't split over model=2 -> replicated on that dim
+    tree = {"embedding": {"table": jnp.ones((7, 8))}}
+    shardings = sharding_tree(tree, mesh_4x2, RULES)
+    assert shardings["embedding"]["table"].spec == P(None, None)
+
+
+def test_sharding_applies_through_opt_state_paths(mesh_4x2):
+    # rules use re.search so optimizer slot paths like "0/mu/dense_0/kernel"
+    # inherit the parameter's sharding (PS slot-variable placement analog)
+    assert spec_for_path("0/mu/dense_0/kernel", RULES) == P(None, "model")
+
+
+def test_batch_sharding_leading_dim(mesh8):
+    s = batch_sharding(mesh8)
+    assert s.spec == P("data")
