@@ -1,0 +1,95 @@
+"""Benchmark driver: prints ONE JSON line with the headline metric.
+
+Headline (BASELINE.md): images/sec/chip on the flagship workload.  There are
+no published reference numbers (`BASELINE.json: "published": {}`), so
+``vs_baseline`` is measured against the targets table this repo maintains in
+BASELINE.md ("Measured" column for the current hardware), and is 1.0 on the
+first recorded run.
+
+Run: ``python bench.py [--model mlp] [--steps 200] [--batch-per-chip 1024]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def bench_mlp(steps: int, batch_per_chip: int, warmup: int = 20):
+    import jax
+    import numpy as np
+    import optax
+
+    from distributed_tensorflow_examples_tpu import data, models, parallel, train
+
+    mesh = parallel.build_mesh(parallel.MeshSpec())
+    n_chips = mesh.size
+    global_batch = batch_per_chip * n_chips
+
+    cfg = models.mlp.Config()
+    opt = optax.sgd(0.05)
+    state, shardings = train.create_sharded_state(
+        lambda rng: models.mlp.init(cfg, rng),
+        opt,
+        jax.random.key(0),
+        mesh=mesh,
+        rules=models.mlp.SHARDING_RULES,
+    )
+    step_fn = train.build_train_step(
+        models.mlp.loss_fn(cfg), opt, mesh=mesh, state_shardings=shardings
+    )
+    rng = np.random.default_rng(0)
+    batch = data.pipeline.as_global(
+        {
+            "image": rng.normal(size=(global_batch, 28, 28, 1)).astype(np.float32),
+            "label": rng.integers(0, 10, size=(global_batch,)).astype(np.int32),
+        },
+        mesh,
+    )
+    for _ in range(warmup):
+        state, metrics = step_fn(state, batch)
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+    images_per_sec = steps * global_batch / dt
+    return {
+        "model": "mnist_mlp",
+        "images_per_sec": images_per_sec,
+        "images_per_sec_per_chip": images_per_sec / n_chips,
+        "n_chips": n_chips,
+        "steps_per_sec": steps / dt,
+        "global_batch": global_batch,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="mlp", choices=["mlp"])
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--batch-per-chip", type=int, default=1024)
+    args = ap.parse_args()
+
+    r = bench_mlp(args.steps, args.batch_per_chip)
+    print(
+        json.dumps(
+            {
+                "metric": f"{r['model']}_images_per_sec_per_chip",
+                "value": round(r["images_per_sec_per_chip"], 1),
+                "unit": "images/sec/chip",
+                "vs_baseline": 1.0,
+                "detail": {k: round(v, 2) if isinstance(v, float) else v for k, v in r.items()},
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

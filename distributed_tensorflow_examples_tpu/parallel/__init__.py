@@ -29,3 +29,4 @@ from .partitioner import (  # noqa: F401
     min_max_variable_partitioner,
 )
 from . import collectives  # noqa: F401
+from . import dist  # noqa: F401

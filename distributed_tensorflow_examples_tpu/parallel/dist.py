@@ -1,0 +1,160 @@
+"""Multi-host bootstrap: the TPU-native distributed runtime layer.
+
+Replaces the reference's control plane (SURVEY.md section 2b D2/D9/D10):
+``tf.train.Server`` starting gRPC master/worker services per process, and
+``TFConfigClusterResolver`` reading the ``TF_CONFIG`` env JSON.  On TPU the
+control plane is JAX's coordination service (``jax.distributed.initialize``
+over DCN); the data plane is XLA collectives over ICI and never touches this
+module.  What remains host-side:
+
+- cluster resolution: explicit args > ``TF_CONFIG`` (accepted for CLI/env
+  compatibility with reference launchers) > TPU-pod auto-detection (on Cloud
+  TPU ``jax.distributed.initialize()`` discovers everything itself),
+- process identity helpers (``is_chief`` = process 0, the analog of
+  ``task_index == 0`` chief election),
+- a cross-host barrier (``sync_global_devices``), the ``wait_for_session``
+  analog used around checkpoint save/restore fences.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+
+import jax
+
+log = logging.getLogger("dtx.dist")
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterConfig:
+    """Resolved multi-host identity (the ClusterSpec + task tuple analog)."""
+
+    coordinator_address: str | None  # host:port of process 0
+    num_processes: int | None
+    process_id: int | None
+    source: str  # "args" | "tf_config" | "auto"
+    task_type: str | None = None  # TF_CONFIG task type ("worker", "ps", ...)
+
+    @property
+    def is_ps_task(self) -> bool:
+        """True for TF_CONFIG roles with no seat in the SPMD world (ps,
+        evaluator): the process should exit cleanly, like the legacy
+        ``--job_name=ps`` path (SURVEY.md section 5.6)."""
+        return self.task_type in ("ps", "evaluator")
+
+
+def resolve_cluster(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> ClusterConfig:
+    """Explicit args win; else ``TF_CONFIG`` (TFConfigClusterResolver analog,
+    SURVEY.md D9); else leave everything None for TPU-pod auto-detection."""
+    if coordinator_address or num_processes is not None or process_id is not None:
+        return ClusterConfig(coordinator_address, num_processes, process_id, "args")
+
+    tf_config = os.environ.get("TF_CONFIG")
+    if tf_config:
+        try:
+            cfg = json.loads(tf_config)
+            cluster = cfg.get("cluster", {})
+            task = cfg.get("task", {})
+            workers = list(cluster.get("chief", [])) + list(cluster.get("worker", []))
+            if cluster.get("ps"):
+                log.warning(
+                    "TF_CONFIG lists %d ps tasks: parameter servers are "
+                    "obsolete on TPU (variables are mesh-sharded); counting "
+                    "only chief/worker tasks as processes.",
+                    len(cluster["ps"]),
+                )
+            task_type = task.get("type")
+            index = int(task.get("index", 0))
+            if task_type == "worker" and "chief" in cluster:
+                index += len(cluster["chief"])
+            if workers:
+                if task_type not in (None, "chief", "worker"):
+                    # ps/evaluator tasks hold no SPMD process id — giving them
+                    # one would collide with a real worker's seat.
+                    return ClusterConfig(
+                        workers[0], len(workers), None, "tf_config", task_type
+                    )
+                # Coordinator port: reuse the first task's port on its host.
+                return ClusterConfig(
+                    workers[0], len(workers), index, "tf_config", task_type
+                )
+        except (ValueError, KeyError) as e:
+            log.warning("ignoring malformed TF_CONFIG: %s", e)
+    return ClusterConfig(None, None, None, "auto")
+
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> ClusterConfig:
+    """Start (or join) the coordination service.  Idempotent; single-process
+    runs (no cluster info anywhere, 1 host) skip initialization entirely so
+    examples work unchanged on one chip."""
+    global _initialized
+    cfg = resolve_cluster(coordinator_address, num_processes, process_id)
+    if _initialized:
+        return cfg
+    if cfg.is_ps_task:
+        log.warning(
+            "TF_CONFIG task type %r has no role under SPMD; not joining the "
+            "coordination service (caller should exit 0).",
+            cfg.task_type,
+        )
+        return cfg
+    if cfg.source == "auto" and not _on_multihost_tpu():
+        return cfg  # plain single-process run
+    # NOTE: must run before any other JAX call — touching the backend first
+    # (even jax.process_count()) would make initialize() raise.
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+    _initialized = True
+    log.info(
+        "distributed runtime up: process %d/%d (source=%s)",
+        jax.process_index(),
+        jax.process_count(),
+        cfg.source,
+    )
+    return cfg
+
+
+def _on_multihost_tpu() -> bool:
+    """True when Cloud-TPU env vars indicate a multi-host pod slice whose
+    topology ``jax.distributed.initialize()`` can self-discover."""
+    return bool(os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_chief() -> bool:
+    """Process 0 — the reference's ``task_index == 0`` chief (SURVEY.md T1).
+    Under SPMD the chief's only special duties are host-side: writing metrics
+    and directing non-sharded checkpoint metadata."""
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "barrier") -> None:
+    """Cross-host sync point (the ``SessionManager.wait_for_session`` analog:
+    everyone reaches ``name`` before anyone proceeds)."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

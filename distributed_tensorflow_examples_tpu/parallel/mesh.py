@@ -176,6 +176,9 @@ def local_mesh_for_testing(
     ``XLA_FLAGS=--xla_force_host_platform_device_count=N``.
     """
     sizes = dict(sizes or {})
+    unknown = set(sizes) - set(DEFAULT_AXES)
+    if unknown:
+        raise ValueError(f"unknown mesh axes {sorted(unknown)}; valid: {DEFAULT_AXES}")
     devices = jax.devices(platform)
     if not sizes:
         sizes = {AXIS_DATA: len(devices)}

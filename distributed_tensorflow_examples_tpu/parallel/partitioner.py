@@ -29,22 +29,24 @@ def fixed_size_partitioner(axis_name: str = "model", dim: int = 0):
 
 
 def min_max_variable_partitioner(
-    max_partitions: int | None = None,
     min_slice_bytes: int = 256 << 10,
     axis_name: str = "model",
 ):
-    """TF-analog heuristic partitioner: returns a *function* of (shape, dtype)
-    deciding whether the leading dim is worth sharding.  Small variables stay
-    replicated (sharding a tiny bias would only add collective latency).
+    """TF-analog heuristic partitioner: returns a *function* of
+    ``(shape, dtype_bytes, axis_size)`` deciding whether the leading dim is
+    worth sharding.  Small variables stay replicated (sharding a tiny bias
+    would only add collective latency).  Unlike TF's ``max_partitions`` there
+    is no partial shard count: a named mesh axis shards over all its devices
+    or not at all, so the only knob is the per-slice byte floor.
     """
 
-    def decide(shape, dtype_bytes: int = 4) -> PartitionSpec:
+    def decide(shape, dtype_bytes: int = 4, axis_size: int = 1) -> PartitionSpec:
         if not shape:
             return P()
         nbytes = dtype_bytes
         for s in shape:
             nbytes *= s
-        if nbytes < min_slice_bytes:
+        if nbytes // max(1, axis_size) < min_slice_bytes:
             return P()
         return P(axis_name)
 

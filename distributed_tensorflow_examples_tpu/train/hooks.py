@@ -43,6 +43,13 @@ class StopAtStepHook(Hook):
     def __init__(self, last_step: int):
         self.last_step = last_step
 
+    def begin(self, loop):
+        # An auto-resumed session may already be at/past the target; stopping
+        # here prevents re-running a finished job from training extra steps
+        # and overwriting its final checkpoint.
+        if loop.step >= self.last_step:
+            loop.request_stop(f"already at step {loop.step} >= {self.last_step}")
+
     def after_step(self, loop, metrics):
         if loop.step >= self.last_step:
             loop.request_stop(f"reached step {self.last_step}")
@@ -175,7 +182,11 @@ class ProfilerHook(Hook):
         self._active = False
 
     def before_step(self, loop):
-        if loop.step == self.start and not self._active:
+        # Straddle check: under unroll>1 the observed step advances by
+        # steps_per_call and may jump over [start, stop) entirely; activate
+        # whenever the upcoming call overlaps the window.
+        upcoming_end = loop.step + getattr(loop, "steps_per_call", 1)
+        if not self._active and loop.step < self.stop and upcoming_end > self.start:
             jax.profiler.start_trace(self.log_dir)
             self._active = True
 

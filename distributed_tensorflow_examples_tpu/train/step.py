@@ -72,8 +72,7 @@ def build_train_step(
                 return one_step(s, b)
 
             state, metrics = jax.lax.scan(body, state, super_batch)
-            # Report the last step's metrics (cheap; full series available
-            # under the "series/" keys for callers that want them).
+            # Only the last sub-step's metrics are reported.
             last = jax.tree.map(lambda m: m[-1], metrics)
             return state, last
 

@@ -1,0 +1,93 @@
+"""Cluster resolution (parallel.dist): the TFConfigClusterResolver analog."""
+
+import json
+
+from distributed_tensorflow_examples_tpu.parallel import dist
+
+
+def test_explicit_args_win(monkeypatch):
+    monkeypatch.setenv("TF_CONFIG", json.dumps({"cluster": {"worker": ["a:1"]}}))
+    cfg = dist.resolve_cluster("host0:1234", 4, 2)
+    assert cfg.source == "args"
+    assert cfg.coordinator_address == "host0:1234"
+    assert cfg.num_processes == 4 and cfg.process_id == 2
+
+
+def test_tf_config_worker(monkeypatch):
+    monkeypatch.setenv(
+        "TF_CONFIG",
+        json.dumps(
+            {
+                "cluster": {"worker": ["w0:2222", "w1:2222", "w2:2222"]},
+                "task": {"type": "worker", "index": 1},
+            }
+        ),
+    )
+    cfg = dist.resolve_cluster()
+    assert cfg.source == "tf_config"
+    assert cfg.coordinator_address == "w0:2222"
+    assert cfg.num_processes == 3 and cfg.process_id == 1
+
+
+def test_tf_config_chief_offsets_worker_index(monkeypatch):
+    monkeypatch.setenv(
+        "TF_CONFIG",
+        json.dumps(
+            {
+                "cluster": {"chief": ["c0:2222"], "worker": ["w0:2222"]},
+                "task": {"type": "worker", "index": 0},
+            }
+        ),
+    )
+    cfg = dist.resolve_cluster()
+    assert cfg.num_processes == 2
+    assert cfg.process_id == 1  # chief occupies process 0
+    assert cfg.coordinator_address == "c0:2222"
+
+
+def test_tf_config_ps_tasks_ignored(monkeypatch):
+    monkeypatch.setenv(
+        "TF_CONFIG",
+        json.dumps(
+            {
+                "cluster": {"ps": ["p0:1"], "worker": ["w0:2", "w1:2"]},
+                "task": {"type": "worker", "index": 0},
+            }
+        ),
+    )
+    cfg = dist.resolve_cluster()
+    assert cfg.num_processes == 2  # PS tasks are not SPMD processes
+
+
+def test_tf_config_ps_task_gets_no_process_id(monkeypatch):
+    monkeypatch.setenv(
+        "TF_CONFIG",
+        json.dumps(
+            {
+                "cluster": {"ps": ["p0:1"], "worker": ["w0:2", "w1:2"]},
+                "task": {"type": "ps", "index": 0},
+            }
+        ),
+    )
+    cfg = dist.resolve_cluster()
+    assert cfg.is_ps_task
+    assert cfg.process_id is None  # must not collide with worker 0's seat
+
+
+def test_no_info_is_auto(monkeypatch):
+    monkeypatch.delenv("TF_CONFIG", raising=False)
+    cfg = dist.resolve_cluster()
+    assert cfg.source == "auto"
+    assert cfg.coordinator_address is None
+
+
+def test_malformed_tf_config_falls_back(monkeypatch):
+    monkeypatch.setenv("TF_CONFIG", "{not json")
+    cfg = dist.resolve_cluster()
+    assert cfg.source == "auto"
+
+
+def test_process_helpers():
+    assert dist.process_count() >= 1
+    assert 0 <= dist.process_index() < dist.process_count()
+    assert dist.is_chief() == (dist.process_index() == 0)

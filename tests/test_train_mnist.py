@@ -36,18 +36,11 @@ def _make(mesh, unroll=1, lr=0.1):
     return state, step
 
 
-def _batches(mesh, n, batch=64, unroll=0):
+def _batches(mesh, n, batch=64):
     ds = data.datasets.mnist(None, seed=0)
     pipe = data.InMemoryPipeline(ds.train, batch_size=batch, shuffle=True, seed=0)
     it = iter(pipe)
-    out = []
-    for _ in range(n):
-        if unroll:
-            from distributed_tensorflow_examples_tpu.data.pipeline import (
-                stack_for_unroll,
-            )
-        out.append(next(it))
-    return [as_global(b, mesh) for b in out]
+    return [as_global(next(it), mesh) for _ in range(n)]
 
 
 def test_loss_falls_on_mesh8(mesh8):
