@@ -1,12 +1,12 @@
 """Benchmark driver: prints ONE JSON line with the headline metric.
 
-Headline (BASELINE.md): images/sec/chip on the flagship workload.  There are
-no published reference numbers (`BASELINE.json: "published": {}`), so
-``vs_baseline`` is measured against the targets table this repo maintains in
-BASELINE.md ("Measured" column for the current hardware), and is 1.0 on the
-first recorded run.
+Headline (BASELINE.md): images/sec/chip on the flagship workload (ResNet-50).
+There are no published reference numbers (`BASELINE.json: "published": {}`),
+so ``vs_baseline`` is measured against the targets table this repo maintains
+in BASELINE.md ("Measured" column for the current hardware), and is 1.0 on
+the first recorded run.
 
-Run: ``python bench.py [--model mlp] [--steps 200] [--batch-per-chip 1024]``
+Run: ``python bench.py [--model resnet50|mlp] [--steps 30] [--batch-per-chip N]``
 """
 
 from __future__ import annotations
@@ -20,48 +20,51 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
-def bench_mlp(steps: int, batch_per_chip: int, warmup: int = 20):
+def _bench_step_loop(step_fn, state, batch, *, steps: int, warmup: int):
+    """Time the compiled step over an on-device batch.
+
+    The batch is reused so the number measures the step, not host->device
+    transfer (the axon tunnel caps infeed at ~25 MB/s, which no real TPU host
+    has).  Timing is closed by a host fetch of the loss scalar — through the
+    tunnel ``block_until_ready`` returns early, inflating throughput by an
+    order of magnitude or more (13x-400x observed depending on workload).
+    """
+    for _ in range(warmup):
+        state, metrics = step_fn(state, batch)
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch)
+    float(metrics["loss"])
+    return time.perf_counter() - t0
+
+
+def _bench(name, model_mod, cfg, optimizer, make_batch, *, steps, batch_per_chip, warmup):
     import jax
     import numpy as np
-    import optax
 
-    from distributed_tensorflow_examples_tpu import data, models, parallel, train
+    from distributed_tensorflow_examples_tpu import data, parallel, train
 
     mesh = parallel.build_mesh(parallel.MeshSpec())
     n_chips = mesh.size
     global_batch = batch_per_chip * n_chips
 
-    cfg = models.mlp.Config()
-    opt = optax.sgd(0.05)
     state, shardings = train.create_sharded_state(
-        lambda rng: models.mlp.init(cfg, rng),
-        opt,
+        lambda rng: model_mod.init(cfg, rng),
+        optimizer,
         jax.random.key(0),
         mesh=mesh,
-        rules=models.mlp.SHARDING_RULES,
+        rules=model_mod.SHARDING_RULES,
     )
     step_fn = train.build_train_step(
-        models.mlp.loss_fn(cfg), opt, mesh=mesh, state_shardings=shardings
+        model_mod.loss_fn(cfg), optimizer, mesh=mesh, state_shardings=shardings
     )
     rng = np.random.default_rng(0)
-    batch = data.pipeline.as_global(
-        {
-            "image": rng.normal(size=(global_batch, 28, 28, 1)).astype(np.float32),
-            "label": rng.integers(0, 10, size=(global_batch,)).astype(np.int32),
-        },
-        mesh,
-    )
-    for _ in range(warmup):
-        state, metrics = step_fn(state, batch)
-    jax.block_until_ready(state.params)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step_fn(state, batch)
-    jax.block_until_ready(state.params)
-    dt = time.perf_counter() - t0
+    batch = data.pipeline.as_global(make_batch(rng, global_batch), mesh)
+    dt = _bench_step_loop(step_fn, state, batch, steps=steps, warmup=warmup)
     images_per_sec = steps * global_batch / dt
     return {
-        "model": "mnist_mlp",
+        "model": name,
         "images_per_sec": images_per_sec,
         "images_per_sec_per_chip": images_per_sec / n_chips,
         "n_chips": n_chips,
@@ -70,14 +73,58 @@ def bench_mlp(steps: int, batch_per_chip: int, warmup: int = 20):
     }
 
 
+def bench_resnet50(steps: int, batch_per_chip: int, image_size: int = 224):
+    """Flagship: ResNet-50 fwd+bwd+update images/sec/chip (BASELINE.md)."""
+    import optax
+
+    from distributed_tensorflow_examples_tpu import models
+
+    return _bench(
+        "resnet50",
+        models.resnet,
+        models.resnet.Config(),
+        optax.sgd(0.1, momentum=0.9),
+        lambda rng, n: {
+            "image": rng.normal(size=(n, image_size, image_size, 3)).astype("float32"),
+            "label": rng.integers(0, 1000, size=(n,)).astype("int32"),
+        },
+        steps=steps,
+        batch_per_chip=batch_per_chip,
+        warmup=5,
+    )
+
+
+def bench_mlp(steps: int, batch_per_chip: int):
+    import optax
+
+    from distributed_tensorflow_examples_tpu import models
+
+    return _bench(
+        "mnist_mlp",
+        models.mlp,
+        models.mlp.Config(),
+        optax.sgd(0.05),
+        lambda rng, n: {
+            "image": rng.normal(size=(n, 28, 28, 1)).astype("float32"),
+            "label": rng.integers(0, 10, size=(n,)).astype("int32"),
+        },
+        steps=steps,
+        batch_per_chip=batch_per_chip,
+        warmup=20,
+    )
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="mlp", choices=["mlp"])
-    ap.add_argument("--steps", type=int, default=200)
-    ap.add_argument("--batch-per-chip", type=int, default=1024)
+    ap.add_argument("--model", default="resnet50", choices=["resnet50", "mlp"])
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--batch-per-chip", type=int, default=None)
     args = ap.parse_args()
 
-    r = bench_mlp(args.steps, args.batch_per_chip)
+    if args.model == "resnet50":
+        r = bench_resnet50(args.steps or 30, args.batch_per_chip or 128)
+    else:
+        r = bench_mlp(args.steps or 200, args.batch_per_chip or 1024)
     print(
         json.dumps(
             {

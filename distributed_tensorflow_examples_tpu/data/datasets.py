@@ -201,10 +201,12 @@ def ptb(data_dir: str | None = None, *, vocab_size: int = 10000, seed: int = 0):
 
 
 def lm_batches(
-    ids: np.ndarray, *, batch_size: int, seq_len: int, seed: int = 0
+    ids: np.ndarray, *, batch_size: int, seq_len: int
 ) -> Iterator[dict[str, np.ndarray]]:
     """Truncated-BPTT batching: contiguous streams per batch row (the PTB
-    convention), yielding {"x": [B,T], "y": [B,T]} forever."""
+    convention), yielding {"x": [B,T], "y": [B,T]} forever.  Fully
+    deterministic from the token array (no shuffling — PTB keeps corpus
+    order)."""
     n = len(ids)
     rows = batch_size
     per_row = n // rows

@@ -16,3 +16,7 @@ traced function XLA can fuse end-to-end.
 
 from . import layers  # noqa: F401
 from . import mlp  # noqa: F401
+from . import cnn  # noqa: F401
+from . import resnet  # noqa: F401
+from . import word2vec  # noqa: F401
+from . import lstm  # noqa: F401

@@ -1,0 +1,78 @@
+"""W2: CIFAR-10 CNN — the reference's async parameter-server workload
+(SURVEY.md section 2a W2, BASELINE.json:8).
+
+Model shape follows the classic TF CIFAR-10 tutorial net the reference genre
+uses: two conv+pool blocks then two dense layers — all MXU-friendly (NHWC,
+bf16 compute, f32 accumulation).  The *async* PS semantics are a
+training-loop concern (SURVEY.md section 7 step 6), not a model concern; this
+module is the pure model, usable under sync or async-emulated DP.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from . import layers
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    num_classes: int = 10
+    channels: tuple[int, ...] = (64, 64)
+    dense: tuple[int, ...] = (384, 192)
+    compute_dtype: str = "bfloat16"
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.compute_dtype)
+
+
+def init(cfg: Config, rng: jax.Array, *, image_size: int = 32, in_channels: int = 3):
+    n_conv, n_dense = len(cfg.channels), len(cfg.dense)
+    rngs = jax.random.split(rng, n_conv + n_dense + 1)
+    params = {}
+    cin = in_channels
+    for i, cout in enumerate(cfg.channels):
+        params[f"conv_{i}"] = layers.conv_init(rngs[i], 5, 5, cin, cout)
+        cin = cout
+    # Each conv block pools 2x; flattened feature size after the conv stack:
+    feat = (image_size // (2 ** n_conv)) ** 2 * cin
+    din = feat
+    for j, dout in enumerate(cfg.dense):
+        params[f"dense_{j}"] = layers.dense_init(rngs[n_conv + j], din, dout)
+        din = dout
+    params["logits"] = layers.dense_init(rngs[-1], din, cfg.num_classes)
+    return params
+
+
+def apply(cfg: Config, params, x):
+    """x: [B, H, W, C] float -> logits [B, num_classes]."""
+    for i in range(len(cfg.channels)):
+        x = layers.conv2d(params[f"conv_{i}"], x, dtype=cfg.dtype)
+        x = jax.nn.relu(x)
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+        )
+    x = x.reshape(x.shape[0], -1)
+    for j in range(len(cfg.dense)):
+        x = layers.dense(params[f"dense_{j}"], x, dtype=cfg.dtype)
+        x = jax.nn.relu(x)
+    return layers.dense(params["logits"], x, dtype=cfg.dtype)
+
+
+def loss_fn(cfg: Config):
+    def f(params, model_state, batch, rng):
+        logits = apply(cfg, params, batch["image"])
+        loss = layers.softmax_cross_entropy(logits, batch["label"])
+        acc = layers.accuracy(logits, batch["label"])
+        return loss, (model_state, {"loss": loss, "accuracy": acc})
+
+    return f
+
+
+#: Mirrored variables (the async-PS placement maps to replication + the
+#: accumulator service, not to sharding).
+SHARDING_RULES: tuple = ()

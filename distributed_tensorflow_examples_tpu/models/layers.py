@@ -56,7 +56,14 @@ def dense_init(rng, in_dim: int, out_dim: int, *, use_bias: bool = True):
 def dense(params, x, *, dtype=None):
     k = params["kernel"]
     if dtype is not None:
+        # Pure compute-dtype matmul: on TPU the MXU accumulates bf16 inputs
+        # in f32 internally; keeping in/out dtypes uniform keeps the autodiff
+        # transpose well-typed (mixed bf16/f32 transposes are rejected).
         x, k = x.astype(dtype), k.astype(dtype)
+        y = jnp.matmul(x, k)
+        if "bias" in params:
+            y = y + params["bias"].astype(dtype)
+        return y
     y = jnp.matmul(x, k, preferred_element_type=jnp.float32)
     if "bias" in params:
         y = y + params["bias"]
@@ -86,10 +93,13 @@ def conv2d(params, x, *, stride=1, padding="SAME", dtype=None):
         window_strides=strides,
         padding=padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32,
+        # Uniform in/out dtype (see dense): MXU accumulation is f32 either
+        # way; mixed-dtype conv transposes fail under autodiff.
+        preferred_element_type=None if dtype is not None else jnp.float32,
     )
     if "bias" in params:
-        y = y + params["bias"]
+        b = params["bias"]
+        y = y + (b.astype(dtype) if dtype is not None else b)
     return y
 
 
@@ -167,8 +177,13 @@ def lstm_cell(params, carry, x, *, forget_bias=1.0, dtype=None):
     k = params["kernel"]
     if dtype is not None:
         x, h, k = x.astype(dtype), h.astype(dtype), k.astype(dtype)
-    z = jnp.matmul(jnp.concatenate([x, h], axis=-1), k, preferred_element_type=jnp.float32)
-    z = z + params["bias"]
+        z = jnp.matmul(jnp.concatenate([x, h], axis=-1), k)
+        z = (z + params["bias"].astype(dtype)).astype(jnp.float32)
+    else:
+        z = jnp.matmul(
+            jnp.concatenate([x, h], axis=-1), k, preferred_element_type=jnp.float32
+        )
+        z = z + params["bias"]
     i, g, f, o = jnp.split(z, 4, axis=-1)
     new_c = jax.nn.sigmoid(f + forget_bias) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
     new_h = jax.nn.sigmoid(o) * jnp.tanh(new_c)

@@ -1,0 +1,125 @@
+"""W5: PTB word-level LSTM language model
+(SURVEY.md section 2a W5, BASELINE.json:11).
+
+Reference shape: legacy ``BasicLSTMCell`` stacks unrolled over truncated-BPTT
+windows, trained multi-worker sync with ``MultiWorkerMirroredStrategy`` (ref
+``rnn_cell_impl.py:825``, ``collective_all_reduce_strategy.py:57``).
+
+TPU-native shape: time recurrence is a ``lax.scan`` (compiler-friendly — one
+compiled loop, no Python unrolling), batch sharded over ``data``; the LSTM
+carry persists across steps through ``model_state`` (the TBPTT convention:
+final state of one window is the initial state of the next), sharded over
+``data`` alongside the batch rows it belongs to.  The embedding and softmax
+tables may shard over ``model`` (the PS-sharded-table analog, as in W4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import layers
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    vocab_size: int = 10000
+    dim: int = 200  # embedding + hidden width ("medium" PTB config scale)
+    num_layers: int = 2
+    keep_prob: float = 1.0  # inverted dropout on non-recurrent connections
+    compute_dtype: str = "bfloat16"
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.compute_dtype)
+
+
+def init(cfg: Config, rng: jax.Array, *, batch_size: int):
+    """Returns (params, model_state); model_state holds the TBPTT carry
+    (c, h per layer), shaped for the GLOBAL batch."""
+    rngs = jax.random.split(rng, cfg.num_layers + 2)
+    params: dict = {"emb": layers.embedding_init(rngs[0], cfg.vocab_size, cfg.dim)}
+    for i in range(cfg.num_layers):
+        params[f"lstm_{i}"] = layers.lstm_cell_init(rngs[1 + i], cfg.dim, cfg.dim)
+    params["softmax"] = layers.dense_init(rngs[-1], cfg.dim, cfg.vocab_size)
+    carry = {
+        f"lstm_{i}": {
+            "c": jnp.zeros((batch_size, cfg.dim), jnp.float32),
+            "h": jnp.zeros((batch_size, cfg.dim), jnp.float32),
+        }
+        for i in range(cfg.num_layers)
+    }
+    return params, carry
+
+
+def reset_carry(model_state):
+    """Zero the TBPTT carry (epoch boundary in the PTB convention)."""
+    return jax.tree.map(jnp.zeros_like, model_state)
+
+
+def apply(cfg: Config, params, carry, x, *, rng=None):
+    """x: [B, T] int32 -> (logits [B, T, V], new_carry).
+
+    The time loop is one ``lax.scan`` over all layers jointly (inputs flow
+    through the stack each timestep) — matching the reference's
+    ``MultiRNNCell`` step order exactly.
+    """
+    emb = layers.embedding_lookup(params["emb"], x, dtype=cfg.dtype)  # [B,T,D]
+    if cfg.keep_prob < 1.0 and rng is not None:
+        mask = jax.random.bernoulli(rng, cfg.keep_prob, emb.shape)
+        emb = jnp.where(mask, emb / cfg.keep_prob, 0).astype(emb.dtype)
+    xs = jnp.swapaxes(emb, 0, 1)  # time-major [T,B,D] for scan
+
+    layer_carries = tuple(
+        (carry[f"lstm_{i}"]["c"], carry[f"lstm_{i}"]["h"])
+        for i in range(cfg.num_layers)
+    )
+
+    def step(carries, x_t):
+        new_carries = []
+        h = x_t
+        for i in range(cfg.num_layers):
+            c_i, h_i = lstm_carry = carries[i]
+            lstm_carry, h = layers.lstm_cell(
+                params[f"lstm_{i}"], (c_i, h_i), h, dtype=cfg.dtype
+            )
+            new_carries.append(lstm_carry)
+        return tuple(new_carries), h
+
+    final_carries, hs = jax.lax.scan(step, layer_carries, xs)  # hs: [T,B,D]
+    hs = jnp.swapaxes(hs, 0, 1)  # [B,T,D]
+    logits = layers.dense(params["softmax"], hs, dtype=cfg.dtype)
+    new_carry = {
+        f"lstm_{i}": {
+            # stop_gradient: TBPTT truncates backprop at the window boundary.
+            "c": jax.lax.stop_gradient(final_carries[i][0].astype(jnp.float32)),
+            "h": jax.lax.stop_gradient(final_carries[i][1].astype(jnp.float32)),
+        }
+        for i in range(cfg.num_layers)
+    }
+    return logits, new_carry
+
+
+def loss_fn(cfg: Config):
+    def f(params, model_state, batch, rng):
+        logits, new_carry = apply(cfg, params, model_state, batch["x"], rng=rng)
+        v = logits.reshape(-1, cfg.vocab_size)
+        labels = batch["y"].reshape(-1)
+        loss = layers.softmax_cross_entropy(v, labels)
+        return loss, (new_carry, {"loss": loss, "perplexity": jnp.exp(loss)})
+
+    return f
+
+
+#: Batch-owned carry shards with the batch over ``data``; the big [V, D] /
+#: [D, V] tables may shard over ``model`` (clamped to replicated when the
+#: mesh has no model axis).
+SHARDING_RULES: tuple = (
+    (r"lstm_\d+/(c|h)$", P("data", None)),
+    (r"emb/table", P("model", None)),
+    (r"softmax/kernel", P(None, "model")),
+    (r"softmax/bias", P("model")),
+)

@@ -1,0 +1,153 @@
+"""W3: ResNet-50 — the reference's MirroredStrategy/NCCL workload
+(SURVEY.md section 2a W3, BASELINE.json:9; ref model:
+``keras.applications.ResNet50``, keras/src/applications/resnet.py:391).
+
+ResNet-50 v1.5 (stride-2 in the 3x3 of each downsampling bottleneck — the
+variant every modern benchmark reports), built TPU-first:
+
+- NHWC activations x HWIO kernels: the layout XLA tiles best onto the MXU.
+- bf16 conv compute with f32 accumulation (``preferred_element_type``).
+- BatchNorm over the *global* batch (sharded batch => XLA inserts the
+  cross-replica reduction; SyncBN semantics — see layers.batchnorm).
+- Mutable BN running stats thread through ``model_state``, mirroring the
+  params tree — the framework's analog of TF's update-ops collection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from . import layers
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    num_classes: int = 1000
+    stage_sizes: tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50
+    width: int = 64
+    compute_dtype: str = "bfloat16"
+    bn_momentum: float = 0.9
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.compute_dtype)
+
+
+def _bottleneck_init(rng, cin: int, mid: int, *, downsample: bool):
+    """One bottleneck: 1x1 reduce -> 3x3 -> 1x1 expand (+ projection)."""
+    cout = 4 * mid
+    ks = jax.random.split(rng, 4)
+    p, s = {}, {}
+    p["conv1"] = layers.conv_init(ks[0], 1, 1, cin, mid, use_bias=False)
+    p["bn1"], s["bn1"] = layers.batchnorm_init(mid)
+    p["conv2"] = layers.conv_init(ks[1], 3, 3, mid, mid, use_bias=False)
+    p["bn2"], s["bn2"] = layers.batchnorm_init(mid)
+    p["conv3"] = layers.conv_init(ks[2], 1, 1, mid, cout, use_bias=False)
+    p["bn3"], s["bn3"] = layers.batchnorm_init(cout)
+    if downsample or cin != cout:
+        p["proj"] = layers.conv_init(ks[3], 1, 1, cin, cout, use_bias=False)
+        p["bn_proj"], s["bn_proj"] = layers.batchnorm_init(cout)
+    return p, s
+
+
+def _bottleneck_apply(cfg, p, s, x, *, stride: int, train: bool):
+    new_s = {}
+    shortcut = x
+    y = layers.conv2d(p["conv1"], x, stride=1, dtype=cfg.dtype)
+    y, new_s["bn1"] = layers.batchnorm(
+        p["bn1"], s["bn1"], y, train=train, momentum=cfg.bn_momentum
+    )
+    y = jax.nn.relu(y)
+    # v1.5: the stride lives on the 3x3, not the 1x1.
+    y = layers.conv2d(p["conv2"], y, stride=stride, dtype=cfg.dtype)
+    y, new_s["bn2"] = layers.batchnorm(
+        p["bn2"], s["bn2"], y, train=train, momentum=cfg.bn_momentum
+    )
+    y = jax.nn.relu(y)
+    y = layers.conv2d(p["conv3"], y, stride=1, dtype=cfg.dtype)
+    y, new_s["bn3"] = layers.batchnorm(
+        p["bn3"], s["bn3"], y, train=train, momentum=cfg.bn_momentum
+    )
+    if "proj" in p:
+        shortcut = layers.conv2d(p["proj"], x, stride=stride, dtype=cfg.dtype)
+        shortcut, new_s["bn_proj"] = layers.batchnorm(
+            p["bn_proj"], s["bn_proj"], shortcut, train=train, momentum=cfg.bn_momentum
+        )
+    return jax.nn.relu(y + shortcut), new_s
+
+
+def init(cfg: Config, rng: jax.Array, *, in_channels: int = 3):
+    rngs = jax.random.split(rng, 2 + sum(cfg.stage_sizes))
+    params: dict = {}
+    state: dict = {}
+    params["stem"] = layers.conv_init(rngs[0], 7, 7, in_channels, cfg.width, use_bias=False)
+    params["bn_stem"], state["bn_stem"] = layers.batchnorm_init(cfg.width)
+    cin = cfg.width
+    k = 1
+    for stage, n_blocks in enumerate(cfg.stage_sizes):
+        mid = cfg.width * (2 ** stage)
+        for block in range(n_blocks):
+            down = stage > 0 and block == 0
+            p, s = _bottleneck_init(rngs[k], cin, mid, downsample=down or cin != 4 * mid)
+            params[f"stage{stage}/block{block}"] = p
+            state[f"stage{stage}/block{block}"] = s
+            cin = 4 * mid
+            k += 1
+    params["head"] = layers.dense_init(rngs[-1], cin, cfg.num_classes)
+    return params, state
+
+
+def apply(cfg: Config, params, model_state, x, *, train: bool):
+    """x: [B, H, W, 3] -> (logits [B, num_classes], new_model_state)."""
+    new_state: dict = {}
+    y = layers.conv2d(params["stem"], x, stride=2, dtype=cfg.dtype)
+    y, new_state["bn_stem"] = layers.batchnorm(
+        params["bn_stem"], model_state["bn_stem"], y, train=train, momentum=cfg.bn_momentum
+    )
+    y = jax.nn.relu(y)
+    y = jnp.pad(y, ((0, 0), (1, 1), (1, 1), (0, 0)), constant_values=-jnp.inf)
+    y = jax.lax.reduce_window(
+        y, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "VALID"
+    )
+    for stage, n_blocks in enumerate(cfg.stage_sizes):
+        for block in range(n_blocks):
+            key = f"stage{stage}/block{block}"
+            stride = 2 if (stage > 0 and block == 0) else 1
+            y, new_state[key] = _bottleneck_apply(
+                cfg, params[key], model_state[key], y, stride=stride, train=train
+            )
+    y = jnp.mean(y.astype(jnp.float32), axis=(1, 2))  # global average pool
+    return layers.dense(params["head"], y, dtype=cfg.dtype), new_state
+
+
+def loss_fn(cfg: Config, *, l2: float = 1e-4):
+    """Softmax CE + L2 weight decay on conv/dense kernels (the tutorial-
+    standard ResNet objective)."""
+
+    def f(params, model_state, batch, rng):
+        logits, new_state = apply(cfg, params, model_state, batch["image"], train=True)
+        ce = layers.softmax_cross_entropy(logits, batch["label"])
+        reg = 0.0
+        if l2:
+            sq = [
+                jnp.sum(jnp.square(p["kernel"].astype(jnp.float32)))
+                for p in jax.tree.leaves(
+                    params, is_leaf=lambda n: isinstance(n, dict) and "kernel" in n
+                )
+                if isinstance(p, dict) and "kernel" in p
+            ]
+            reg = l2 * sum(sq)
+        loss = ce + reg
+        acc = layers.accuracy(logits, batch["label"])
+        return loss, (new_state, {"loss": loss, "ce": ce, "accuracy": acc})
+
+    return f
+
+
+#: Data-parallel: all variables mirrored (MirroredStrategy analog).  On large
+#: meshes the optimizer state could be sharded ZeRO-style over 'data'; kept
+#: mirrored for reference parity.
+SHARDING_RULES: tuple = ()

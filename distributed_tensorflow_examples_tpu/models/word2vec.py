@@ -1,0 +1,131 @@
+"""W4: word2vec skip-gram with a mesh-sharded embedding table
+(SURVEY.md section 2a W4, BASELINE.json:10).
+
+Reference shape: the embedding table is a ``PartitionedVariable`` split across
+parameter-server tasks (``fixed_size_partitioner``), so every forward pass
+gathers rows over the network from the PS shards (call stack: SURVEY.md
+section 3.5); the loss is NCE / sampled softmax
+(ref ``TF/python/ops/nn_impl.py:2016,2220``).
+
+TPU-native shape: both big [vocab, dim] tables are sharded over the ``model``
+mesh axis (rule table below) and live distributed in HBM; the row gather and
+its backward scatter-add compile to in-graph collectives over ICI — the
+cross-network PS hop disappears into the step.  Negative sampling runs inside
+jit with the same log-uniform (Zipfian) distribution TF's candidate sampler
+uses, so loss numerics are comparable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import layers
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    vocab_size: int = 10000
+    dim: int = 128
+    num_sampled: int = 64
+    loss: str = "nce"  # "nce" | "sampled_softmax"
+    compute_dtype: str = "float32"  # tables are small; f32 keeps parity tight
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.compute_dtype)
+
+
+def init(cfg: Config, rng: jax.Array):
+    r1, r2 = jax.random.split(rng)
+    return {
+        "emb": layers.embedding_init(r1, cfg.vocab_size, cfg.dim),
+        "nce": {
+            # TF word2vec convention: output weights init truncated-normal
+            # with std 1/sqrt(dim), bias zero.
+            "weights": (1.0 / jnp.sqrt(cfg.dim))
+            * jax.random.truncated_normal(r2, -2.0, 2.0, (cfg.vocab_size, cfg.dim)),
+            "bias": jnp.zeros((cfg.vocab_size,), jnp.float32),
+        },
+    }
+
+
+def log_uniform_sample(rng, num_sampled: int, vocab_size: int):
+    """TF's LogUniformCandidateSampler distribution (ids assumed ordered by
+    descending frequency): P(k) = (log(k+2) - log(k+1)) / log(V+1).
+    Inverse-CDF sampling keeps it jit-friendly (no host callback)."""
+    u = jax.random.uniform(rng, (num_sampled,))
+    ids = jnp.exp(u * jnp.log(vocab_size + 1.0)) - 1.0
+    return jnp.clip(ids.astype(jnp.int32), 0, vocab_size - 1)
+
+
+def _log_expected_count(ids, vocab_size: int):
+    """log(expected sampling probability) for the subtract-log-q correction
+    (ref nn_impl.py `subtract_log_q=True` default)."""
+    k = ids.astype(jnp.float32)
+    p = (jnp.log(k + 2.0) - jnp.log(k + 1.0)) / jnp.log(vocab_size + 1.0)
+    return jnp.log(p)
+
+
+def _logits(cfg, params, emb, true_ids, sampled_ids):
+    """(true_logits [B], sampled_logits [B, S]) with subtract-log-q."""
+    w, b = params["nce"]["weights"], params["nce"]["bias"]
+    w_true = jnp.take(w, true_ids, axis=0)  # [B, D] — sharded-table gather
+    w_samp = jnp.take(w, sampled_ids, axis=0)  # [S, D]
+    true_logits = jnp.sum(emb * w_true, axis=-1) + jnp.take(b, true_ids)
+    sampled_logits = emb @ w_samp.T + jnp.take(b, sampled_ids)[None, :]
+    true_logits = true_logits - _log_expected_count(true_ids, cfg.vocab_size)
+    sampled_logits = sampled_logits - _log_expected_count(sampled_ids, cfg.vocab_size)[None, :]
+    return true_logits, sampled_logits
+
+
+def nce_loss(cfg: Config, params, emb, true_ids, rng):
+    """NCE (ref nn_impl.py:2016): binary logistic regression, real pair vs
+    ``num_sampled`` log-uniform negatives shared across the batch."""
+    sampled = log_uniform_sample(rng, cfg.num_sampled, cfg.vocab_size)
+    t, s = _logits(cfg, params, emb, true_ids, sampled)
+    # sigmoid CE: true label 1 on t, 0 on every s.
+    loss_true = jax.nn.softplus(-t)  # -log sigmoid(t)
+    loss_samp = jnp.sum(jax.nn.softplus(s), axis=-1)  # -sum log(1-sigmoid(s))
+    return jnp.mean(loss_true + loss_samp)
+
+
+def sampled_softmax_loss(cfg: Config, params, emb, true_ids, rng):
+    """Sampled softmax (ref nn_impl.py:2220): softmax CE over
+    {true} U {sampled} classes."""
+    sampled = log_uniform_sample(rng, cfg.num_sampled, cfg.vocab_size)
+    t, s = _logits(cfg, params, emb, true_ids, sampled)
+    logits = jnp.concatenate([t[:, None], s], axis=-1)  # [B, 1+S]; gold = 0
+    return jnp.mean(jax.nn.logsumexp(logits, axis=-1) - logits[:, 0])
+
+
+def loss_fn(cfg: Config):
+    def f(params, model_state, batch, rng):
+        emb = layers.embedding_lookup(params["emb"], batch["center"], dtype=cfg.dtype)
+        fn = nce_loss if cfg.loss == "nce" else sampled_softmax_loss
+        loss = fn(cfg, params, emb, batch["context"], rng)
+        return loss, (model_state, {"loss": loss})
+
+    return f
+
+
+def similarity(cfg: Config, params, query_ids):
+    """Cosine similarity of query words against the whole vocab (the eval
+    the reference genre prints nearest neighbours with)."""
+    table = params["emb"]["table"]
+    norm = table / (jnp.linalg.norm(table, axis=-1, keepdims=True) + 1e-8)
+    q = jnp.take(norm, query_ids, axis=0)
+    return q @ norm.T
+
+
+#: The fixed_size_partitioner -> mesh mapping (SURVEY.md section 2b D4): both
+#: [vocab, dim] tables shard their vocab dim over the ``model`` axis; bias
+#: follows.  On a mesh without a model axis these clamp to replicated.
+SHARDING_RULES: tuple = (
+    (r"emb/table", P("model", None)),
+    (r"nce/weights", P("model", None)),
+    (r"nce/bias", P("model")),
+)

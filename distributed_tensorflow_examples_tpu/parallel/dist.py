@@ -132,9 +132,14 @@ def initialize(
 
 
 def _on_multihost_tpu() -> bool:
-    """True when Cloud-TPU env vars indicate a multi-host pod slice whose
-    topology ``jax.distributed.initialize()`` can self-discover."""
-    return bool(os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
+    """True when Cloud-TPU env vars indicate a MULTI-host pod slice whose
+    topology ``jax.distributed.initialize()`` can self-discover.  A single
+    hostname (e.g. ``TPU_WORKER_HOSTNAMES=localhost`` on one-host setups) is
+    not a cluster."""
+    if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+        return True
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hostnames.split(",") if h.strip()]) > 1
 
 
 def process_index() -> int:

@@ -14,5 +14,6 @@ TPU-native (SURVEY.md section 1 L3, section 2c T1-T4).
 from .state import TrainState, create_state, create_sharded_state  # noqa: F401
 from .step import build_eval_step, build_train_step  # noqa: F401
 from .loop import TrainSession  # noqa: F401
+from .runner import Experiment  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import hooks  # noqa: F401

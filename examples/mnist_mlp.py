@@ -24,7 +24,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from absl import app, flags
 
-from distributed_tensorflow_examples_tpu import data, models, parallel, train, utils
+from distributed_tensorflow_examples_tpu import data, models, train
 from distributed_tensorflow_examples_tpu.utils.flags import (
     define_legacy_cluster_flags,
     define_training_flags,
@@ -41,8 +41,6 @@ FLAGS = flags.FLAGS
 def main(argv):
     del argv
     logging.basicConfig(level=logging.INFO, format="%(message)s")
-    import jax
-    import jax.numpy as jnp
     import optax
 
     info = resolve_legacy_cluster(FLAGS)
@@ -52,93 +50,21 @@ def main(argv):
         print("job_name=ps: parameter servers are not needed on TPU; exiting 0.")
         return
 
-    mesh = parallel.build_mesh(parallel.MeshSpec.parse(FLAGS.mesh))
-    logging.info("mesh: %s over %d devices", dict(mesh.shape), mesh.size)
-
     ds = data.datasets.mnist(FLAGS.data_dir, seed=FLAGS.seed)
     logging.info("mnist source: %s", ds.source)
 
     cfg = models.mlp.Config(hidden=tuple(int(h) for h in FLAGS.hidden_units))
-    opt = optax.sgd(FLAGS.learning_rate)
-    state, shardings = train.create_sharded_state(
-        lambda rng: models.mlp.init(cfg, rng),
-        opt,
-        jax.random.key(FLAGS.seed),
-        mesh=mesh,
+    exp = train.Experiment(
+        init_fn=lambda rng: models.mlp.init(cfg, rng),
+        loss_fn=models.mlp.loss_fn(cfg),
+        optimizer=optax.sgd(FLAGS.learning_rate),
         rules=models.mlp.SHARDING_RULES,
+        flags=FLAGS,
     )
-    step_fn = train.build_train_step(
-        models.mlp.loss_fn(cfg),
-        opt,
-        mesh=mesh,
-        state_shardings=shardings,
-        unroll=FLAGS.unroll,
-    )
-
-    writer = utils.MetricsWriter(FLAGS.log_dir)
-    hooks = [
-        train.hooks.StopAtStepHook(FLAGS.train_steps),
-        train.hooks.StepCounterHook(
-            every_steps=FLAGS.log_every_steps, batch_size=FLAGS.batch_size
-        ),
-        train.hooks.LoggingHook(every_steps=FLAGS.log_every_steps),
-        train.hooks.SummaryHook(writer, every_steps=FLAGS.log_every_steps),
-    ]
-    ckpt = None
-    if FLAGS.log_dir:
-        ckpt = train.checkpoint.CheckpointManager(
-            os.path.join(FLAGS.log_dir, "ckpt"), save_interval_steps=1
-        )
-        hooks.append(
-            train.hooks.CheckpointHook(ckpt, every_steps=FLAGS.checkpoint_every_steps)
-        )
-    if FLAGS.profile and FLAGS.log_dir:
-        hooks.append(train.hooks.ProfilerHook(FLAGS.log_dir))
-
-    pipe = data.InMemoryPipeline(
-        ds.train, batch_size=FLAGS.batch_size, seed=FLAGS.seed
-    )
-    it = iter(pipe)
-    spec = None
-    if FLAGS.unroll > 1:
-        from jax.sharding import PartitionSpec as P
-
-        it = data.pipeline.stack_for_unroll(it, FLAGS.unroll)
-        spec = P(None, "data")
-    batches = data.prefetch_to_mesh(it, mesh, spec=spec)
-
-    session = train.TrainSession(
-        step_fn,
-        state,
-        hooks=hooks,
-        checkpoint_manager=ckpt,
-        steps_per_call=FLAGS.unroll,
-    )
-    final_state = session.run(batches)
-
-    # Final eval on the held-out split (accuracy target: BASELINE.md).
-    eval_fn = train.build_eval_step(
-        lambda params, mstate, batch: models.mlp.loss_fn(cfg)(
-            params, mstate, batch, jax.random.key(0)
-        )[1][1],
-        mesh=mesh,
-        state_shardings=shardings,
-    )
-    # Eval batch: no bigger than the test split, divisible by the data axis.
-    dp = mesh.shape["data"]
-    ebs = min(FLAGS.batch_size, len(ds.test["label"]) // dp * dp)
-    accs = []
-    for i in range(0, (len(ds.test["label"]) // ebs) * ebs, ebs):
-        eb = {k: v[i : i + ebs] for k, v in ds.test.items()}
-        m = eval_fn(final_state, data.pipeline.as_global(eb, mesh))
-        accs.append(float(m["accuracy"]))
-    test_acc = sum(accs) / max(1, len(accs))
-    print(
-        f"FINAL step={int(final_state.step)} "
-        f"steps_per_sec={session.records.get('steps_per_sec', 0):.1f} "
-        f"test_accuracy={test_acc:.4f}"
-    )
-    writer.close()
+    pipe = data.InMemoryPipeline(ds.train, batch_size=FLAGS.batch_size, seed=FLAGS.seed)
+    exp.run(iter(pipe))
+    metrics = exp.evaluate(ds.test)
+    exp.finish(test_accuracy=metrics.get("accuracy", 0.0))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,119 @@
+"""W5: PTB LSTM language model — the reference's MultiWorkerMirroredStrategy
+workload.
+
+Reference config (SURVEY.md section 2a W5, BASELINE.json:11): word-level LSTM,
+one process per worker identified by TF_CONFIG, gradients all-reduced by
+collective ops over gRPC each step (call stack: SURVEY.md section 3.4).
+
+TPU-native shape: the multi-worker ring is the mesh ``data`` axis (multi-host:
+``jax.distributed`` bootstrap via ``parallel.dist``, which still reads
+TF_CONFIG for launcher compatibility); the collective all-reduce is emitted by
+XLA.  Truncated-BPTT carry persists across steps in ``model_state`` and is
+sharded with the batch rows it belongs to.
+
+Run: python examples/ptb_lstm.py --batch_size=64 --seq_len=20 --train_steps=2000
+"""
+
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from absl import app, flags
+
+from distributed_tensorflow_examples_tpu import data, models, train
+from distributed_tensorflow_examples_tpu.utils.flags import (
+    define_legacy_cluster_flags,
+    define_training_flags,
+    resolve_legacy_cluster,
+)
+
+define_training_flags(default_batch_size=64, default_steps=2000)
+define_legacy_cluster_flags()
+flags.DEFINE_integer("vocab_size", 10000, "Vocabulary size.")
+flags.DEFINE_integer("hidden_dim", 200, "Embedding + LSTM hidden width.")
+flags.DEFINE_integer("num_layers", 2, "LSTM stack depth.")
+flags.DEFINE_integer("seq_len", 20, "Truncated-BPTT window length.")
+flags.DEFINE_float("clip_norm", 5.0, "Global-norm gradient clip (PTB recipe).")
+
+FLAGS = flags.FLAGS
+
+
+def main(argv):
+    del argv
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    import jax
+    import optax
+
+    info = resolve_legacy_cluster(FLAGS)
+    if info["is_legacy_ps_process"]:
+        print("job_name=ps: parameter servers are not needed on TPU; exiting 0.")
+        return
+
+    train_ids, valid_ids, vocab, source = data.datasets.ptb(
+        FLAGS.data_dir, vocab_size=FLAGS.vocab_size, seed=FLAGS.seed
+    )
+    logging.info(
+        "ptb source: %s (%d train / %d valid tokens)", source, len(train_ids), len(valid_ids)
+    )
+
+    cfg = models.lstm.Config(
+        vocab_size=FLAGS.vocab_size, dim=FLAGS.hidden_dim, num_layers=FLAGS.num_layers
+    )
+    exp = train.Experiment(
+        init_fn=lambda rng: models.lstm.init(cfg, rng, batch_size=FLAGS.batch_size),
+        loss_fn=models.lstm.loss_fn(cfg),
+        optimizer=optax.chain(
+            optax.clip_by_global_norm(FLAGS.clip_norm),
+            optax.sgd(FLAGS.learning_rate),
+        ),
+        rules=models.lstm.SHARDING_RULES,
+        flags=FLAGS,
+    )
+    # Contiguous per-row streams; each host owns a disjoint row block (the
+    # batch dim is the shard dim, so the global batch is rows 0..B-1 in order).
+    n_hosts = jax.process_count()
+    if FLAGS.batch_size % n_hosts:
+        raise ValueError(
+            f"--batch_size={FLAGS.batch_size} not divisible by {n_hosts} "
+            "hosts; the TBPTT carry is shaped for the global batch"
+        )
+    local_rows = FLAGS.batch_size // n_hosts
+    row_block = len(train_ids) // n_hosts
+    local_ids = train_ids[
+        jax.process_index() * row_block : (jax.process_index() + 1) * row_block
+    ]
+    it = data.datasets.lm_batches(
+        local_ids, batch_size=local_rows, seq_len=FLAGS.seq_len
+    )
+    exp.run(it)
+
+    # Validation perplexity over the held-out stream (fresh zero carry, local
+    # eval batch rows — carry shape must match the eval batch).
+    import jax.numpy as jnp
+
+    eval_rows = min(FLAGS.batch_size, max(1, len(valid_ids) // (FLAGS.seq_len + 1)))
+    _, zero_carry = models.lstm.init(cfg, jax.random.key(0), batch_size=eval_rows)
+    vit = data.datasets.lm_batches(
+        valid_ids, batch_size=eval_rows, seq_len=FLAGS.seq_len
+    )
+    n_eval = max(1, (len(valid_ids) // eval_rows - 1) // FLAGS.seq_len)
+    total, count = 0.0, 0
+    carry = zero_carry
+    loss_f = models.lstm.loss_fn(cfg)
+    eval_step = jax.jit(
+        lambda params, carry, b: loss_f(params, carry, b, jax.random.key(0))
+    )
+    for _ in range(min(n_eval, 50)):
+        b = next(vit)
+        b = {k: jnp.asarray(v) for k, v in b.items()}
+        loss, (carry, m) = eval_step(exp.state.params, carry, b)
+        total += float(loss)
+        count += 1
+    valid_ppl = float(jnp.exp(total / count))
+    exp.finish(valid_perplexity=valid_ppl)
+
+
+if __name__ == "__main__":
+    app.run(main)

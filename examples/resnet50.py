@@ -1,0 +1,90 @@
+"""W3: ResNet-50 ImageNet — the reference's MirroredStrategy/NCCL workload.
+
+Reference config (SURVEY.md section 2a W3, BASELINE.json:9): single-node
+multi-GPU data parallel, NCCL all-reduce of ~25M params per step (call stack:
+SURVEY.md section 3.3).
+
+TPU-native shape: the same sync data parallelism is the mesh's ``data`` axis;
+the NCCL ring becomes the XLA-emitted ICI all-reduce implicit in the
+global-batch loss.  SGD + momentum, stepwise-decay schedule, L2 weight decay
+(the tutorial-standard recipe).  Without --data_dir an ImageNet-shaped
+synthetic stream is used (standard for infeed/throughput benchmarking).
+
+Run: python examples/resnet50.py --batch_size=256 --train_steps=500 \
+         --image_size=224
+"""
+
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from absl import app, flags
+
+from distributed_tensorflow_examples_tpu import data, models, train
+from distributed_tensorflow_examples_tpu.utils.flags import (
+    define_legacy_cluster_flags,
+    define_training_flags,
+    resolve_legacy_cluster,
+)
+
+define_training_flags(default_batch_size=256, default_steps=1000)
+define_legacy_cluster_flags()
+flags.DEFINE_integer("image_size", 224, "Input image resolution.")
+flags.DEFINE_integer("num_classes", 1000, "Label classes.")
+flags.DEFINE_float("momentum", 0.9, "SGD momentum.")
+flags.DEFINE_integer("synthetic_examples", 2048, "Synthetic train-set size.")
+
+FLAGS = flags.FLAGS
+
+
+def main(argv):
+    del argv
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    import optax
+
+    info = resolve_legacy_cluster(FLAGS)
+    if info["is_legacy_ps_process"]:
+        print("job_name=ps: parameter servers are not needed on TPU; exiting 0.")
+        return
+
+    ds = data.datasets.imagenet_synthetic(
+        image_size=FLAGS.image_size,
+        n_train=FLAGS.synthetic_examples,
+        seed=FLAGS.seed,
+    )
+    logging.info("imagenet source: %s (%d classes)", ds.source, FLAGS.num_classes)
+
+    cfg = models.resnet.Config(num_classes=FLAGS.num_classes)
+    # Stepwise decay at 60/80% of the run (the 30/60/80-epoch recipe scaled
+    # to the requested step budget).
+    schedule = optax.piecewise_constant_schedule(
+        FLAGS.learning_rate,
+        {int(FLAGS.train_steps * 0.6): 0.1, int(FLAGS.train_steps * 0.8): 0.1},
+    )
+    exp = train.Experiment(
+        init_fn=lambda rng: models.resnet.init(cfg, rng),
+        loss_fn=models.resnet.loss_fn(cfg),
+        optimizer=optax.sgd(schedule, momentum=FLAGS.momentum),
+        rules=models.resnet.SHARDING_RULES,
+        flags=FLAGS,
+    )
+    pipe = data.InMemoryPipeline(ds.train, batch_size=FLAGS.batch_size, seed=FLAGS.seed)
+    exp.run(iter(pipe))
+
+    def eval_fn(params, mstate, batch):
+        import jax.numpy as jnp
+
+        logits, _ = models.resnet.apply(cfg, params, mstate, batch["image"], train=False)
+        return {
+            "accuracy": models.layers.accuracy(logits, batch["label"]),
+            "loss": models.layers.softmax_cross_entropy(logits, batch["label"]),
+        }
+
+    metrics = exp.evaluate(ds.test, eval_fn=eval_fn)
+    exp.finish(test_accuracy=metrics.get("accuracy", 0.0))
+
+
+if __name__ == "__main__":
+    app.run(main)

@@ -1,0 +1,96 @@
+"""W4: word2vec skip-gram — the reference's PS-sharded-embedding workload.
+
+Reference config (SURVEY.md section 2a W4, BASELINE.json:10): the embedding
+table is partitioned across PS tasks (``fixed_size_partitioner``), every
+forward pass gathers rows over gRPC from the owning PS (call stack: SURVEY.md
+section 3.5); NCE loss over log-uniform negatives.
+
+TPU-native shape: the table shards over the mesh ``model`` axis and lives
+distributed in HBM; the gather + backward scatter compile to ICI collectives
+inside the step.  ``--mesh "data=4,model=2"`` exercises the sharded path;
+default mesh puts everything on ``data`` (table replicated).
+
+Run: python examples/word2vec.py --batch_size=512 --train_steps=2000 \
+         --mesh "data=1,model=1"
+"""
+
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from absl import app, flags
+
+from distributed_tensorflow_examples_tpu import data, models, train
+from distributed_tensorflow_examples_tpu.utils.flags import (
+    define_legacy_cluster_flags,
+    define_training_flags,
+    resolve_legacy_cluster,
+)
+
+define_training_flags(default_batch_size=256, default_steps=2000)
+define_legacy_cluster_flags()
+flags.DEFINE_integer("vocab_size", 10000, "Vocabulary size (most-frequent cut).")
+flags.DEFINE_integer("embedding_dim", 128, "Embedding dimension.")
+flags.DEFINE_integer("num_sampled", 64, "Negative samples per batch (NCE).")
+flags.DEFINE_integer("window", 5, "Skip-gram window half-width.")
+flags.DEFINE_enum("nce_loss", "nce", ["nce", "sampled_softmax"], "Loss variant.")
+
+FLAGS = flags.FLAGS
+
+
+def main(argv):
+    del argv
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    import optax
+
+    info = resolve_legacy_cluster(FLAGS)
+    if info["is_legacy_ps_process"]:
+        print("job_name=ps: parameter servers are not needed on TPU; exiting 0.")
+        return
+
+    ids, vocab, source = data.datasets.text_corpus(
+        FLAGS.data_dir, vocab_size=FLAGS.vocab_size, seed=FLAGS.seed
+    )
+    logging.info("corpus source: %s (%d tokens, vocab %d)", source, len(ids), len(vocab))
+
+    cfg = models.word2vec.Config(
+        vocab_size=FLAGS.vocab_size,
+        dim=FLAGS.embedding_dim,
+        num_sampled=FLAGS.num_sampled,
+        loss=FLAGS.nce_loss,
+    )
+    exp = train.Experiment(
+        init_fn=lambda rng: models.word2vec.init(cfg, rng),
+        loss_fn=models.word2vec.loss_fn(cfg),
+        optimizer=optax.sgd(FLAGS.learning_rate),
+        rules=models.word2vec.SHARDING_RULES,
+        flags=FLAGS,
+    )
+    import jax
+
+    # Generator pipelines yield per-host LOCAL batches (each host draws a
+    # different seed stream — the Dataset.shard analog for sampled data).
+    local_batch = FLAGS.batch_size // jax.process_count()
+    it = data.datasets.skipgram_batches(
+        ids,
+        batch_size=local_batch,
+        window=FLAGS.window,
+        seed=FLAGS.seed + jax.process_index(),
+    )
+    exp.run(it)
+
+    # Final "loss on fresh pairs" figure (the W4 quality proxy without a
+    # real analogy benchmark on synthetic data).
+    eval_pairs = next(
+        data.datasets.skipgram_batches(
+            ids, batch_size=4096, window=FLAGS.window, seed=FLAGS.seed + 999
+        )
+    )
+    m = exp.evaluate(eval_pairs, batch_size=1024)
+    exp.finish(eval_loss=m.get("loss", 0.0))
+
+
+if __name__ == "__main__":
+    app.run(main)

@@ -1,0 +1,242 @@
+"""Model-zoo unit tests: shapes, trainability, and (for the sharded-table
+workloads) mesh-placement invariance — the numerics-parity strategy of
+SURVEY.md section 4d."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from distributed_tensorflow_examples_tpu import data, models, train
+from distributed_tensorflow_examples_tpu.data.pipeline import as_global
+from distributed_tensorflow_examples_tpu.parallel import local_mesh_for_testing
+
+
+def _train_some(cfg_mod, cfg, init_fn, batches, mesh, rules=(), lr=0.05, opt=None):
+    opt = opt or optax.sgd(lr)
+    state, shardings = train.create_sharded_state(
+        init_fn, opt, jax.random.key(0), mesh=mesh, rules=rules
+    )
+    step = train.build_train_step(
+        cfg_mod.loss_fn(cfg), opt, mesh=mesh, state_shardings=shardings
+    )
+    first = None
+    for b in batches:
+        state, m = step(state, b)
+        if first is None:
+            first = float(m["loss"])
+    return state, first, float(m["loss"])
+
+
+# ----------------------------------------------------------------------------
+# W2 CNN
+# ----------------------------------------------------------------------------
+
+
+def test_cnn_shapes_and_loss_falls(mesh8):
+    cfg = models.cnn.Config(channels=(16, 16), dense=(64, 32), compute_dtype="float32")
+    ds = data.datasets.cifar10(None, seed=0)
+    pipe = data.InMemoryPipeline(ds.train, batch_size=64, seed=0)
+    it = iter(pipe)
+    batches = [as_global(next(it), mesh8) for _ in range(25)]
+    _, first, last = _train_some(
+        models.cnn, cfg, lambda r: models.cnn.init(cfg, r), batches, mesh8
+    )
+    assert last < first * 0.8, (first, last)
+
+
+# ----------------------------------------------------------------------------
+# W3 ResNet-50
+# ----------------------------------------------------------------------------
+
+
+def test_resnet_param_count_matches_reference():
+    """ResNet-50 @1000 classes must land on the canonical ~25.56M params
+    (ref keras.applications.ResNet50, SURVEY.md W3)."""
+    cfg = models.resnet.Config()
+    p, _ = models.resnet.init(cfg, jax.random.key(0))
+    n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(p))
+    assert 25.5e6 < n < 25.7e6, n
+
+
+def test_resnet_trains_and_bn_state_updates(mesh8):
+    cfg = models.resnet.Config(
+        num_classes=10, stage_sizes=(1, 1), width=8, compute_dtype="float32"
+    )
+    rng = np.random.default_rng(0)
+    mkbatch = lambda: as_global(
+        {
+            "image": rng.normal(size=(16, 32, 32, 3)).astype(np.float32),
+            "label": rng.integers(0, 10, size=(16,)).astype(np.int32),
+        },
+        mesh8,
+    )
+    opt = optax.sgd(0.1)
+    state, shardings = train.create_sharded_state(
+        lambda r: models.resnet.init(cfg, r), opt, jax.random.key(0), mesh=mesh8
+    )
+    step = train.build_train_step(
+        models.resnet.loss_fn(cfg, l2=0.0), opt, mesh=mesh8, state_shardings=shardings
+    )
+    before = np.asarray(state.model_state["bn_stem"]["mean"]).copy()
+    for _ in range(3):
+        state, m = step(state, mkbatch())
+    after = np.asarray(state.model_state["bn_stem"]["mean"])
+    assert not np.allclose(before, after)  # running stats moved
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_resnet_eval_mode_deterministic():
+    cfg = models.resnet.Config(num_classes=10, stage_sizes=(1,), width=8)
+    p, s = models.resnet.init(cfg, jax.random.key(0))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 32, 32, 3)), jnp.float32)
+    l1, s1 = models.resnet.apply(cfg, p, s, x, train=False)
+    l2, s2 = models.resnet.apply(cfg, p, s, x, train=False)
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+    for a, b in zip(jax.tree.leaves(s), jax.tree.leaves(s1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # no stat drift
+
+
+# ----------------------------------------------------------------------------
+# W4 word2vec — sharded-table parity (the D4/3.5 crux)
+# ----------------------------------------------------------------------------
+
+
+W2V_CFG = models.word2vec.Config(vocab_size=512, dim=32, num_sampled=16)
+
+
+def _w2v_batches(n, batch=64):
+    ids, _, _ = data.datasets.text_corpus(None, vocab_size=512, synth_tokens=20_000)
+    it = data.datasets.skipgram_batches(ids, batch_size=batch, seed=0)
+    return [next(it) for _ in range(n)]
+
+
+def test_word2vec_loss_falls(mesh8):
+    raw = _w2v_batches(40)
+    batches = [as_global(b, mesh8) for b in raw]
+    _, first, last = _train_some(
+        models.word2vec,
+        W2V_CFG,
+        lambda r: models.word2vec.init(W2V_CFG, r),
+        batches,
+        mesh8,
+        rules=models.word2vec.SHARDING_RULES,
+        lr=0.5,
+    )
+    assert last < first, (first, last)
+
+
+def test_word2vec_sharded_vs_replicated_parity():
+    """Sharding the table over the model axis must not change numerics:
+    mesh(data=8) with replicated table == mesh(data=4,model=2) with the
+    vocab dim sharded.  This is the invariant the reference could NOT offer
+    (PS-sharded lookup crossed the network; SURVEY.md section 3.5) and the
+    core test of the fixed_size_partitioner -> PartitionSpec mapping."""
+    mesh_rep = local_mesh_for_testing({"data": 8})
+    mesh_tp = local_mesh_for_testing({"data": 4, "model": 2})
+    raw = _w2v_batches(8)
+    init = lambda r: models.word2vec.init(W2V_CFG, r)
+    sA, fA, lA = _train_some(
+        models.word2vec, W2V_CFG, init, [as_global(b, mesh_rep) for b in raw],
+        mesh_rep, rules=(), lr=0.5,
+    )
+    sB, fB, lB = _train_some(
+        models.word2vec, W2V_CFG, init, [as_global(b, mesh_tp) for b in raw],
+        mesh_tp, rules=models.word2vec.SHARDING_RULES, lr=0.5,
+    )
+    np.testing.assert_allclose(fA, fB, rtol=1e-5)
+    np.testing.assert_allclose(lA, lB, rtol=1e-5)
+    tA = np.asarray(sA.params["emb"]["table"])
+    tB = np.asarray(jax.device_get(sB.params["emb"]["table"]))
+    np.testing.assert_allclose(tA, tB, rtol=1e-4, atol=1e-6)
+
+
+def test_log_uniform_sampler_distribution():
+    """Sampler must follow P(k) ∝ log((k+2)/(k+1)) (TF candidate-sampler
+    distribution) — checked coarsely on a big draw."""
+    V = 100
+    draws = np.asarray(
+        models.word2vec.log_uniform_sample(jax.random.key(0), 20000, V)
+    )
+    assert draws.min() >= 0 and draws.max() < V
+    # id 0 should be ~log(2)/log(101) ≈ 15% of draws; rare ids ~0.2%.
+    f0 = (draws == 0).mean()
+    assert 0.10 < f0 < 0.20, f0
+    f50 = (draws == 50).mean()
+    assert f50 < 0.02
+
+
+# ----------------------------------------------------------------------------
+# W5 LSTM
+# ----------------------------------------------------------------------------
+
+
+LSTM_CFG = models.lstm.Config(vocab_size=256, dim=32, num_layers=2, compute_dtype="float32")
+
+
+def _lm_batches(n, batch=8, seq=10):
+    ids = data.datasets._synthetic_token_stream(20_000, 256, 0)
+    it = data.datasets.lm_batches(ids, batch_size=batch, seq_len=seq)
+    return [next(it) for _ in range(n)]
+
+
+def test_lstm_carry_persists_and_loss_falls(mesh8):
+    raw = _lm_batches(30)
+    batches = [as_global(b, mesh8) for b in raw]
+    opt = optax.sgd(0.5)
+    state, shardings = train.create_sharded_state(
+        lambda r: models.lstm.init(LSTM_CFG, r, batch_size=8),
+        opt,
+        jax.random.key(0),
+        mesh=mesh8,
+        rules=models.lstm.SHARDING_RULES,
+    )
+    step = train.build_train_step(
+        models.lstm.loss_fn(LSTM_CFG), opt, mesh=mesh8, state_shardings=shardings
+    )
+    zero = np.asarray(jax.device_get(state.model_state["lstm_0"]["h"]))
+    assert np.all(zero == 0)
+    first = None
+    for b in batches:
+        state, m = step(state, b)
+        if first is None:
+            first = float(m["loss"])
+    h = np.asarray(jax.device_get(state.model_state["lstm_0"]["h"]))
+    assert np.any(h != 0)  # TBPTT carry flowed across steps
+    assert float(m["loss"]) < first, (first, float(m["loss"]))
+
+
+def test_lstm_carry_independent_of_data_sharding():
+    """Batch rows own their carry: splitting rows over the data axis must
+    reproduce the single-device trajectory exactly (f32)."""
+    mesh1 = local_mesh_for_testing({"data": 1})
+    mesh8 = local_mesh_for_testing({"data": 8})
+    raw = _lm_batches(5)
+    losses = {}
+    for name, mesh in (("m1", mesh1), ("m8", mesh8)):
+        opt = optax.sgd(0.5)
+        state, shardings = train.create_sharded_state(
+            lambda r: models.lstm.init(LSTM_CFG, r, batch_size=8),
+            opt,
+            jax.random.key(0),
+            mesh=mesh,
+            rules=models.lstm.SHARDING_RULES,
+        )
+        step = train.build_train_step(
+            models.lstm.loss_fn(LSTM_CFG), opt, mesh=mesh, state_shardings=shardings
+        )
+        ls = []
+        for b in raw:
+            state, m = step(state, as_global(b, mesh))
+            ls.append(float(m["loss"]))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["m1"], losses["m8"], rtol=2e-5)
+
+
+def test_lstm_reset_carry():
+    _, carry = models.lstm.init(LSTM_CFG, jax.random.key(0), batch_size=4)
+    carry = jax.tree.map(lambda x: x + 1.0, carry)
+    reset = models.lstm.reset_carry(carry)
+    for leaf in jax.tree.leaves(reset):
+        assert np.all(np.asarray(leaf) == 0)
