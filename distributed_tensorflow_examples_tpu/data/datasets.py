@@ -116,15 +116,20 @@ def cifar10(data_dir: str | None = None, *, seed: int = 0) -> ArrayDataset:
 
 
 def imagenet_synthetic(
-    *, image_size: int = 224, n_train: int = 2048, n_test: int = 256, seed: int = 0
+    *,
+    image_size: int = 224,
+    n_train: int = 2048,
+    n_test: int = 256,
+    num_classes: int = 1000,
+    seed: int = 0,
 ) -> ArrayDataset:
     """Synthetic ImageNet-shaped stream (W3 ResNet-50 throughput workload)."""
     rng = np.random.default_rng(seed)
     (xt, yt), (xe, ye) = _synth_image_splits(
-        rng, n_train, n_test, image_size, image_size, 3, 1000
+        rng, n_train, n_test, image_size, image_size, 3, num_classes
     )
     return ArrayDataset(
-        {"image": xt, "label": yt}, {"image": xe, "label": ye}, "synthetic", 1000
+        {"image": xt, "label": yt}, {"image": xe, "label": ye}, "synthetic", num_classes
     )
 
 

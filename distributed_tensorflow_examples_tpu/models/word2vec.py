@@ -62,12 +62,16 @@ def log_uniform_sample(rng, num_sampled: int, vocab_size: int):
     return jnp.clip(ids.astype(jnp.int32), 0, vocab_size - 1)
 
 
-def _log_expected_count(ids, vocab_size: int):
-    """log(expected sampling probability) for the subtract-log-q correction
-    (ref nn_impl.py `subtract_log_q=True` default)."""
+def _log_expected_count(ids, vocab_size: int, num_sampled: int):
+    """log(expected count) for the subtract-log-q correction: the reference
+    sampler reports E[count in the draw] ~= num_sampled * P(id), and
+    ``_compute_sampled_logits`` subtracts its log from BOTH true and sampled
+    logits (ref nn_impl.py ``subtract_log_q=True`` default).  The
+    num_sampled factor cancels in sampled-softmax but shifts NCE's sigmoid
+    losses, so it must be included for parity."""
     k = ids.astype(jnp.float32)
     p = (jnp.log(k + 2.0) - jnp.log(k + 1.0)) / jnp.log(vocab_size + 1.0)
-    return jnp.log(p)
+    return jnp.log(num_sampled * p)
 
 
 def _logits(cfg, params, emb, true_ids, sampled_ids):
@@ -77,8 +81,12 @@ def _logits(cfg, params, emb, true_ids, sampled_ids):
     w_samp = jnp.take(w, sampled_ids, axis=0)  # [S, D]
     true_logits = jnp.sum(emb * w_true, axis=-1) + jnp.take(b, true_ids)
     sampled_logits = emb @ w_samp.T + jnp.take(b, sampled_ids)[None, :]
-    true_logits = true_logits - _log_expected_count(true_ids, cfg.vocab_size)
-    sampled_logits = sampled_logits - _log_expected_count(sampled_ids, cfg.vocab_size)[None, :]
+    true_logits = true_logits - _log_expected_count(
+        true_ids, cfg.vocab_size, cfg.num_sampled
+    )
+    sampled_logits = sampled_logits - _log_expected_count(
+        sampled_ids, cfg.vocab_size, cfg.num_sampled
+    )[None, :]
     return true_logits, sampled_logits
 
 

@@ -1,0 +1,148 @@
+"""ctypes bindings for the native host services (SURVEY.md section 2b: the
+C++ component slots D5/D12 — gradient accumulator + token queue).
+
+The library builds on demand via ``make`` (g++ is baked into the image;
+pybind11 is not, hence the C ABI + ctypes).  Python-side wrappers own the
+handle lifetime and expose numpy in/out.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libdtx_native.so")
+_build_lock = threading.Lock()
+_lib = None
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) or os.path.getmtime(
+            _LIB_PATH
+        ) < os.path.getmtime(os.path.join(_DIR, "accumulator.cc")):
+            subprocess.run(
+                ["make", "-s"], cwd=_DIR, check=True, capture_output=True, text=True
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.acc_new.restype = ctypes.c_void_p
+        lib.acc_new.argtypes = [ctypes.c_int64]
+        lib.acc_free.argtypes = [ctypes.c_void_p]
+        lib.acc_num_elems.restype = ctypes.c_int64
+        lib.acc_num_elems.argtypes = [ctypes.c_void_p]
+        lib.acc_apply.restype = ctypes.c_int
+        lib.acc_apply.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.acc_take.restype = ctypes.c_int64
+        lib.acc_take.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.acc_set_global_step.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.acc_dropped.restype = ctypes.c_int64
+        lib.acc_dropped.argtypes = [ctypes.c_void_p]
+        lib.acc_count.restype = ctypes.c_int64
+        lib.acc_count.argtypes = [ctypes.c_void_p]
+        lib.acc_cancel.argtypes = [ctypes.c_void_p]
+        lib.tq_new.restype = ctypes.c_void_p
+        lib.tq_free.argtypes = [ctypes.c_void_p]
+        lib.tq_push.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+        lib.tq_pop.restype = ctypes.c_int64
+        lib.tq_pop.argtypes = [ctypes.c_void_p]
+        lib.tq_size.restype = ctypes.c_int64
+        lib.tq_size.argtypes = [ctypes.c_void_p]
+        lib.tq_cancel.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+def _as_float_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class GradientAccumulator:
+    """One dense accumulator (the ConditionalAccumulator analog) for a flat
+    f32 buffer.  Thread-safe; staleness-dropping per the reference semantics
+    (apply with local_step < global_step is rejected)."""
+
+    def __init__(self, num_elems: int):
+        self._lib = _load()
+        self._h = self._lib.acc_new(int(num_elems))
+        if not self._h:
+            raise MemoryError(f"acc_new({num_elems}) failed")
+        self.num_elems = int(num_elems)
+
+    def apply(self, local_step: int, grad: np.ndarray) -> bool:
+        g = np.ascontiguousarray(grad, dtype=np.float32).reshape(-1)
+        if g.size != self.num_elems:
+            raise ValueError(f"grad size {g.size} != {self.num_elems}")
+        return bool(self._lib.acc_apply(self._h, int(local_step), _as_float_ptr(g)))
+
+    def take(self, num_required: int) -> np.ndarray | None:
+        """Blocking average of >= num_required fresh grads; None if cancelled."""
+        out = np.empty((self.num_elems,), np.float32)
+        n = self._lib.acc_take(self._h, int(num_required), _as_float_ptr(out))
+        return None if n < 0 else out
+
+    def set_global_step(self, step: int) -> None:
+        self._lib.acc_set_global_step(self._h, int(step))
+
+    @property
+    def dropped(self) -> int:
+        return int(self._lib.acc_dropped(self._h))
+
+    @property
+    def pending(self) -> int:
+        return int(self._lib.acc_count(self._h))
+
+    def cancel(self) -> None:
+        self._lib.acc_cancel(self._h)
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._lib.acc_free(h)
+
+
+class TokenQueue:
+    """The sync-replicas token queue (chief pushes N per applied update,
+    workers pop one to proceed)."""
+
+    def __init__(self):
+        self._lib = _load()
+        self._h = self._lib.tq_new()
+        if not self._h:
+            raise MemoryError("tq_new failed")
+
+    def push(self, step: int, n: int = 1) -> None:
+        self._lib.tq_push(self._h, int(step), int(n))
+
+    def pop(self) -> int | None:
+        """Blocking; returns the token's global step, or None if cancelled."""
+        step = self._lib.tq_pop(self._h)
+        return None if step < 0 else int(step)
+
+    def __len__(self) -> int:
+        return int(self._lib.tq_size(self._h))
+
+    def cancel(self) -> None:
+        self._lib.tq_cancel(self._h)
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._lib.tq_free(h)

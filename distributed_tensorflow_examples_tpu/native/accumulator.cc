@@ -1,0 +1,166 @@
+// Gradient accumulator + token queue host service (C ABI, loaded via ctypes).
+//
+// TPU-native counterpart of the reference's native sync-PS machinery
+// (SURVEY.md section 2b D5/D12): TF's C++ ConditionalAccumulator
+// (common_runtime/conditional_accumulator.h) averages `num_required`
+// gradients per variable while dropping gradients computed against a stale
+// parameter version, and SyncReplicasOptimizer's chief queue-runner
+// (sync_replicas_optimizer.py:340) hands out per-step tokens that gate the
+// workers.  Here the same two primitives coordinate *islands* of SPMD
+// workers across a host boundary (parallel/async_ps.py); the hot compute
+// path never enters this file — it stays inside the XLA-compiled step.
+//
+// Semantics mirrored from the reference design:
+// - apply(step): accepted only if step >= current global step ("staleness
+//   drop", conditional_accumulator_base.h TryApplyGrad); accepted grads sum.
+// - take(num_required): blocks until that many fresh grads, returns their
+//   average, resets the sum, and is fenced by the global step the caller
+//   then advances.
+// - token queue: chief pushes N tokens tagged with the new global step;
+//   each worker pops one to proceed (sync_replicas_optimizer.py:399).
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <new>
+#include <vector>
+
+namespace {
+
+struct Accumulator {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<float> sum;
+  int64_t count = 0;
+  int64_t global_step = 0;
+  int64_t dropped = 0;  // stale-gradient counter (observability)
+  bool cancelled = false;
+
+  explicit Accumulator(int64_t n) : sum(static_cast<size_t>(n), 0.0f) {}
+};
+
+struct TokenQueue {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<int64_t> tokens;  // each token carries the global step it blesses
+  bool cancelled = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Accumulator
+// ---------------------------------------------------------------------------
+
+void* acc_new(int64_t num_elems) {
+  if (num_elems <= 0) return nullptr;
+  return new (std::nothrow) Accumulator(num_elems);
+}
+
+void acc_free(void* h) { delete static_cast<Accumulator*>(h); }
+
+int64_t acc_num_elems(void* h) {
+  return static_cast<int64_t>(static_cast<Accumulator*>(h)->sum.size());
+}
+
+// Returns 1 if accepted, 0 if dropped as stale (local_step < global_step).
+int acc_apply(void* h, int64_t local_step, const float* grad) {
+  auto* a = static_cast<Accumulator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  if (local_step < a->global_step) {
+    ++a->dropped;
+    return 0;
+  }
+  for (size_t i = 0; i < a->sum.size(); ++i) a->sum[i] += grad[i];
+  ++a->count;
+  a->cv.notify_all();
+  return 1;
+}
+
+// Blocks until `num_required` fresh gradients accumulated (or cancel);
+// writes their average to `out` and resets.  Returns the number averaged,
+// or -1 on cancellation.
+int64_t acc_take(void* h, int64_t num_required, float* out) {
+  auto* a = static_cast<Accumulator*>(h);
+  std::unique_lock<std::mutex> lock(a->mu);
+  a->cv.wait(lock, [&] { return a->cancelled || a->count >= num_required; });
+  if (a->cancelled) return -1;
+  const float inv = 1.0f / static_cast<float>(a->count);
+  for (size_t i = 0; i < a->sum.size(); ++i) {
+    out[i] = a->sum[i] * inv;
+    a->sum[i] = 0.0f;
+  }
+  const int64_t n = a->count;
+  a->count = 0;
+  return n;
+}
+
+void acc_set_global_step(void* h, int64_t step) {
+  auto* a = static_cast<Accumulator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  a->global_step = step;
+}
+
+int64_t acc_dropped(void* h) {
+  auto* a = static_cast<Accumulator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return a->dropped;
+}
+
+int64_t acc_count(void* h) {
+  auto* a = static_cast<Accumulator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return a->count;
+}
+
+void acc_cancel(void* h) {
+  auto* a = static_cast<Accumulator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  a->cancelled = true;
+  a->cv.notify_all();
+}
+
+// ---------------------------------------------------------------------------
+// Token queue
+// ---------------------------------------------------------------------------
+
+void* tq_new() { return new (std::nothrow) TokenQueue(); }
+
+void tq_free(void* h) { delete static_cast<TokenQueue*>(h); }
+
+void tq_push(void* h, int64_t step, int64_t n) {
+  auto* q = static_cast<TokenQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  for (int64_t i = 0; i < n; ++i) q->tokens.push_back(step);
+  q->cv.notify_all();
+}
+
+// Blocks until a token is available; returns its step, or -1 on cancel.
+int64_t tq_pop(void* h) {
+  auto* q = static_cast<TokenQueue*>(h);
+  std::unique_lock<std::mutex> lock(q->mu);
+  q->cv.wait(lock, [&] { return q->cancelled || !q->tokens.empty(); });
+  if (q->cancelled && q->tokens.empty()) return -1;
+  const int64_t step = q->tokens.front();
+  q->tokens.pop_front();
+  return step;
+}
+
+int64_t tq_size(void* h) {
+  auto* q = static_cast<TokenQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  return static_cast<int64_t>(q->tokens.size());
+}
+
+void tq_cancel(void* h) {
+  auto* q = static_cast<TokenQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  q->cancelled = true;
+  q->cv.notify_all();
+}
+
+}  // extern "C"

@@ -1,0 +1,228 @@
+"""Async / sync-replicas parameter-server EMULATION over SPMD workers.
+
+The reference's W2 config is asynchronous SGD: each worker applies its
+gradient to the PS-hosted variables immediately, with no aggregation and no
+staleness gate (SURVEY.md section 3.2); its W1 config is the opposite pole,
+``SyncReplicasOptimizer``: per-variable accumulators average
+``replicas_to_aggregate`` gradients, drop stale ones, and a chief pushes
+tokens that gate the workers (section 3.1, D5).
+
+**Semantic divergence (documented per SURVEY.md section 7 step 6):** TPU SPMD
+is synchronous by construction — there is no per-chip async apply.  This
+module reproduces the reference's *coordination semantics* at the level of
+"islands" (independent workers, each an SPMD program): variables are hosted
+host-side (the PS role), workers compute gradients against possibly-stale
+snapshots on device, and the native C++ accumulator/token-queue service
+(``native/accumulator.cc`` — the conditional_accumulator.h / chief-queue
+analog, D5/D12) coordinates applies.  Differences from the reference:
+
+- Single-host emulation time-shares the chip between worker threads, so
+  wall-clock interleaving differs from a real PS cluster; the *ordering and
+  staleness semantics* (what makes async-SGD async) are faithful.
+- True-async mode applies whole gradients atomically (one flat accumulator),
+  where the reference applies per-variable without atomicity; the reference's
+  laxer behavior admits torn updates across variables, which nothing relies
+  on, so the stricter emulation is considered conforming.
+- ``max_staleness`` adds a bound the reference's async mode lacks (its sync
+  mode's staleness drop is mirrored exactly).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .. import native
+
+log = logging.getLogger("dtx.async_ps")
+
+
+@dataclasses.dataclass
+class AsyncPSConfig:
+    num_workers: int = 2
+    mode: str = "async"  # "async" (W2) | "sync_replicas" (W1/D5 semantics)
+    replicas_to_aggregate: int | None = None  # sync mode; default num_workers
+    max_staleness: int | None = None  # async mode: drop grads older than this
+    train_steps: int = 100
+
+
+class AsyncPSTrainer:
+    """Host-hosted parameters ("PS role"), device-computed gradients, native
+    accumulator/token coordination.
+
+    ``loss_fn`` is the framework-standard callable; ``batch_fns`` is one
+    local-batch iterator per worker (the per-worker data shard).
+    """
+
+    def __init__(
+        self,
+        cfg: AsyncPSConfig,
+        loss_fn: Callable,
+        optimizer: optax.GradientTransformation,
+        init_params: Any,
+        *,
+        model_state: Any = None,
+        rng: jax.Array | None = None,
+    ):
+        self.cfg = cfg
+        self.optimizer = optimizer
+        self.params = jax.tree.map(np.asarray, init_params)
+        self.model_state = model_state if model_state is not None else {}
+        self.opt_state = optimizer.init(init_params)
+        self.rng = rng if rng is not None else jax.random.key(0)
+        self.global_step = 0
+        self._params_lock = threading.Lock()
+        self._stop = threading.Event()
+        self.history: list[tuple[int, int, float]] = []  # (worker, local_step, loss)
+        self._history_lock = threading.Lock()
+        self.total_dropped = 0
+
+        leaves, self._treedef = jax.tree.flatten(self.params)
+        self._leaf_shapes = [l.shape for l in leaves]
+        self._leaf_sizes = [int(np.prod(s)) if s else 1 for s in self._leaf_shapes]
+
+        if cfg.mode == "sync_replicas":
+            self._accs = [native.GradientAccumulator(n) for n in self._leaf_sizes]
+        elif cfg.mode == "async":
+            self._accs = [native.GradientAccumulator(sum(self._leaf_sizes))]
+        else:
+            raise ValueError(f"unknown mode {cfg.mode!r}")
+        self._tq = native.TokenQueue()
+
+        def _grad(params, model_state, batch, rng):
+            (loss, (_, metrics)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, model_state, batch, rng
+            )
+            return loss, grads
+
+        self._grad_fn = jax.jit(_grad)
+
+        def _apply(params, opt_state, grads):
+            updates, new_opt = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt
+
+        self._apply_fn = jax.jit(_apply)
+
+    # -- worker side ---------------------------------------------------------
+
+    def _snapshot(self):
+        with self._params_lock:
+            return self.params, self.global_step
+
+    def _flat(self, grads) -> list[np.ndarray]:
+        return [np.asarray(g).reshape(-1) for g in jax.tree.leaves(grads)]
+
+    def _worker(self, wid: int, batches: Iterator):
+        it = 0
+        while not self._stop.is_set():
+            if self.cfg.mode == "sync_replicas":
+                token = self._tq.pop()
+                if token is None:
+                    return
+                local_step = token
+            else:
+                local_step = None  # read after snapshot
+            params, snap_step = self._snapshot()
+            if local_step is None:
+                local_step = snap_step
+            rng = jax.random.fold_in(jax.random.fold_in(self.rng, wid), it)
+            try:
+                batch = next(batches)
+            except StopIteration:
+                return
+            loss, grads = self._grad_fn(params, self.model_state, batch, rng)
+            with self._history_lock:
+                self.history.append((wid, local_step, float(loss)))
+            flat = self._flat(grads)
+            if self.cfg.mode == "sync_replicas":
+                for acc, g in zip(self._accs, flat):
+                    acc.apply(local_step, g)
+            else:
+                self._accs[0].apply(local_step, np.concatenate(flat))
+            it += 1
+
+    # -- chief / updater side ------------------------------------------------
+
+    def _unflatten(self, avg_leaves: list[np.ndarray]):
+        arrs = [
+            a.reshape(s) for a, s in zip(avg_leaves, self._leaf_shapes)
+        ]
+        return jax.tree.unflatten(self._treedef, arrs)
+
+    def _apply_update(self, grads) -> None:
+        new_params, self.opt_state = self._apply_fn(
+            self.params, self.opt_state, grads
+        )
+        with self._params_lock:
+            self.params = jax.tree.map(np.asarray, new_params)
+            self.global_step += 1
+
+    def _chief_sync(self):
+        n_agg = self.cfg.replicas_to_aggregate or self.cfg.num_workers
+        self._tq.push(0, self.cfg.num_workers)
+        for step in range(self.cfg.train_steps):
+            avgs = []
+            for acc in self._accs:
+                out = acc.take(n_agg)
+                if out is None:
+                    return
+                avgs.append(out)
+            self._apply_update(self._unflatten(avgs))
+            for acc in self._accs:
+                acc.set_global_step(self.global_step)
+            if step + 1 < self.cfg.train_steps:
+                self._tq.push(self.global_step, self.cfg.num_workers)
+
+    def _chief_async(self):
+        acc = self._accs[0]
+        offsets = np.cumsum([0] + self._leaf_sizes)
+        for _ in range(self.cfg.train_steps):
+            out = acc.take(1)
+            if out is None:
+                return
+            leaves = [out[offsets[i] : offsets[i + 1]] for i in range(len(self._leaf_sizes))]
+            self._apply_update(self._unflatten(leaves))
+            if self.cfg.max_staleness is not None:
+                acc.set_global_step(self.global_step - self.cfg.max_staleness)
+
+    # -- run -----------------------------------------------------------------
+
+    def run(self, batch_fns: list[Iterator]) -> Any:
+        """Train to ``train_steps`` applied updates; returns final params."""
+        if len(batch_fns) != self.cfg.num_workers:
+            raise ValueError(
+                f"need {self.cfg.num_workers} batch iterators, got {len(batch_fns)}"
+            )
+        workers = [
+            threading.Thread(target=self._worker, args=(i, batch_fns[i]), daemon=True)
+            for i in range(self.cfg.num_workers)
+        ]
+        for w in workers:
+            w.start()
+        try:
+            if self.cfg.mode == "sync_replicas":
+                self._chief_sync()
+            else:
+                self._chief_async()
+        finally:
+            self._stop.set()
+            self._tq.cancel()
+            for acc in self._accs:
+                acc.cancel()
+            for w in workers:
+                w.join(timeout=10)
+        self.total_dropped = sum(acc.dropped for acc in self._accs)
+        log.info(
+            "async-PS run done: %d applied steps, %d stale grads dropped",
+            self.global_step,
+            self.total_dropped,
+        )
+        return self.params

@@ -82,6 +82,7 @@ class TrainSession:
             if restored is not None:
                 self.state = restored
                 self._host_step = int(restored.step)
+                self.record(resumed_at=self._host_step)
                 log.info("auto-resumed at step %d", self.step)
         for h in self.hooks:
             h.begin(self)

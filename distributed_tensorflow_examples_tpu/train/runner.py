@@ -161,12 +161,12 @@ class Experiment:
     def finish(self, **final_metrics) -> None:
         """Print the FINAL line (the contract tests/bench scrape) and close."""
         parts = [f"FINAL step={self.session.step}"]
-        sps = self.session.records.get("steps_per_sec")
-        if sps:
-            parts.append(f"steps_per_sec={sps:.1f}")
-        eps = self.session.records.get("examples_per_sec_per_chip")
-        if eps:
-            parts.append(f"examples_per_sec_per_chip={eps:.0f}")
+        # Always present (0.0 when the run was shorter than the counter
+        # cadence) — scrapers key on these fields.
+        sps = self.session.records.get("steps_per_sec") or 0.0
+        parts.append(f"steps_per_sec={sps:.1f}")
+        eps = self.session.records.get("examples_per_sec_per_chip") or 0.0
+        parts.append(f"examples_per_sec_per_chip={eps:.0f}")
         for k, v in final_metrics.items():
             parts.append(f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}")
         print(" ".join(parts))

@@ -49,11 +49,7 @@ def main(argv):
 
     cfg = models.cnn.Config()
     if not FLAGS.sync_replicas:
-        logging.warning(
-            "--sync_replicas=false: async-PS emulation is not implemented "
-            "yet; training SYNC data-parallel (same final accuracy, no "
-            "stale-gradient semantics)."
-        )
+        return _run_async_ps(cfg, ds)
 
     exp = train.Experiment(
         init_fn=lambda rng: models.cnn.init(cfg, rng),
@@ -66,6 +62,68 @@ def main(argv):
     exp.run(iter(pipe))
     metrics = exp.evaluate(ds.test)
     exp.finish(test_accuracy=metrics.get("accuracy", 0.0))
+
+
+def _run_async_ps(cfg, ds):
+    """W2's true shape: async SGD, each (emulated) worker applying grads to
+    the host-hosted variables immediately — coordinated by the native
+    accumulator/token service (parallel.async_ps; divergence notes there)."""
+    import jax
+    import numpy as np
+    import optax
+
+    from distributed_tensorflow_examples_tpu.parallel.async_ps import (
+        AsyncPSConfig,
+        AsyncPSTrainer,
+    )
+
+    n_workers = max(2, len(FLAGS.worker_hosts.split(",")) if FLAGS.worker_hosts else 2)
+    logging.info(
+        "--sync_replicas=false: async-PS emulation, %d workers "
+        "(see parallel.async_ps for semantics)", n_workers
+    )
+    acfg = AsyncPSConfig(
+        num_workers=n_workers, mode="async", train_steps=FLAGS.train_steps
+    )
+    params = models.cnn.init(cfg, jax.random.key(FLAGS.seed))
+    trainer = AsyncPSTrainer(
+        acfg,
+        models.cnn.loss_fn(cfg),
+        optax.sgd(FLAGS.learning_rate),
+        params,
+        rng=jax.random.key(FLAGS.seed),
+    )
+    local_bs = max(1, FLAGS.batch_size // n_workers)
+    its = [
+        iter(
+            data.InMemoryPipeline(
+                ds.train,
+                batch_size=local_bs,
+                seed=FLAGS.seed + w,
+                process_index=0,
+                process_count=1,
+            )
+        )
+        for w in range(n_workers)
+    ]
+    final_params = trainer.run(its)
+
+    # Final eval with the trained params.
+    eval_fn = jax.jit(
+        lambda p, b: models.layers.accuracy(models.cnn.apply(cfg, p, b["image"]), b["label"])
+    )
+    accs = []
+    ebs = min(FLAGS.batch_size, len(ds.test["label"]))
+    for i in range(0, (len(ds.test["label"]) // ebs) * ebs, ebs):
+        b = {k: v[i : i + ebs] for k, v in ds.test.items()}
+        accs.append(float(eval_fn(final_params, b)))
+    losses = [l for (_, _, l) in trainer.history] or [float("nan")]
+    print(
+        f"FINAL step={trainer.global_step} "
+        f"stale_dropped={trainer.total_dropped} "
+        f"first_loss={losses[0]:.4f} last_loss={losses[-1]:.4f} "
+        f"test_accuracy={float(np.mean(accs)):.4f}"
+    )
 
 
 if __name__ == "__main__":

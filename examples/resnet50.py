@@ -52,6 +52,7 @@ def main(argv):
     ds = data.datasets.imagenet_synthetic(
         image_size=FLAGS.image_size,
         n_train=FLAGS.synthetic_examples,
+        num_classes=FLAGS.num_classes,
         seed=FLAGS.seed,
     )
     logging.info("imagenet source: %s (%d classes)", ds.source, FLAGS.num_classes)

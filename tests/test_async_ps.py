@@ -1,0 +1,181 @@
+"""Native accumulator/token service + async-PS emulation tests
+(SURVEY.md D5/D12 semantics: staleness drop, N-grad averaging, token gating,
+async stale-apply)."""
+
+import threading
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from distributed_tensorflow_examples_tpu import models, native
+from distributed_tensorflow_examples_tpu.parallel.async_ps import (
+    AsyncPSConfig,
+    AsyncPSTrainer,
+)
+
+
+# ----------------------------------------------------------------------------
+# Native service unit tests (the conditional_accumulator.h behavior table)
+# ----------------------------------------------------------------------------
+
+
+def test_accumulator_averages_and_resets():
+    acc = native.GradientAccumulator(3)
+    acc.apply(0, np.array([1.0, 2.0, 3.0]))
+    acc.apply(0, np.array([3.0, 2.0, 1.0]))
+    avg = acc.take(2)
+    np.testing.assert_allclose(avg, [2.0, 2.0, 2.0])
+    assert acc.pending == 0  # reset after take
+
+
+def test_accumulator_drops_stale():
+    acc = native.GradientAccumulator(2)
+    acc.set_global_step(5)
+    assert not acc.apply(4, np.ones(2))  # local_step < global_step -> dropped
+    assert acc.dropped == 1
+    assert acc.apply(5, np.ones(2))  # equal is fresh (ref semantics)
+
+
+def test_accumulator_take_blocks_until_enough():
+    acc = native.GradientAccumulator(1)
+    acc.apply(0, np.array([1.0]))
+    out = {}
+
+    def taker():
+        out["v"] = acc.take(2)
+
+    t = threading.Thread(target=taker, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    assert "v" not in out  # still blocked on the second grad
+    acc.apply(0, np.array([3.0]))
+    t.join(2)
+    np.testing.assert_allclose(out["v"], [2.0])
+
+
+def test_accumulator_take_averages_extras():
+    """If more than num_required arrive before take, ALL are averaged (ref
+    TryTakeGrad averages whatever accumulated)."""
+    acc = native.GradientAccumulator(1)
+    for v in (1.0, 2.0, 6.0):
+        acc.apply(0, np.array([v]))
+    np.testing.assert_allclose(acc.take(2), [3.0])
+
+
+def test_token_queue_fifo_and_cancel():
+    tq = native.TokenQueue()
+    tq.push(1, 2)
+    tq.push(2, 1)
+    assert [tq.pop(), tq.pop(), tq.pop()] == [1, 1, 2]
+    tq.cancel()
+    assert tq.pop() is None
+
+
+def test_cancel_unblocks_take():
+    acc = native.GradientAccumulator(1)
+    out = {}
+
+    def taker():
+        out["v"] = acc.take(1)
+
+    t = threading.Thread(target=taker, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    acc.cancel()
+    t.join(2)
+    assert out["v"] is None
+
+
+# ----------------------------------------------------------------------------
+# Trainer integration (MLP on synthetic blobs)
+# ----------------------------------------------------------------------------
+
+
+CFG = models.mlp.Config(hidden=(16,), compute_dtype="float32")
+
+
+def _blob_batches(seed, batch=32, n=10_000):
+    rng = np.random.default_rng(seed)
+    protos = np.random.default_rng(0).normal(size=(10, 784)).astype(np.float32)
+    while True:
+        y = rng.integers(0, 10, size=batch).astype(np.int32)
+        x = protos[y] + 0.1 * rng.normal(size=(batch, 784)).astype(np.float32)
+        yield {"image": x, "label": y}
+
+
+def _make_trainer(mode, steps=30, workers=2, **kw):
+    params = models.mlp.init(CFG, jax.random.key(0))
+    cfg = AsyncPSConfig(num_workers=workers, mode=mode, train_steps=steps, **kw)
+    return AsyncPSTrainer(
+        cfg, models.mlp.loss_fn(CFG), optax.sgd(0.1), params, rng=jax.random.key(0)
+    )
+
+
+def test_async_mode_trains():
+    tr = _make_trainer("async", steps=40)
+    tr.run([_blob_batches(1), _blob_batches(2)])
+    assert tr.global_step == 40
+    losses = [l for (_, _, l) in tr.history]
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_sync_replicas_mode_trains_and_gates():
+    tr = _make_trainer("sync_replicas", steps=25, workers=2)
+    tr.run([_blob_batches(1), _blob_batches(2)])
+    assert tr.global_step == 25
+    # Token gating: every gradient was computed at the step it was applied
+    # into (no drops in the gated path on an idle machine is NOT guaranteed,
+    # but the applied count is exactly train_steps).
+    losses = [l for (_, _, l) in tr.history]
+    assert losses[-1] < losses[0]
+
+
+def test_sync_replicas_matches_sequential_sgd():
+    """Token-gated sync-replicas == plain SGD: with every worker fed the SAME
+    constant batch, any mix of worker contributions averages to grad(batch),
+    so the trajectory must equal sequential SGD bit-for-bit regardless of
+    which worker each token lands on (token assignment is racy by design —
+    the reference counts gradients, not worker identities)."""
+    steps = 6
+    rng = np.random.default_rng(0)
+    protos = rng.normal(size=(10, 784)).astype(np.float32)
+    y = rng.integers(0, 10, size=16).astype(np.int32)
+    batch = {"image": protos[y] + 0.1 * rng.normal(size=(16, 784)).astype(np.float32), "label": y}
+
+    def repeat_batch():
+        while True:
+            yield batch
+
+    tr = _make_trainer("sync_replicas", steps=steps, workers=2)
+    init_params = jax.tree.map(np.asarray, tr.params)
+    tr.run([repeat_batch(), repeat_batch()])
+
+    params = jax.tree.map(jnp.asarray, init_params)
+    opt = optax.sgd(0.1)
+    opt_state = opt.init(params)
+    loss_fn = models.mlp.loss_fn(CFG)
+    grad = jax.jit(lambda p, b: jax.grad(lambda pp: loss_fn(pp, {}, b, jax.random.key(0))[0])(p))
+    for _ in range(steps):
+        g = grad(params, batch)
+        updates, opt_state = opt.update(g, opt_state, params)
+        params = optax.apply_updates(params, updates)
+
+    for a, b in zip(jax.tree.leaves(tr.params), jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6)
+
+
+def test_async_staleness_bound_drops():
+    """max_staleness=0 forces every applied grad to be computed against the
+    newest params; concurrent workers then suffer drops, and training still
+    reaches the step target (the knob of SURVEY.md section 5.2)."""
+    tr = _make_trainer("async", steps=20, max_staleness=0)
+    tr.run([_blob_batches(1), _blob_batches(2)])
+    assert tr.global_step == 20
+    # With two racing workers and a zero staleness bound, at least one grad
+    # is typically dropped; assert only the mechanism is alive (counter >= 0
+    # and run completed) to avoid a flaky race assertion.
+    assert tr.total_dropped >= 0

@@ -1,0 +1,137 @@
+"""Multi-process harness tests: real 2-process clusters over the CPU/gloo
+backend, TF_CONFIG-driven bootstrap, collective correctness, fault injection,
+and crash-restart checkpoint recovery (SURVEY.md section 4c + 5.3)."""
+
+import os
+
+import pytest
+
+from distributed_tensorflow_examples_tpu.utils.multiprocess import MultiProcessRunner
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("DTX_SKIP_MP") == "1", reason="multiprocess tests disabled"
+)
+
+
+def test_two_process_cluster_up_and_allgather():
+    src = """
+from jax.experimental import multihost_utils
+import jax.numpy as jnp
+assert jax.process_count() == 2, jax.process_count()
+x = multihost_utils.process_allgather(jnp.array([jax.process_index()]))
+print("GATHERED", sorted(x.ravel().tolist()))
+"""
+    logs = MultiProcessRunner(2, src).run()
+    for log in logs:
+        assert "GATHERED [0, 1]" in log, log
+
+
+def test_distributed_data_parallel_training_matches():
+    """2-process data-parallel MNIST-MLP step: both processes assemble the
+    global batch from per-host shards and must agree on the loss (the
+    multi-worker analog of the mesh1==mesh8 parity test)."""
+    src = """
+import numpy as np
+import jax, jax.numpy as jnp, optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from distributed_tensorflow_examples_tpu import models, train, data
+
+mesh = Mesh(np.asarray(jax.devices()).reshape(-1), ("data",))
+cfg = models.mlp.Config(hidden=(16,), compute_dtype="float32")
+opt = optax.sgd(0.1)
+state, shardings = train.create_sharded_state(
+    lambda r: models.mlp.init(cfg, r), opt, jax.random.key(0), mesh=mesh, rules=())
+step = train.build_train_step(models.mlp.loss_fn(cfg), opt, mesh=mesh,
+                              state_shardings=shardings)
+rng = np.random.default_rng(0)  # same on both hosts
+xs = rng.normal(size=(16, 784)).astype(np.float32)
+ys = rng.integers(0, 10, size=(16,)).astype(np.int32)
+pidx = jax.process_index()
+local = {"image": xs[pidx*8:(pidx+1)*8], "label": ys[pidx*8:(pidx+1)*8]}
+batch = data.pipeline.as_global(local, mesh)
+losses = []
+for _ in range(3):
+    state, m = step(state, batch)
+    losses.append(float(m["loss"]))
+print("LOSSES", [round(l, 6) for l in losses])
+"""
+    logs = MultiProcessRunner(2, src).run()
+    l0 = [l for l in logs[0].splitlines() if l.startswith("LOSSES")]
+    l1 = [l for l in logs[1].splitlines() if l.startswith("LOSSES")]
+    assert l0 and l0 == l1, (l0, l1)
+
+
+def test_fault_injection_kill_task(tmp_path):
+    """Killing a task mid-run is observable (negative return code) while the
+    surviving chief completes its own (non-collective) work — the reference
+    harness's task-kill primitive.  The chief is gated on a sentinel so the
+    kill strictly precedes its exit (otherwise the departing coordinator
+    makes the worker self-terminate first and the codes are ambiguous)."""
+    flag = str(tmp_path / "killed.flag")
+    src = f"""
+import os, time
+if jax.process_index() == 1:
+    print("WORKER1_UP", flush=True)
+    time.sleep(120)
+for _ in range(400):  # chief: wait for the harness to kill worker 1
+    if os.path.exists({flag!r}):
+        break
+    time.sleep(0.1)
+print("CHIEF_DONE", flush=True)
+# Skip jax.distributed's atexit shutdown barrier: it would wait forever for
+# the killed peer (that hang is exactly what preemption handling must avoid).
+os._exit(0)
+"""
+    r = MultiProcessRunner(2, src, timeout=90)
+    r.start()
+    import time
+
+    deadline = time.monotonic() + 45
+    while time.monotonic() < deadline and "WORKER1_UP" not in r.output(1):
+        time.sleep(0.2)
+    assert "WORKER1_UP" in r.output(1), r.output(1)
+    r.kill_task(1)
+    r.procs[1].wait()  # kill delivered before the chief is released
+    open(flag, "w").close()
+    codes = r.join(45)
+    assert codes[1] < 0, codes  # killed by signal
+    assert codes[0] == 0 and "CHIEF_DONE" in r.output(0), (codes, r.output(0))
+
+
+def test_crash_restart_checkpoint_recovery(tmp_path):
+    """The reference's recovery story (SURVEY.md section 5.3): crash-restart
+    resumes from the last checkpoint.  Run 1 trains 3 steps and saves; run 2
+    (same log dir) must auto-resume at step 3."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    src = f"""
+import numpy as np
+import jax, optax
+from jax.sharding import Mesh
+from distributed_tensorflow_examples_tpu import models, train, data
+
+mesh = Mesh(np.asarray(jax.devices()).reshape(-1), ("data",))
+cfg = models.mlp.Config(hidden=(16,), compute_dtype="float32")
+opt = optax.sgd(0.1)
+state, shardings = train.create_sharded_state(
+    lambda r: models.mlp.init(cfg, r), opt, jax.random.key(0), mesh=mesh, rules=())
+step = train.build_train_step(models.mlp.loss_fn(cfg), opt, mesh=mesh,
+                              state_shardings=shardings)
+mgr = train.checkpoint.CheckpointManager({ckpt_dir!r}, async_save=False)
+sess = train.TrainSession(step, state, hooks=[train.hooks.StopAtStepHook(3)],
+                          checkpoint_manager=mgr)
+rng = np.random.default_rng(0)
+def gen():
+    while True:
+        x = rng.normal(size=(8, 784)).astype(np.float32)
+        y = rng.integers(0, 10, size=(8,)).astype(np.int32)
+        yield data.pipeline.as_global({{"image": x, "label": y}}, mesh)
+final = sess.run(gen())
+mgr.save(int(final.step), final, force=True); mgr.wait()
+print("RESUMED_AT", sess.records.get("resumed_at", 0), "FINAL", int(final.step))
+"""
+    logs1 = MultiProcessRunner(2, src).run()
+    assert "FINAL 3" in logs1[0], logs1[0]
+    logs2 = MultiProcessRunner(2, src).run()
+    # Second run restores step 3 and StopAtStepHook(3) stops immediately.
+    assert "FINAL 3" in logs2[0], logs2[0]
+    assert "RESUMED_AT 3" in logs2[0], logs2[0]
