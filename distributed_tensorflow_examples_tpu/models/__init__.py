@@ -20,3 +20,4 @@ from . import cnn  # noqa: F401
 from . import resnet  # noqa: F401
 from . import word2vec  # noqa: F401
 from . import lstm  # noqa: F401
+from . import transformer  # noqa: F401

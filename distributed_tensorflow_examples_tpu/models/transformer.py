@@ -1,0 +1,163 @@
+"""Decoder-only transformer LM: the framework's growth-path flagship.
+
+No reference analog (the reference's five workloads predate attention —
+SURVEY.md section 5.7); this model exists to exercise the parallelism axes
+the blueprint requires beyond reference parity:
+
+- ``data``  — batch sharding (as every workload),
+- ``model`` — tensor parallelism: attention heads and MLP hidden dim sharded
+              (Megatron-style column->row pairs, gathers/reduces emitted by
+              XLA from the sharding constraints),
+- ``seq``   — sequence/context parallelism: activations sharded over the
+              sequence dim; attention runs as a ``ppermute`` ring
+              (ops/attention.py) so no device holds the full sequence.
+
+Pre-norm blocks, learned positional embedding, GELU MLP, weight-tied softmax
+optional.  Params stay f32; compute in bf16 on the MXU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import attention as attn_ops
+from . import layers
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    vocab_size: int = 32000
+    dim: int = 512
+    n_layers: int = 6
+    n_heads: int = 8
+    mlp_ratio: int = 4
+    max_seq_len: int = 2048
+    causal: bool = True
+    compute_dtype: str = "bfloat16"
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.compute_dtype)
+
+    @property
+    def head_dim(self):
+        return self.dim // self.n_heads
+
+
+def _layernorm_init(d):
+    return {"scale": jnp.ones((d,), jnp.float32), "bias": jnp.zeros((d,), jnp.float32)}
+
+
+def _layernorm(p, x, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def init(cfg: Config, rng: jax.Array):
+    n = cfg.n_layers
+    rngs = jax.random.split(rng, 4 * n + 3)
+    params: dict = {
+        "emb": layers.embedding_init(rngs[0], cfg.vocab_size, cfg.dim),
+        "pos": {"table": 0.02 * jax.random.normal(rngs[1], (cfg.max_seq_len, cfg.dim))},
+        "ln_f": _layernorm_init(cfg.dim),
+        "head": layers.dense_init(rngs[2], cfg.dim, cfg.vocab_size, use_bias=False),
+    }
+    h = cfg.dim * cfg.mlp_ratio
+    for i in range(n):
+        r = rngs[3 + 4 * i : 3 + 4 * (i + 1)]
+        params[f"block_{i}"] = {
+            "ln1": _layernorm_init(cfg.dim),
+            "qkv": layers.dense_init(r[0], cfg.dim, 3 * cfg.dim, use_bias=False),
+            "proj": layers.dense_init(r[1], cfg.dim, cfg.dim, use_bias=False),
+            "ln2": _layernorm_init(cfg.dim),
+            "mlp_in": layers.dense_init(r[2], cfg.dim, h),
+            "mlp_out": layers.dense_init(r[3], h, cfg.dim),
+        }
+    return params
+
+
+def apply(cfg: Config, params, x, *, mesh: Mesh | None = None):
+    """x: [B, T] int32 -> logits [B, T, V].
+
+    With ``mesh``: activations carry sharding constraints
+    ([B,T,D] -> P('data','seq',None)) so XLA partitions every dense op, and
+    attention routes through the seq-axis ring when the mesh shards ``seq``.
+    """
+    B, T = x.shape
+
+    def constrain(y, spec):
+        if mesh is None:
+            return y
+        return jax.lax.with_sharding_constraint(
+            y, jax.sharding.NamedSharding(mesh, spec)
+        )
+
+    h = layers.embedding_lookup(params["emb"], x, dtype=cfg.dtype)
+    h = h + params["pos"]["table"][:T].astype(cfg.dtype)[None]
+    h = constrain(h, P("data", "seq", None))
+
+    for i in range(cfg.n_layers):
+        p = params[f"block_{i}"]
+        y = _layernorm(p["ln1"], h)
+        qkv = layers.dense(p["qkv"], y, dtype=cfg.dtype)  # [B,T,3D]
+        qkv = qkv.reshape(B, T, 3, cfg.n_heads, cfg.head_dim)
+        q, k, v = [
+            jnp.moveaxis(qkv[:, :, j], 2, 1) for j in range(3)
+        ]  # [B,H,T,hd], heads shardable over 'model'
+        q = constrain(q, P("data", "model", "seq", None))
+        k = constrain(k, P("data", "model", "seq", None))
+        v = constrain(v, P("data", "model", "seq", None))
+        if mesh is not None and mesh.shape.get("seq", 1) > 1:
+            o = attn_ops.sequence_parallel_attention(mesh, q, k, v, causal=cfg.causal)
+        else:
+            o = attn_ops.mha(q, k, v, causal=cfg.causal)
+        o = jnp.moveaxis(o, 1, 2).reshape(B, T, cfg.dim)
+        h = h + layers.dense(p["proj"], o, dtype=cfg.dtype)
+        h = constrain(h, P("data", "seq", None))
+
+        y = _layernorm(p["ln2"], h)
+        y = layers.dense(p["mlp_in"], y, dtype=cfg.dtype)  # column-parallel
+        y = constrain(y, P("data", "seq", "model"))
+        y = jax.nn.gelu(y)
+        h = h + layers.dense(p["mlp_out"], y, dtype=cfg.dtype)  # row-parallel
+        h = constrain(h, P("data", "seq", None))
+
+    h = _layernorm(params["ln_f"], h)
+    return layers.dense(params["head"], h, dtype=cfg.dtype)
+
+
+def loss_fn(cfg: Config, *, mesh: Mesh | None = None):
+    def f(params, model_state, batch, rng):
+        logits = apply(cfg, params, batch["x"], mesh=mesh)
+        loss = layers.softmax_cross_entropy(
+            logits.reshape(-1, cfg.vocab_size), batch["y"].reshape(-1)
+        )
+        return loss, (model_state, {"loss": loss, "perplexity": jnp.exp(loss)})
+
+    return f
+
+
+def batch_spec() -> P:
+    """[B, T] batches shard batch over 'data' AND sequence over 'seq'."""
+    return P("data", "seq")
+
+
+#: Megatron-style TP rule table: qkv/mlp_in column-sharded (output dim),
+#: proj/mlp_out row-sharded (input dim); embedding + head over vocab.
+SHARDING_RULES: tuple = (
+    (r"block_\d+/qkv/kernel", P(None, "model")),
+    (r"block_\d+/proj/kernel", P("model", None)),
+    (r"block_\d+/mlp_in/kernel", P(None, "model")),
+    (r"block_\d+/mlp_in/bias", P("model")),
+    (r"block_\d+/mlp_out/kernel", P("model", None)),
+    (r"emb/table", P("model", None)),
+    (r"pos/table", P(None, None)),
+    (r"head/kernel", P(None, "model")),
+)

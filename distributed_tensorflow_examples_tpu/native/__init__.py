@@ -31,9 +31,14 @@ def _load() -> ctypes.CDLL:
         if not os.path.exists(_LIB_PATH) or os.path.getmtime(
             _LIB_PATH
         ) < os.path.getmtime(os.path.join(_DIR, "accumulator.cc")):
-            subprocess.run(
-                ["make", "-s"], cwd=_DIR, check=True, capture_output=True, text=True
+            proc = subprocess.run(
+                ["make", "-s"], cwd=_DIR, capture_output=True, text=True
             )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"native build failed (exit {proc.returncode}):\n"
+                    f"{proc.stdout}\n{proc.stderr}"
+                )
         lib = ctypes.CDLL(_LIB_PATH)
         lib.acc_new.restype = ctypes.c_void_p
         lib.acc_new.argtypes = [ctypes.c_int64]
@@ -66,6 +71,23 @@ def _load() -> ctypes.CDLL:
         lib.tq_size.restype = ctypes.c_int64
         lib.tq_size.argtypes = [ctypes.c_void_p]
         lib.tq_cancel.argtypes = [ctypes.c_void_p]
+        lib.gq_new.restype = ctypes.c_void_p
+        lib.gq_new.argtypes = [ctypes.c_int64]
+        lib.gq_free.argtypes = [ctypes.c_void_p]
+        lib.gq_push.restype = ctypes.c_int
+        lib.gq_push.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.gq_pop.restype = ctypes.c_int64
+        lib.gq_pop.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_float)]
+        lib.gq_set_min_step.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.gq_dropped.restype = ctypes.c_int64
+        lib.gq_dropped.argtypes = [ctypes.c_void_p]
+        lib.gq_size.restype = ctypes.c_int64
+        lib.gq_size.argtypes = [ctypes.c_void_p]
+        lib.gq_cancel.argtypes = [ctypes.c_void_p]
         _lib = lib
     return _lib
 
@@ -116,6 +138,49 @@ class GradientAccumulator:
         h, self._h = getattr(self, "_h", None), None
         if h:
             self._lib.acc_free(h)
+
+
+class GradientQueue:
+    """FIFO of whole gradients for TRUE-async apply (the worker->PS
+    Send/Recv role): each pushed gradient is popped and applied individually
+    — no coalescing — with an optional staleness gate."""
+
+    def __init__(self, num_elems: int):
+        self._lib = _load()
+        self._h = self._lib.gq_new(int(num_elems))
+        if not self._h:
+            raise MemoryError(f"gq_new({num_elems}) failed")
+        self.num_elems = int(num_elems)
+
+    def push(self, local_step: int, grad: np.ndarray) -> bool:
+        g = np.ascontiguousarray(grad, dtype=np.float32).reshape(-1)
+        if g.size != self.num_elems:
+            raise ValueError(f"grad size {g.size} != {self.num_elems}")
+        return bool(self._lib.gq_push(self._h, int(local_step), _as_float_ptr(g)))
+
+    def pop(self) -> tuple[int, np.ndarray] | None:
+        """Blocking; returns (local_step, grad) or None when cancelled+drained."""
+        out = np.empty((self.num_elems,), np.float32)
+        step = self._lib.gq_pop(self._h, _as_float_ptr(out))
+        return None if step < 0 else (int(step), out)
+
+    def set_min_step(self, step: int) -> None:
+        self._lib.gq_set_min_step(self._h, int(step))
+
+    @property
+    def dropped(self) -> int:
+        return int(self._lib.gq_dropped(self._h))
+
+    def __len__(self) -> int:
+        return int(self._lib.gq_size(self._h))
+
+    def cancel(self) -> None:
+        self._lib.gq_cancel(self._h)
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._lib.gq_free(h)
 
 
 class TokenQueue:

@@ -48,6 +48,22 @@ struct TokenQueue {
   bool cancelled = false;
 };
 
+// FIFO of whole gradients for TRUE-async apply (W2): unlike the summing
+// accumulator, each pushed gradient is popped and applied individually —
+// the Send/Recv rendezvous role of the reference's worker->PS push
+// (rpc_rendezvous_mgr.h), with an optional staleness gate.
+struct GradQueue {
+  std::mutex mu;
+  std::condition_variable cv;
+  size_t n_elems;
+  std::deque<std::pair<int64_t, std::vector<float>>> q;  // (local_step, grad)
+  int64_t min_step = 0;  // staleness gate: pushes below this are dropped
+  int64_t dropped = 0;
+  bool cancelled = false;
+
+  explicit GradQueue(int64_t n) : n_elems(static_cast<size_t>(n)) {}
+};
+
 }  // namespace
 
 extern "C" {
@@ -158,6 +174,69 @@ int64_t tq_size(void* h) {
 
 void tq_cancel(void* h) {
   auto* q = static_cast<TokenQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  q->cancelled = true;
+  q->cv.notify_all();
+}
+
+// ---------------------------------------------------------------------------
+// Gradient queue (true-async path)
+// ---------------------------------------------------------------------------
+
+void* gq_new(int64_t num_elems) {
+  if (num_elems <= 0) return nullptr;
+  return new (std::nothrow) GradQueue(num_elems);
+}
+
+void gq_free(void* h) { delete static_cast<GradQueue*>(h); }
+
+// Returns 1 if enqueued, 0 if dropped as stale (local_step < min_step).
+int gq_push(void* h, int64_t local_step, const float* grad) {
+  auto* q = static_cast<GradQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  if (local_step < q->min_step) {
+    ++q->dropped;
+    return 0;
+  }
+  q->q.emplace_back(local_step, std::vector<float>(grad, grad + q->n_elems));
+  q->cv.notify_all();
+  return 1;
+}
+
+// Blocks for the oldest gradient; writes it to `out` and returns its
+// local_step, or -1 on cancellation.
+int64_t gq_pop(void* h, float* out) {
+  auto* q = static_cast<GradQueue*>(h);
+  std::unique_lock<std::mutex> lock(q->mu);
+  q->cv.wait(lock, [&] { return q->cancelled || !q->q.empty(); });
+  if (q->q.empty()) return -1;  // cancelled and drained
+  auto& front = q->q.front();
+  std::memcpy(out, front.second.data(), q->n_elems * sizeof(float));
+  const int64_t step = front.first;
+  q->q.pop_front();
+  return step;
+}
+
+void gq_set_min_step(void* h, int64_t step) {
+  auto* q = static_cast<GradQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  q->min_step = step;
+}
+
+int64_t gq_dropped(void* h) {
+  auto* q = static_cast<GradQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  return q->dropped;
+}
+
+int64_t gq_size(void* h) {
+  auto* q = static_cast<GradQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  return static_cast<int64_t>(q->q.size());
+}
+
+void gq_cancel(void* h) {
+  auto* q = static_cast<GradQueue*>(h);
   std::lock_guard<std::mutex> lock(q->mu);
   q->cancelled = true;
   q->cv.notify_all();

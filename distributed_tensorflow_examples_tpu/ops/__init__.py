@@ -5,3 +5,5 @@ into libtensorflow (SURVEY.md D11/D12).  The TPU-native equivalent is Pallas:
 kernels lower through Mosaic to real TPU code, while a pure-XLA reference
 implementation of each op serves CPU tests and autodiff checks.
 """
+
+from . import attention  # noqa: F401

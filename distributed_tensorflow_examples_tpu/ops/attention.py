@@ -1,0 +1,139 @@
+"""Attention ops: reference MHA + ring attention for sequence/context
+parallelism.
+
+No reference analog (SURVEY.md section 5.7: the reference has no attention
+model; its longest-sequence workload scales only by TBPTT unroll).  This is
+the framework's long-context growth path, first-class per the blueprint:
+sequences shard over the mesh ``seq`` axis, and attention runs as a ring —
+each shard keeps its queries local while key/value blocks rotate around the
+axis via ``ppermute`` (one hop per step, riding ICI neighbor links), with the
+online-softmax accumulation of flash attention so no shard ever materialises
+the full [T, T] score matrix.
+
+Numerical contract (tested): ring attention over a seq-sharded mesh ==
+full-sequence attention on one device, for both causal and full attention.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+#: Finite "minus infinity" for masked logits: keeps the online-softmax
+#: recurrence NaN-free when a block is fully masked (exp(-1e30 - m) == 0 for
+#: any finite m), where a true -inf would produce inf-inf = NaN.
+NEG_INF = -1e30
+
+
+def mha(q, k, v, *, causal: bool = False, q_offset: int = 0, k_offset: int = 0):
+    """Reference multi-head attention.  q: [B, H, Tq, D], k/v: [B, H, Tk, D].
+
+    ``q_offset``/``k_offset`` are the global positions of the first row of
+    q/k — the pieces ring attention needs for causal masking across shards.
+    """
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[2])[:, None]
+        kpos = k_offset + jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(kpos > qpos, NEG_INF, s)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+
+def _block(q, k, v, carry, *, scale, causal, q_offset, k_offset):
+    """One online-softmax accumulation step (the flash-attention recurrence)
+    against a single k/v block.  carry = (o, m, l):
+    o [B,H,Tq,D] unnormalised output, m [B,H,Tq,1] running max,
+    l [B,H,Tq,1] running sum of exp."""
+    o, m, l = carry
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale  # [B,H,Tq,Tk]
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[2])[:, None]
+        kpos = k_offset + jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(kpos > qpos, NEG_INF, s)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    # Valid (unmasked) entries only: a fully-masked block contributes 0.
+    p = jnp.exp(s - m_new) * (s > NEG_INF / 2)
+    alpha = jnp.exp(m - m_new)
+    o = o * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    return o, m_new, l
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = False):
+    """Sequence-parallel attention inside ``shard_map``: queries stay local,
+    k/v blocks rotate ``axis_size`` hops around the ring (permuter.h role —
+    SURVEY.md D11 — but emitted as XLA ``ppermute`` on ICI).
+
+    Shapes per shard: q/k/v [B, H, T_local, D]; the global sequence is the
+    concatenation over the axis in index order.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    t_local = q.shape[2]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    q32, dtype = q.astype(jnp.float32), q.dtype
+    o = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
+    m = jnp.full(q.shape[:3] + (1,), NEG_INF, jnp.float32)
+    l = jnp.zeros(q.shape[:3] + (1,), jnp.float32)
+
+    # Receive-from-next rotation: after i hops we hold shard (my + i) % n's
+    # k/v.  Every shard does n identical hops => a clean ICI ring schedule.
+    perm = [(j, (j - 1) % n) for j in range(n)]
+
+    def body(carry, i):
+        o, m, l, k, v = carry
+        src = (my + i) % n
+        o, m, l = _block(
+            q32,
+            k.astype(jnp.float32),
+            v.astype(jnp.float32),
+            (o, m, l),
+            scale=scale,
+            causal=causal,
+            q_offset=my * t_local,
+            k_offset=src * t_local,
+        )
+        # Uniform rotation every step (the nth hop returns k/v to their
+        # owners; XLA drops it as dead code since the outputs are unused).
+        k, v = jax.tree.map(
+            lambda x: lax.ppermute(x, axis_name, perm=perm), (k, v)
+        )
+        return (o, m, l, k, v), None
+
+    (o, m, l, k, v), _ = lax.scan(body, (o, m, l, k, v), jnp.arange(n))
+    return (o / jnp.maximum(l, 1e-30)).astype(dtype)
+
+
+def sequence_parallel_attention(
+    mesh: Mesh,
+    q,
+    k,
+    v,
+    *,
+    causal: bool = False,
+    seq_axis: str = "seq",
+    batch_axis: str = "data",
+    head_axis: str = "model",
+):
+    """Global-array entry point: [B, H, T, D] inputs with T sharded over
+    ``seq_axis`` (and heads over ``head_axis`` when present — ring SP and
+    Megatron TP compose).  Internally a ``shard_map`` running the ring.
+    Falls back to plain (XLA-partitioned) attention when the mesh has no seq
+    axis."""
+    if mesh.shape.get(seq_axis, 1) == 1:
+        return mha(q, k, v, causal=causal)
+    h_entry = head_axis if mesh.shape.get(head_axis, 1) > 1 else None
+    spec = P(batch_axis, h_entry, seq_axis, None)
+
+    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+    mapped = jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )
+    return mapped(q, k, v)

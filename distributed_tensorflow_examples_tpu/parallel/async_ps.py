@@ -18,11 +18,14 @@ analog, D5/D12) coordinates applies.  Differences from the reference:
 
 - Single-host emulation time-shares the chip between worker threads, so
   wall-clock interleaving differs from a real PS cluster; the *ordering and
-  staleness semantics* (what makes async-SGD async) are faithful.
-- True-async mode applies whole gradients atomically (one flat accumulator),
-  where the reference applies per-variable without atomicity; the reference's
-  laxer behavior admits torn updates across variables, which nothing relies
-  on, so the stricter emulation is considered conforming.
+  staleness semantics* (what makes async-SGD async) are faithful: each
+  pushed gradient is popped and applied INDIVIDUALLY, in arrival order
+  (native GradientQueue — the worker->PS Send/Recv role), never coalesced.
+- Both modes move whole gradients atomically: sync aggregation uses one flat
+  accumulator over the concatenated gradient instead of the reference's
+  per-variable accumulators (numerically identical for equal counts, and it
+  closes the torn-cross-variable-update race the per-variable scheme admits
+  when replicas_to_aggregate < num_workers).
 - ``max_staleness`` adds a bound the reference's async mode lacks (its sync
   mode's staleness drop is mirrored exactly).
 """
@@ -89,10 +92,13 @@ class AsyncPSTrainer:
         self._leaf_shapes = [l.shape for l in leaves]
         self._leaf_sizes = [int(np.prod(s)) if s else 1 for s in self._leaf_shapes]
 
+        self._gq = None
+        self._accs: list = []
         if cfg.mode == "sync_replicas":
-            self._accs = [native.GradientAccumulator(n) for n in self._leaf_sizes]
-        elif cfg.mode == "async":
+            # One FLAT accumulator: whole-gradient applies are atomic.
             self._accs = [native.GradientAccumulator(sum(self._leaf_sizes))]
+        elif cfg.mode == "async":
+            self._gq = native.GradientQueue(sum(self._leaf_sizes))
         else:
             raise ValueError(f"unknown mode {cfg.mode!r}")
         self._tq = native.TokenQueue()
@@ -141,19 +147,20 @@ class AsyncPSTrainer:
             loss, grads = self._grad_fn(params, self.model_state, batch, rng)
             with self._history_lock:
                 self.history.append((wid, local_step, float(loss)))
-            flat = self._flat(grads)
+            flat = np.concatenate(self._flat(grads))
             if self.cfg.mode == "sync_replicas":
-                for acc, g in zip(self._accs, flat):
-                    acc.apply(local_step, g)
+                self._accs[0].apply(local_step, flat)
             else:
-                self._accs[0].apply(local_step, np.concatenate(flat))
+                self._gq.push(local_step, flat)
             it += 1
 
     # -- chief / updater side ------------------------------------------------
 
-    def _unflatten(self, avg_leaves: list[np.ndarray]):
+    def _unflatten_concat(self, flat: np.ndarray):
+        offsets = np.cumsum([0] + self._leaf_sizes)
         arrs = [
-            a.reshape(s) for a, s in zip(avg_leaves, self._leaf_shapes)
+            flat[offsets[i] : offsets[i + 1]].reshape(s)
+            for i, s in enumerate(self._leaf_shapes)
         ]
         return jax.tree.unflatten(self._treedef, arrs)
 
@@ -167,31 +174,28 @@ class AsyncPSTrainer:
 
     def _chief_sync(self):
         n_agg = self.cfg.replicas_to_aggregate or self.cfg.num_workers
+        acc = self._accs[0]
         self._tq.push(0, self.cfg.num_workers)
         for step in range(self.cfg.train_steps):
-            avgs = []
-            for acc in self._accs:
-                out = acc.take(n_agg)
-                if out is None:
-                    return
-                avgs.append(out)
-            self._apply_update(self._unflatten(avgs))
-            for acc in self._accs:
-                acc.set_global_step(self.global_step)
+            out = acc.take(n_agg)
+            if out is None:
+                return
+            self._apply_update(self._unflatten_concat(out))
+            acc.set_global_step(self.global_step)
             if step + 1 < self.cfg.train_steps:
                 self._tq.push(self.global_step, self.cfg.num_workers)
 
     def _chief_async(self):
-        acc = self._accs[0]
-        offsets = np.cumsum([0] + self._leaf_sizes)
+        # Each gradient applies individually, in arrival order — the W2
+        # semantics (no coalescing; see module docstring).
         for _ in range(self.cfg.train_steps):
-            out = acc.take(1)
-            if out is None:
+            item = self._gq.pop()
+            if item is None:
                 return
-            leaves = [out[offsets[i] : offsets[i + 1]] for i in range(len(self._leaf_sizes))]
-            self._apply_update(self._unflatten(leaves))
+            _, flat = item
+            self._apply_update(self._unflatten_concat(flat))
             if self.cfg.max_staleness is not None:
-                acc.set_global_step(self.global_step - self.cfg.max_staleness)
+                self._gq.set_min_step(self.global_step - self.cfg.max_staleness)
 
     # -- run -----------------------------------------------------------------
 
@@ -217,9 +221,13 @@ class AsyncPSTrainer:
             self._tq.cancel()
             for acc in self._accs:
                 acc.cancel()
+            if self._gq is not None:
+                self._gq.cancel()
             for w in workers:
                 w.join(timeout=10)
-        self.total_dropped = sum(acc.dropped for acc in self._accs)
+        self.total_dropped = sum(acc.dropped for acc in self._accs) + (
+            self._gq.dropped if self._gq is not None else 0
+        )
         log.info(
             "async-PS run done: %d applied steps, %d stale grads dropped",
             self.global_step,

@@ -40,6 +40,7 @@ def build_train_step(
     state_shardings: Any = None,
     donate: bool = True,
     unroll: int = 1,
+    batch_spec: P | None = None,
 ):
     """Returns ``step(state, batch) -> (state, metrics)``, fully jitted.
 
@@ -88,7 +89,10 @@ def build_train_step(
             "create_sharded_state) so jit can pin the state layout; pass it "
             "or omit mesh for sharding-free jit."
         )
-    b_sharding = batch_sharding(mesh)
+    if batch_spec is not None:
+        b_sharding = NamedSharding(mesh, batch_spec)
+    else:
+        b_sharding = batch_sharding(mesh)
     if unroll > 1:
         spec = b_sharding.spec
         b_sharding = NamedSharding(mesh, P(None, *spec))

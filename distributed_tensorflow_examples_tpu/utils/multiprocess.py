@@ -75,6 +75,7 @@ class MultiProcessRunner:
         self.extra_env = dict(env or {})
         self.procs: list[subprocess.Popen] = []
         self.log_paths: list[str] = []
+        self._log_files: list = []
 
     def _tf_config(self, index: int) -> str:
         # Every entry carries the coordinator's port: only workers[0] (the
@@ -96,6 +97,7 @@ class MultiProcessRunner:
             log_path = os.path.join(self._dir, f"task_{i}.log")
             self.log_paths.append(log_path)
             logf = open(log_path, "w")
+            self._log_files.append(logf)
             self.procs.append(
                 subprocess.Popen(
                     [sys.executable, self.script_path, str(i)],
@@ -126,7 +128,20 @@ class MultiProcessRunner:
                 p.kill()
                 p.wait()
                 codes[i] = -9
+        for f in self._log_files:
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._log_files.clear()
         return [int(c) for c in codes]
+
+    def cleanup(self) -> None:
+        """Remove the temp worker-script/log directory (call after a
+        successful run; kept on failure for debugging)."""
+        import shutil
+
+        shutil.rmtree(self._dir, ignore_errors=True)
 
     def output(self, index: int) -> str:
         with open(self.log_paths[index]) as f:
@@ -142,4 +157,6 @@ class MultiProcessRunner:
                 for i in range(self.n)
             )
             raise RuntimeError(f"multi-process run failed: {codes}\n{logs}")
-        return [self.output(i) for i in range(self.n)]
+        outs = [self.output(i) for i in range(self.n)]
+        self.cleanup()
+        return outs

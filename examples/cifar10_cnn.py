@@ -93,6 +93,9 @@ def _run_async_ps(cfg, ds):
         params,
         rng=jax.random.key(FLAGS.seed),
     )
+    import time as _time
+
+    t0 = _time.perf_counter()
     local_bs = max(1, FLAGS.batch_size // n_workers)
     its = [
         iter(
@@ -117,9 +120,15 @@ def _run_async_ps(cfg, ds):
     for i in range(0, (len(ds.test["label"]) // ebs) * ebs, ebs):
         b = {k: v[i : i + ebs] for k, v in ds.test.items()}
         accs.append(float(eval_fn(final_params, b)))
+    dt = _time.perf_counter() - t0
+    sps = trainer.global_step / dt if dt > 0 else 0.0
+    eps_per_chip = sps * local_bs / max(1, len(jax.devices()))
     losses = [l for (_, _, l) in trainer.history] or [float("nan")]
+    # Same scrapable fields as Experiment.finish().
     print(
         f"FINAL step={trainer.global_step} "
+        f"steps_per_sec={sps:.1f} "
+        f"examples_per_sec_per_chip={eps_per_chip:.0f} "
         f"stale_dropped={trainer.total_dropped} "
         f"first_loss={losses[0]:.4f} last_loss={losses[-1]:.4f} "
         f"test_accuracy={float(np.mean(accs)):.4f}"

@@ -107,16 +107,19 @@ def _blob_batches(seed, batch=32, n=10_000):
         yield {"image": x, "label": y}
 
 
-def _make_trainer(mode, steps=30, workers=2, **kw):
+def _make_trainer(mode, steps=30, workers=2, lr=0.1, **kw):
     params = models.mlp.init(CFG, jax.random.key(0))
     cfg = AsyncPSConfig(num_workers=workers, mode=mode, train_steps=steps, **kw)
     return AsyncPSTrainer(
-        cfg, models.mlp.loss_fn(CFG), optax.sgd(0.1), params, rng=jax.random.key(0)
+        cfg, models.mlp.loss_fn(CFG), optax.sgd(lr), params, rng=jax.random.key(0)
     )
 
 
 def test_async_mode_trains():
-    tr = _make_trainer("async", steps=40)
+    # Per-gradient async applies act like a ~num_workers x step-rate; a
+    # smaller lr keeps the stale-gradient dynamics stable (the same tuning
+    # the reference's async configs need).
+    tr = _make_trainer("async", steps=40, lr=0.02)
     tr.run([_blob_batches(1), _blob_batches(2)])
     assert tr.global_step == 40
     losses = [l for (_, _, l) in tr.history]
@@ -172,10 +175,32 @@ def test_async_staleness_bound_drops():
     """max_staleness=0 forces every applied grad to be computed against the
     newest params; concurrent workers then suffer drops, and training still
     reaches the step target (the knob of SURVEY.md section 5.2)."""
-    tr = _make_trainer("async", steps=20, max_staleness=0)
+    tr = _make_trainer("async", steps=20, max_staleness=0, lr=0.02)
     tr.run([_blob_batches(1), _blob_batches(2)])
     assert tr.global_step == 20
     # With two racing workers and a zero staleness bound, at least one grad
     # is typically dropped; assert only the mechanism is alive (counter >= 0
     # and run completed) to avoid a flaky race assertion.
     assert tr.total_dropped >= 0
+
+
+def test_gradient_queue_fifo_no_coalescing():
+    """True-async path: each pushed gradient pops individually in FIFO
+    order (never averaged together), with the staleness gate dropping
+    too-old pushes."""
+    gq = native.GradientQueue(2)
+    gq.push(0, np.array([1.0, 1.0]))
+    gq.push(1, np.array([2.0, 2.0]))
+    s0, g0 = gq.pop()
+    s1, g1 = gq.pop()
+    assert (s0, s1) == (0, 1)
+    np.testing.assert_allclose(g0, [1.0, 1.0])
+    np.testing.assert_allclose(g1, [2.0, 2.0])
+    gq.set_min_step(5)
+    assert not gq.push(4, np.ones(2))  # stale
+    assert gq.dropped == 1
+    assert gq.push(5, np.ones(2))
+    assert len(gq) == 1
+    gq.cancel()
+    gq.pop()  # drains the remaining item
+    assert gq.pop() is None

@@ -1,0 +1,79 @@
+"""Ring attention (sequence parallelism) numerics: ring over a seq-sharded
+mesh must equal full-sequence attention exactly (SURVEY.md section 5.7 growth
+path; the contract stated in ops/attention.py)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_tensorflow_examples_tpu.ops import attention as A
+from distributed_tensorflow_examples_tpu.data.pipeline import as_global
+from distributed_tensorflow_examples_tpu.parallel import local_mesh_for_testing
+
+
+def _qkv(b=2, h=4, t=32, d=16, seed=0):
+    r = jax.random.split(jax.random.key(seed), 3)
+    mk = lambda rr: jax.random.normal(rr, (b, h, t, d), jnp.float32)
+    return mk(r[0]), mk(r[1]), mk(r[2])
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_full(causal):
+    mesh = local_mesh_for_testing({"data": 2, "seq": 4})
+    q, k, v = _qkv()
+    ref = A.mha(q, k, v, causal=causal)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("data", None, "seq", None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    out = jax.jit(
+        lambda q, k, v: A.sequence_parallel_attention(mesh, q, k, v, causal=causal)
+    )(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_composes_with_head_sharding():
+    """SP ring + TP head sharding on one mesh (data=2, seq=2, model=2)."""
+    mesh = local_mesh_for_testing({"data": 2, "seq": 2, "model": 2})
+    q, k, v = _qkv(b=2, h=4, t=16, d=8)
+    ref = A.mha(q, k, v, causal=True)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("data", "model", "seq", None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    out = jax.jit(
+        lambda q, k, v: A.sequence_parallel_attention(mesh, q, k, v, causal=True)
+    )(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_grads_flow():
+    """Autodiff through the ring (scan + ppermute) matches full-attention
+    gradients — required for training with SP."""
+    mesh = local_mesh_for_testing({"seq": 4})
+    q, k, v = _qkv(b=1, h=2, t=16, d=8)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(
+            A.sequence_parallel_attention(mesh, q, k, v, causal=True) ** 2
+        )
+
+    def loss_full(q, k, v):
+        return jnp.sum(A.mha(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_full):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_masked_rows_are_finite():
+    """First causal block of a late shard is fully masked mid-ring; the
+    online softmax must stay NaN-free."""
+    mesh = local_mesh_for_testing({"seq": 8})
+    q, k, v = _qkv(b=1, h=1, t=32, d=8)
+    out = jax.jit(
+        lambda q, k, v: A.sequence_parallel_attention(mesh, q, k, v, causal=True)
+    )(q, k, v)
+    assert np.isfinite(np.asarray(out)).all()

@@ -1,0 +1,79 @@
+"""Transformer LM (growth-path flagship): trains under dp x tp x sp, and the
+parallel placement does not change numerics vs a single-device run."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_tensorflow_examples_tpu import models, train
+from distributed_tensorflow_examples_tpu.data.pipeline import as_global
+from distributed_tensorflow_examples_tpu.parallel import local_mesh_for_testing
+
+CFG = models.transformer.Config(
+    vocab_size=128, dim=32, n_layers=2, n_heads=4, max_seq_len=64,
+    compute_dtype="float32",
+)
+
+
+def _batches(n, b=4, t=16, seed=0):
+    # Markov-structured stream (learnable bigrams) — random tokens would
+    # leave nothing for the loss to descend on in a short test.
+    from distributed_tensorflow_examples_tpu.data import datasets
+
+    ids = datasets._synthetic_token_stream(8192, 128, seed)
+    it = datasets.lm_batches(ids, batch_size=b, seq_len=t)
+    return [next(it) for _ in range(n)]
+
+
+def _run(mesh, raw, rules, spec=None):
+    spec = spec if spec is not None else __import__('jax').sharding.PartitionSpec('data')
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    opt = optax.adam(1e-3)
+    state, shardings = train.create_sharded_state(
+        lambda r: models.transformer.init(CFG, r),
+        opt,
+        jax.random.key(0),
+        mesh=mesh,
+        rules=rules,
+    )
+    step = train.build_train_step(
+        models.transformer.loss_fn(CFG, mesh=mesh),
+        opt,
+        mesh=mesh,
+        state_shardings=shardings,
+        batch_spec=spec,
+    )
+    losses = []
+    sh = NamedSharding(mesh, spec) if spec is not None else None
+    for b in raw:
+        gb = (
+            {k: jax.device_put(v, sh) for k, v in b.items()}
+            if sh is not None
+            else as_global(b, mesh)
+        )
+        state, m = step(state, gb)
+        losses.append(float(m["loss"]))
+    return losses
+
+
+def test_transformer_trains_dp_tp_sp():
+    from jax.sharding import PartitionSpec as P
+
+    mesh = local_mesh_for_testing({"data": 2, "seq": 2, "model": 2})
+    raw = _batches(20)
+    losses = _run(mesh, raw, models.transformer.SHARDING_RULES, spec=P("data", "seq"))
+    assert losses[-1] < losses[0] * 0.98, losses
+    assert all(np.isfinite(losses))
+
+
+def test_transformer_parallel_matches_single_device():
+    from jax.sharding import PartitionSpec as P
+
+    raw = _batches(4)
+    mesh1 = local_mesh_for_testing({"data": 1})
+    mesh8 = local_mesh_for_testing({"data": 2, "seq": 2, "model": 2})
+    l1 = _run(mesh1, raw, ())
+    l8 = _run(mesh8, raw, models.transformer.SHARDING_RULES, spec=P("data", "seq"))
+    np.testing.assert_allclose(l1, l8, rtol=5e-4)
