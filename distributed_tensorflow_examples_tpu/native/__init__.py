@@ -72,7 +72,7 @@ def _load() -> ctypes.CDLL:
         lib.tq_size.argtypes = [ctypes.c_void_p]
         lib.tq_cancel.argtypes = [ctypes.c_void_p]
         lib.gq_new.restype = ctypes.c_void_p
-        lib.gq_new.argtypes = [ctypes.c_int64]
+        lib.gq_new.argtypes = [ctypes.c_int64, ctypes.c_int64]
         lib.gq_free.argtypes = [ctypes.c_void_p]
         lib.gq_push.restype = ctypes.c_int
         lib.gq_push.argtypes = [
@@ -145,18 +145,20 @@ class GradientQueue:
     Send/Recv role): each pushed gradient is popped and applied individually
     — no coalescing — with an optional staleness gate."""
 
-    def __init__(self, num_elems: int):
+    def __init__(self, num_elems: int, capacity: int = 16):
         self._lib = _load()
-        self._h = self._lib.gq_new(int(num_elems))
+        self._h = self._lib.gq_new(int(num_elems), int(capacity))
         if not self._h:
-            raise MemoryError(f"gq_new({num_elems}) failed")
+            raise MemoryError(f"gq_new({num_elems}, {capacity}) failed")
         self.num_elems = int(num_elems)
 
     def push(self, local_step: int, grad: np.ndarray) -> bool:
+        """Blocks while the queue is full (backpressure); returns False when
+        the grad was dropped as stale or the queue was cancelled."""
         g = np.ascontiguousarray(grad, dtype=np.float32).reshape(-1)
         if g.size != self.num_elems:
             raise ValueError(f"grad size {g.size} != {self.num_elems}")
-        return bool(self._lib.gq_push(self._h, int(local_step), _as_float_ptr(g)))
+        return self._lib.gq_push(self._h, int(local_step), _as_float_ptr(g)) == 1
 
     def pop(self) -> tuple[int, np.ndarray] | None:
         """Blocking; returns (local_step, grad) or None when cancelled+drained."""

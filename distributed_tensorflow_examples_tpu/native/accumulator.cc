@@ -54,14 +54,17 @@ struct TokenQueue {
 // (rpc_rendezvous_mgr.h), with an optional staleness gate.
 struct GradQueue {
   std::mutex mu;
-  std::condition_variable cv;
+  std::condition_variable cv;       // signalled on push (pop waiters)
+  std::condition_variable cv_space; // signalled on pop (push waiters)
   size_t n_elems;
+  size_t capacity;  // bound on queued gradients: push blocks when full
   std::deque<std::pair<int64_t, std::vector<float>>> q;  // (local_step, grad)
   int64_t min_step = 0;  // staleness gate: pushes below this are dropped
   int64_t dropped = 0;
   bool cancelled = false;
 
-  explicit GradQueue(int64_t n) : n_elems(static_cast<size_t>(n)) {}
+  GradQueue(int64_t n, int64_t cap)
+      : n_elems(static_cast<size_t>(n)), capacity(static_cast<size_t>(cap)) {}
 };
 
 }  // namespace
@@ -183,17 +186,23 @@ void tq_cancel(void* h) {
 // Gradient queue (true-async path)
 // ---------------------------------------------------------------------------
 
-void* gq_new(int64_t num_elems) {
-  if (num_elems <= 0) return nullptr;
-  return new (std::nothrow) GradQueue(num_elems);
+// capacity bounds queued gradients (backpressure: push blocks while full).
+void* gq_new(int64_t num_elems, int64_t capacity) {
+  if (num_elems <= 0 || capacity <= 0) return nullptr;
+  return new (std::nothrow) GradQueue(num_elems, capacity);
 }
 
 void gq_free(void* h) { delete static_cast<GradQueue*>(h); }
 
-// Returns 1 if enqueued, 0 if dropped as stale (local_step < min_step).
+// Returns 1 if enqueued, 0 if dropped as stale (local_step < min_step),
+// -1 if cancelled while waiting for space.  Blocks while the queue is full
+// (backpressure on fast workers — bounds memory to capacity gradients).
 int gq_push(void* h, int64_t local_step, const float* grad) {
   auto* q = static_cast<GradQueue*>(h);
-  std::lock_guard<std::mutex> lock(q->mu);
+  std::unique_lock<std::mutex> lock(q->mu);
+  q->cv_space.wait(lock,
+                   [&] { return q->cancelled || q->q.size() < q->capacity; });
+  if (q->cancelled) return -1;
   if (local_step < q->min_step) {
     ++q->dropped;
     return 0;
@@ -214,6 +223,7 @@ int64_t gq_pop(void* h, float* out) {
   std::memcpy(out, front.second.data(), q->n_elems * sizeof(float));
   const int64_t step = front.first;
   q->q.pop_front();
+  q->cv_space.notify_all();
   return step;
 }
 
@@ -240,6 +250,7 @@ void gq_cancel(void* h) {
   std::lock_guard<std::mutex> lock(q->mu);
   q->cancelled = true;
   q->cv.notify_all();
+  q->cv_space.notify_all();
 }
 
 }  // extern "C"

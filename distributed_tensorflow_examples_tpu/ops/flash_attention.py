@@ -1,0 +1,282 @@
+"""Flash attention as a Pallas TPU kernel (forward + FA2 backward).
+
+The reference's "custom native op" slot is hand-written C++ compiled into
+libtensorflow (SURVEY.md D11/D12); the TPU-native equivalent is a Pallas
+kernel lowered through Mosaic.  This is the framework's flagship custom
+kernel: O(block) VMEM attention — neither the [T, T] score matrix nor the
+full k/v sequence is ever resident on-chip, so sequence length is bounded by
+HBM, not VMEM (plain XLA attention materialises [T, T] scores and dies at
+moderate T; a full-k/v-in-VMEM kernel dies at ~16k).
+
+Design (per /opt/skills/guides/pallas_guide.md):
+- 3D grid (batch*heads, q blocks, k blocks); the k dimension is innermost
+  and "arbitrary" (sequential), so the online-softmax state for one q block
+  lives in VMEM scratch across k steps and the output block is written on
+  the last k step.
+- Causal: blocks fully above the diagonal skip their compute via ``pl.when``
+  (grid steps still occur, but no matmuls issue).
+- Online softmax in f32; NEG_INF finite mask keeps partially-masked blocks
+  NaN-free (same contract as ops.attention).
+- Backward: two kernels with the same structure — dq (grid over q blocks,
+  inner over k) and dk/dv (grid over k blocks, inner over q) — using the
+  saved LSE and the FA2 recurrence: p = exp(s - lse); ds = p*(do.v^T - D);
+  D = rowsum(do * o).
+- ``interpret=True`` off-TPU so CPU tests run the same kernels.
+
+Composes with ring attention (ops.attention): the ring rotates k/v shards
+between chips; this kernel is the per-chip block compute.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _params():
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary")
+    )
+
+
+def _mask(s, qi, kj, bq, bk):
+    qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    kpos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(kpos > qpos, NEG_INF, s)
+
+
+def _visible(qi, kj, bq, bk):
+    """False iff the (qi, kj) block is entirely above the causal diagonal."""
+    return kj * bk <= (qi + 1) * bq - 1
+
+
+# ----------------------------------------------------------------------------
+# Forward
+# ----------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *, scale, causal, bq, bk):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    @pl.when(jnp.logical_or(not causal, _visible(qi, kj, bq, bk)))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+        k = k_ref[0].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0].astype(jnp.float32)
+        s = q @ k.T  # [bq, bk]
+        if causal:
+            s = _mask(s, qi, kj, bq, bk)
+        m_prev, l_prev = m_sc[:], l_sc[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new) * (s > NEG_INF / 2)
+        alpha = jnp.exp(m_prev - m_new)
+        acc_sc[:] = acc_sc[:] * alpha + p @ v
+        l_sc[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_sc[:] = m_new
+
+    @pl.when(kj == nk - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_sc[:], 1e-30)
+        o_ref[0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_sc[:] + jnp.log(l_safe)
+
+
+def _fwd(q, k, v, *, causal, block_q, block_k):
+    bh, t, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    bq, bk = min(block_q, t), min(block_k, t)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
+        grid=(bh, t // bq, t // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),  # running max
+            pltpu.VMEM((bq, 1), jnp.float32),  # running sum
+            pltpu.VMEM((bq, d), jnp.float32),  # output accumulator
+        ],
+        compiler_params=_params(),
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+# ----------------------------------------------------------------------------
+# Backward (FA2)
+# ----------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc, *, scale, causal, bq, bk):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    @pl.when(jnp.logical_or(not causal, _visible(qi, kj, bq, bk)))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]  # [bq, 1]
+        delta = delta_ref[0]
+        s = q @ k.T
+        if causal:
+            s = _mask(s, qi, kj, bq, bk)
+        p = jnp.exp(s - lse) * (s > NEG_INF / 2)
+        ds = p * (do @ v.T - delta)
+        dq_sc[:] = dq_sc[:] + ds @ k
+
+    @pl.when(kj == nk - 1)
+    def _finish():
+        dq_ref[0] = (dq_sc[:] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_sc, dv_sc, *, scale, causal, bq, bk):
+    kj, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    @pl.when(jnp.logical_or(not causal, _visible(qi, kj, bq, bk)))
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # unscaled
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = (q * scale) @ k.T
+        if causal:
+            s = _mask(s, qi, kj, bq, bk)
+        p = jnp.exp(s - lse) * (s > NEG_INF / 2)
+        dv_sc[:] = dv_sc[:] + p.T @ do
+        ds = p * (do @ v.T - delta)
+        dk_sc[:] = dk_sc[:] + (ds.T @ q) * scale
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _bwd(causal, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    bh, t, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    bq, bk = min(block_q, t), min(block_k, t)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [bh, t, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
+        grid=(bh, t // bq, t // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),  # q
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),  # k
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),  # v
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),  # do
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),  # lse
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),  # delta
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=_params(),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
+        grid=(bh, t // bk, t // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # q
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # k
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # v
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # do
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),  # lse
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=_params(),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhd(q, k, v, causal, block_q, block_k):
+    o, _ = _fwd(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
+    o, lse = _fwd(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
+    return o, (q, k, v, o, lse)
+
+
+_flash_bhd.defvjp(_flash_fwd_rule, _bwd)
+
+
+def flash_attention(
+    q, k, v, *, causal: bool = False, block_q: int = 512, block_k: int = 512
+):
+    """Drop-in for ``ops.attention.mha``: q/k/v [B, H, T, D] -> [B, H, T, D].
+
+    Requires T divisible by the block sizes (caller pads or adjusts blocks);
+    differentiable (custom FA2 VJP); runs interpreted off-TPU.
+    """
+    B, H, T, D = q.shape
+    bq = min(block_q, T)
+    bk = min(block_k, T)
+    if T % bq or T % bk:
+        raise ValueError(f"seq len {T} not divisible by blocks ({bq}, {bk})")
+    fold = lambda x: x.reshape(B * H, T, D)
+    o = _flash_bhd(fold(q), fold(k), fold(v), causal, bq, bk)
+    return o.reshape(B, H, T, D)

@@ -98,7 +98,9 @@ class AsyncPSTrainer:
             # One FLAT accumulator: whole-gradient applies are atomic.
             self._accs = [native.GradientAccumulator(sum(self._leaf_sizes))]
         elif cfg.mode == "async":
-            self._gq = native.GradientQueue(sum(self._leaf_sizes))
+            self._gq = native.GradientQueue(
+                sum(self._leaf_sizes), capacity=max(4, 2 * cfg.num_workers)
+            )
         else:
             raise ValueError(f"unknown mode {cfg.mode!r}")
         self._tq = native.TokenQueue()

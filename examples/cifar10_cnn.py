@@ -110,6 +110,7 @@ def _run_async_ps(cfg, ds):
         for w in range(n_workers)
     ]
     final_params = trainer.run(its)
+    dt = _time.perf_counter() - t0  # training window only (eval excluded)
 
     # Final eval with the trained params.
     eval_fn = jax.jit(
@@ -120,7 +121,6 @@ def _run_async_ps(cfg, ds):
     for i in range(0, (len(ds.test["label"]) // ebs) * ebs, ebs):
         b = {k: v[i : i + ebs] for k, v in ds.test.items()}
         accs.append(float(eval_fn(final_params, b)))
-    dt = _time.perf_counter() - t0
     sps = trainer.global_step / dt if dt > 0 else 0.0
     eps_per_chip = sps * local_bs / max(1, len(jax.devices()))
     losses = [l for (_, _, l) in trainer.history] or [float("nan")]

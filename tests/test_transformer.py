@@ -27,9 +27,9 @@ def _batches(n, b=4, t=16, seed=0):
 
 
 def _run(mesh, raw, rules, spec=None):
-    spec = spec if spec is not None else __import__('jax').sharding.PartitionSpec('data')
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
+    spec = spec if spec is not None else P("data")
     opt = optax.adam(1e-3)
     state, shardings = train.create_sharded_state(
         lambda r: models.transformer.init(CFG, r),
@@ -46,14 +46,8 @@ def _run(mesh, raw, rules, spec=None):
         batch_spec=spec,
     )
     losses = []
-    sh = NamedSharding(mesh, spec) if spec is not None else None
     for b in raw:
-        gb = (
-            {k: jax.device_put(v, sh) for k, v in b.items()}
-            if sh is not None
-            else as_global(b, mesh)
-        )
-        state, m = step(state, gb)
+        state, m = step(state, as_global(b, mesh, spec=spec))
         losses.append(float(m["loss"]))
     return losses
 
