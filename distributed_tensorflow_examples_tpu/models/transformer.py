@@ -37,6 +37,7 @@ class Config:
     mlp_ratio: int = 4
     max_seq_len: int = 2048
     causal: bool = True
+    attention: str = "auto"  # "auto" | "xla" | "flash" (auto: flash on TPU)
     compute_dtype: str = "bfloat16"
 
     @property
@@ -58,6 +59,32 @@ def _layernorm(p, x, eps=1e-5):
     var = jnp.var(x32, axis=-1, keepdims=True)
     y = (x32 - mu) * jax.lax.rsqrt(var + eps)
     return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _use_flash(cfg: Config, seq_len: int) -> bool:
+    if cfg.attention == "flash":
+        return True
+    if cfg.attention == "auto":
+        # Flash needs block-divisible T; on TPU it wins from moderate T up
+        # (BASELINE.md kernel table) and is mandatory at long context.
+        return jax.default_backend() == "tpu" and seq_len % 512 == 0
+    return False
+
+
+def _flash_sharded(mesh: Mesh, q, k, v, *, causal: bool):
+    """Flash attention under a mesh: a Mosaic custom call cannot be
+    partitioned by XLA SPMD, so shard_map it — batch over ``data``, heads
+    over ``model``, sequence local (the seq>1 case routes to the ring
+    instead)."""
+    h_entry = "model" if mesh.shape.get("model", 1) > 1 else None
+    spec = P("data", h_entry, None, None)
+
+    from ..ops.flash_attention import flash_attention
+
+    fn = lambda q, k, v: flash_attention(q, k, v, causal=causal)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)
 
 
 def init(cfg: Config, rng: jax.Array):
@@ -115,7 +142,25 @@ def apply(cfg: Config, params, x, *, mesh: Mesh | None = None):
         k = constrain(k, P("data", "model", "seq", None))
         v = constrain(v, P("data", "model", "seq", None))
         if mesh is not None and mesh.shape.get("seq", 1) > 1:
+            # Sequence sharded: ring attention over the seq axis.  (Per-chip
+            # block compute is the ring's own online-softmax; an explicit
+            # --attention=flash does not apply here.)
+            if cfg.attention == "flash" and i == 0:
+                import warnings
+
+                warnings.warn(
+                    "attention='flash' is overridden by sequence parallelism "
+                    "(seq axis > 1 routes attention through the ppermute "
+                    "ring); per-chip compute uses the ring's online softmax."
+                )
             o = attn_ops.sequence_parallel_attention(mesh, q, k, v, causal=cfg.causal)
+        elif _use_flash(cfg, T):
+            if mesh is not None:
+                o = _flash_sharded(mesh, q, k, v, causal=cfg.causal)
+            else:
+                from ..ops.flash_attention import flash_attention
+
+                o = flash_attention(q, k, v, causal=cfg.causal)
         else:
             o = attn_ops.mha(q, k, v, causal=cfg.causal)
         o = jnp.moveaxis(o, 1, 2).reshape(B, T, cfg.dim)

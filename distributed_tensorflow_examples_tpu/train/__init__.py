@@ -17,3 +17,4 @@ from .loop import TrainSession  # noqa: F401
 from .runner import Experiment  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import hooks  # noqa: F401
+from . import preemption  # noqa: F401

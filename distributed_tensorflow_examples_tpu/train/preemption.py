@@ -1,0 +1,70 @@
+"""Preemption-aware checkpointing (SURVEY.md section 5.3).
+
+Reference-era recovery is crash-restart: non-chief workers block in
+``SessionManager.wait_for_session`` while the chief restores the newest
+checkpoint (``session_manager.py:259,419``); modern TF adds
+``PreemptionCheckpointHandler`` (``failure_handling.py:337``) which listens
+for the platform's preemption signal and saves one final checkpoint before
+the instance disappears.
+
+TPU-native shape: Cloud TPU preemptions deliver SIGTERM; this hook installs a
+signal handler that flips a flag, and the training loop (which owns the only
+safe point to act — between compiled steps) saves a checkpoint and requests a
+clean stop.  Resume is the ordinary auto-restore path of ``TrainSession``.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+
+from .hooks import Hook
+
+log = logging.getLogger("dtx.preemption")
+
+
+class PreemptionCheckpointHook(Hook):
+    """Save-and-stop on SIGTERM/SIGINT (the PreemptionCheckpointHandler
+    analog).  Installed while the session runs; restores the previous signal
+    handlers at end."""
+
+    def __init__(self, manager, signals=(signal.SIGTERM,)):
+        self.mgr = manager
+        self.signals = signals
+        self._flag = threading.Event()
+        self._prev: dict = {}
+
+    @property
+    def preempted(self) -> bool:
+        return self._flag.is_set()
+
+    def _handler(self, signum, frame):
+        log.warning("received signal %d: will checkpoint and stop", signum)
+        self._flag.set()
+
+    def begin(self, loop):
+        for s in self.signals:
+            try:
+                self._prev[s] = signal.signal(s, self._handler)
+            except ValueError:
+                # Not the main thread (e.g. tests driving the loop from a
+                # worker thread): fall back to manual .trigger().
+                log.info("cannot install handler for signal %d here", s)
+
+    def trigger(self) -> None:
+        """Manual preemption signal (tests / external watchers)."""
+        self._flag.set()
+
+    def after_step(self, loop, metrics):
+        if self._flag.is_set() and not loop.should_stop():
+            self.mgr.save(loop.step, loop.state, force=True)
+            self.mgr.wait()
+            loop.request_stop(f"preempted at step {loop.step} (checkpoint saved)")
+
+    def end(self, loop):
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except ValueError:
+                pass

@@ -42,14 +42,20 @@ class Experiment:
         self,
         *,
         init_fn: Callable,
-        loss_fn: Callable,
+        loss_fn: Callable | None,
         optimizer: optax.GradientTransformation,
         rules=(),
         flags,
         mesh: Mesh | None = None,
         extra_hooks: Iterable[hooks_lib.Hook] = (),
+        loss_fn_factory: Callable | None = None,
+        batch_spec: PartitionSpec | None = None,
     ):
         self.flags = flags
+        if getattr(flags, "deterministic", False):
+            from ..utils import determinism
+
+            determinism.enable()
         cluster = dist.initialize()
         if cluster.is_ps_task:
             # TF_CONFIG launchers may still start ps/evaluator processes;
@@ -62,6 +68,12 @@ class Experiment:
             raise SystemExit(0)
         self.mesh = mesh if mesh is not None else build_mesh(MeshSpec.parse(flags.mesh))
         log.info("mesh: %s over %d devices", dict(self.mesh.shape), self.mesh.size)
+        if loss_fn is None:
+            # Mesh-dependent losses (ring attention needs the mesh object).
+            if loss_fn_factory is None:
+                raise ValueError("pass loss_fn or loss_fn_factory")
+            loss_fn = loss_fn_factory(self.mesh)
+        self.batch_spec = batch_spec
         self.optimizer = optimizer
         self.state, self.shardings = create_sharded_state(
             init_fn,
@@ -76,6 +88,7 @@ class Experiment:
             mesh=self.mesh,
             state_shardings=self.shardings,
             unroll=flags.unroll,
+            batch_spec=batch_spec,
         )
         self._loss_fn = loss_fn
         self.log_dir = flags.log_dir or None
@@ -99,6 +112,11 @@ class Experiment:
                     self.ckpt, every_steps=flags.checkpoint_every_steps
                 )
             )
+            # Preemption (SIGTERM) -> final checkpoint + clean stop; resume
+            # is the ordinary auto-restore (SURVEY.md section 5.3).
+            from .preemption import PreemptionCheckpointHook
+
+            self.hooks.append(PreemptionCheckpointHook(self.ckpt))
         if getattr(flags, "profile", False) and self.log_dir:
             self.hooks.append(hooks_lib.ProfilerHook(self.log_dir))
         self.hooks.extend(extra_hooks)
@@ -113,11 +131,12 @@ class Experiment:
     def batches(self, local_iter, *, unrolled: bool = True):
         """Wrap a per-host local-batch iterator into prefetched global device
         batches (stacking for unroll when configured)."""
-        spec = None
+        spec = self.batch_spec
         it = local_iter if hasattr(local_iter, "__next__") else iter(local_iter)
         if unrolled and self.flags.unroll > 1:
             it = pipeline_lib.stack_for_unroll(it, self.flags.unroll)
-            spec = PartitionSpec(None, "data")
+            base = spec if spec is not None else PartitionSpec("data")
+            spec = PartitionSpec(None, *base)
         return pipeline_lib.prefetch_to_mesh(it, self.mesh, spec=spec)
 
     def run(self, local_iter) -> Any:
@@ -141,7 +160,10 @@ class Experiment:
                 return _loss(params, mstate, batch, jax.random.key(0))[1][1]
 
         step = build_eval_step(
-            eval_fn, mesh=self.mesh, state_shardings=self.shardings
+            eval_fn,
+            mesh=self.mesh,
+            state_shardings=self.shardings,
+            batch_spec=self.batch_spec,
         )
         n = len(next(iter(arrays.values())))
         dp = self.mesh.shape.get("data", 1)
@@ -152,7 +174,10 @@ class Experiment:
         count = 0
         for i in range(0, (n // ebs) * ebs, ebs):
             b = {k: v[i : i + ebs] for k, v in arrays.items()}
-            m = step(self.state, pipeline_lib.as_global(b, self.mesh))
+            m = step(
+                self.state,
+                pipeline_lib.as_global(b, self.mesh, spec=self.batch_spec),
+            )
             for k, v in m.items():
                 sums[k] = sums.get(k, 0.0) + float(v)
             count += 1

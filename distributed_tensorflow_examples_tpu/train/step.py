@@ -119,6 +119,7 @@ def build_eval_step(
     *,
     mesh: Mesh | None = None,
     state_shardings: Any = None,
+    batch_spec: P | None = None,
 ):
     """``eval(state, batch) -> metrics`` (replicated outputs)."""
 
@@ -127,8 +128,13 @@ def build_eval_step(
 
     if mesh is None:
         return jax.jit(stepper)
+    b_sharding = (
+        NamedSharding(mesh, batch_spec)
+        if batch_spec is not None
+        else batch_sharding(mesh)
+    )
     return jax.jit(
         stepper,
-        in_shardings=(state_shardings, batch_sharding(mesh)),
+        in_shardings=(state_shardings, b_sharding),
         out_shardings=_tree_of_replicated(mesh),
     )

@@ -1,0 +1,38 @@
+"""Determinism controls (SURVEY.md section 5.2).
+
+The reference stack's knob is ``tf.config.experimental.enable_op_determinism``
+(``TF/python/framework/config.py:945``) plus fixed seeds.  On TPU, SPMD is
+race-free by construction — the nondeterminism sources that remain are
+(a) seeds, (b) matmul/reduction precision choices that may vary with fusion
+decisions, and (c) host-side data order.  This module centralises the knob:
+
+- every framework RNG flows from one seed (``--seed``; examples already
+  fold step/worker ids),
+- ``enable()`` pins partitionable threefry (stable keys under sharding) and
+  the highest matmul precision so reductions don't vary with tiling,
+- data pipelines reshuffle from ``(seed, epoch)`` (see data.pipeline), so
+  every host agrees on the permutation.
+
+The async-PS emulation (parallel.async_ps) is *deliberately* nondeterministic
+in arrival order — that is the semantics being emulated (the reference's
+async config is racy by design; SURVEY.md section 5.2).  Its determinism
+story is the staleness bound, not this flag.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+log = logging.getLogger("dtx.determinism")
+
+
+def enable(*, matmul_precision: str = "highest") -> None:
+    """Turn on run-to-run determinism (the enable_op_determinism analog)."""
+    jax.config.update("jax_threefry_partitionable", True)
+    jax.config.update("jax_default_matmul_precision", matmul_precision)
+    log.info(
+        "determinism on: partitionable threefry, matmul precision=%s",
+        matmul_precision,
+    )

@@ -57,6 +57,13 @@ def define_training_flags(default_batch_size: int = 128, default_steps: int = 10
         'Mesh spec, e.g. "data=8,model=2"; empty = all devices on the data axis.',
     )
     _define("bool", "profile", False, "Capture a jax.profiler trace window.")
+    _define(
+        "bool",
+        "deterministic",
+        False,
+        "Run-to-run determinism (enable_op_determinism analog): partitionable "
+        "threefry + highest matmul precision.",
+    )
 
 
 def define_legacy_cluster_flags():

@@ -1,0 +1,107 @@
+"""Transformer LM: the framework's growth-path example (no reference analog).
+
+The five reference workloads predate attention (SURVEY.md section 5.7); this
+CLI exists to exercise what the reference never could — the long-context and
+model-parallel axes of the framework:
+
+- ``--mesh "data=2,seq=2,model=2"``: data x sequence(ring attention) x
+  tensor(Megatron) parallelism in one run,
+- ``--attention flash``: the Pallas flash kernel (O(block) VMEM — sequence
+  length bounded by HBM, not by the [T, T] score matrix),
+- the same TrainSession/hooks/checkpoint/preemption machinery as the five
+  parity examples.
+
+Run: python examples/transformer_lm.py --batch_size=8 --seq_len=512 \
+         --train_steps=500 --attention=flash
+"""
+
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from absl import app, flags
+
+from distributed_tensorflow_examples_tpu import data, models, train
+from distributed_tensorflow_examples_tpu.utils.flags import (
+    define_legacy_cluster_flags,
+    define_training_flags,
+    resolve_legacy_cluster,
+)
+
+define_training_flags(default_batch_size=8, default_steps=500)
+define_legacy_cluster_flags()
+flags.DEFINE_integer("vocab_size", 8192, "Vocabulary size.")
+flags.DEFINE_integer("dim", 256, "Model width.")
+flags.DEFINE_integer("n_layers", 4, "Decoder blocks.")
+flags.DEFINE_integer("n_heads", 8, "Attention heads.")
+flags.DEFINE_integer("seq_len", 512, "Sequence length.")
+flags.DEFINE_enum(
+    "attention", "auto", ["auto", "xla", "flash"], "Per-chip attention impl."
+)
+flags.DEFINE_float("clip_norm", 1.0, "Global-norm gradient clip.")
+
+FLAGS = flags.FLAGS
+
+
+def main(argv):
+    del argv
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    import jax
+    import optax
+
+    info = resolve_legacy_cluster(FLAGS)
+    if info["is_legacy_ps_process"]:
+        print("job_name=ps: parameter servers are not needed on TPU; exiting 0.")
+        return
+
+    ids, vocab, source = data.datasets.text_corpus(
+        FLAGS.data_dir,
+        vocab_size=FLAGS.vocab_size,
+        synth_tokens=max(2_000_000, FLAGS.batch_size * (FLAGS.seq_len + 1) * 50),
+        seed=FLAGS.seed,
+    )
+    logging.info("corpus source: %s (%d tokens)", source, len(ids))
+
+    cfg = models.transformer.Config(
+        vocab_size=FLAGS.vocab_size,
+        dim=FLAGS.dim,
+        n_layers=FLAGS.n_layers,
+        n_heads=FLAGS.n_heads,
+        max_seq_len=FLAGS.seq_len,
+        attention=FLAGS.attention,
+    )
+    exp = train.Experiment(
+        init_fn=lambda rng: models.transformer.init(cfg, rng),
+        loss_fn=None,  # set after mesh exists (ring attention needs it)
+        optimizer=optax.chain(
+            optax.clip_by_global_norm(FLAGS.clip_norm),
+            optax.adamw(FLAGS.learning_rate),
+        ),
+        rules=models.transformer.SHARDING_RULES,
+        flags=FLAGS,
+        loss_fn_factory=lambda mesh: models.transformer.loss_fn(cfg, mesh=mesh),
+        batch_spec=models.transformer.batch_spec(),
+    )
+
+    # Per-host data shard: each host owns a disjoint block of the token
+    # stream and a disjoint block of batch rows (the Dataset.shard analog).
+    n_hosts = jax.process_count()
+    if FLAGS.batch_size % n_hosts:
+        raise ValueError(
+            f"--batch_size={FLAGS.batch_size} not divisible by {n_hosts} hosts"
+        )
+    local_rows = FLAGS.batch_size // n_hosts
+    block = len(ids) // n_hosts
+    local_ids = ids[jax.process_index() * block : (jax.process_index() + 1) * block]
+    it = data.datasets.lm_batches(
+        local_ids, batch_size=local_rows, seq_len=FLAGS.seq_len
+    )
+    exp.run(it)
+    m = exp.session.last_metrics
+    exp.finish(final_perplexity=float(m.get("perplexity", 0.0)))
+
+
+if __name__ == "__main__":
+    app.run(main)

@@ -71,3 +71,31 @@ def test_transformer_parallel_matches_single_device():
     l1 = _run(mesh1, raw, ())
     l8 = _run(mesh8, raw, models.transformer.SHARDING_RULES, spec=P("data", "seq"))
     np.testing.assert_allclose(l1, l8, rtol=5e-4)
+
+
+def test_transformer_flash_under_mesh():
+    """attention='flash' on a dp x tp mesh (seq unsharded) routes through
+    the shard_map-wrapped Pallas kernel and matches the xla path."""
+    from jax.sharding import PartitionSpec as P
+
+    cfg_flash = models.transformer.Config(
+        vocab_size=128, dim=32, n_layers=1, n_heads=4, max_seq_len=64,
+        compute_dtype="float32", attention="flash",
+    )
+    cfg_xla = models.transformer.Config(
+        vocab_size=128, dim=32, n_layers=1, n_heads=4, max_seq_len=64,
+        compute_dtype="float32", attention="xla",
+    )
+    mesh = local_mesh_for_testing({"data": 2, "model": 2})
+    raw = _batches(2, b=4, t=32)
+    params = models.transformer.init(cfg_flash, jax.random.key(0))
+    from distributed_tensorflow_examples_tpu.data.pipeline import as_global as ag
+
+    b = ag(raw[0], mesh, spec=P("data", "seq"))
+    f_flash = jax.jit(
+        lambda p, x: models.transformer.apply(cfg_flash, p, x, mesh=mesh)
+    )
+    f_xla = jax.jit(lambda p, x: models.transformer.apply(cfg_xla, p, x, mesh=mesh))
+    o1 = f_flash(params, b["x"])
+    o2 = f_xla(params, b["x"])
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), rtol=2e-4, atol=2e-4)
