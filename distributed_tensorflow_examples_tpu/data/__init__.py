@@ -11,7 +11,11 @@ prefetch.  The reference's pipeline machinery (``Dataset.shard/batch/prefetch``,
   only its shard, batches are assembled into *global* sharded ``jax.Array``s
   via ``make_array_from_process_local_data``, with a depth-2 background
   prefetcher overlapping host->HBM transfer with the running step.
+- ``filestream`` — ``FileStreamPipeline``: the out-of-core path (datasets
+  larger than host RAM stream from shard files with a reader thread + decode
+  worker pool — tf.data's interleave/map/shard roles).
 """
 
 from .pipeline import InMemoryPipeline, prefetch_to_mesh  # noqa: F401
-from . import datasets  # noqa: F401
+from .filestream import FileStreamPipeline  # noqa: F401
+from . import datasets, filestream  # noqa: F401

@@ -14,6 +14,11 @@ the blueprint requires beyond reference parity:
 
 Pre-norm blocks, learned positional embedding, GELU MLP, weight-tied softmax
 optional.  Params stay f32; compute in bf16 on the MXU.
+
+Checkpoint-format note: the qkv kernel's output columns are interpreted
+head-major — (H, 3, head_dim) — so a TP shard owns whole heads (round-2
+change; round-1 checkpoints used (3, H, head_dim) and are incompatible:
+they restore without error but produce garbage attention).
 """
 
 from __future__ import annotations
@@ -80,10 +85,11 @@ def _flash_sharded(mesh: Mesh, q, k, v, *, causal: bool):
     spec = P("data", h_entry, None, None)
 
     from ..ops.flash_attention import flash_attention
+    from ..parallel import collectives
 
     fn = lambda q, k, v: flash_attention(q, k, v, causal=causal)
-    return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    return collectives.shard_map(
+        fn, mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
 
 
@@ -134,9 +140,14 @@ def apply(cfg: Config, params, x, *, mesh: Mesh | None = None):
         p = params[f"block_{i}"]
         y = _layernorm(p["ln1"], h)
         qkv = layers.dense(p["qkv"], y, dtype=cfg.dtype)  # [B,T,3D]
-        qkv = qkv.reshape(B, T, 3, cfg.n_heads, cfg.head_dim)
+        # Interpret the 3D output columns as (H, 3, hd) — head-major — so a
+        # 'model'-axis shard of the column-parallel qkv kernel owns WHOLE
+        # heads (its q, k and v slices for those heads).  The (3, H, hd)
+        # layout would give a TP shard all of q plus part of k, forcing GSPMD
+        # to reshard every layer to satisfy P('data','model','seq',None).
+        qkv = qkv.reshape(B, T, cfg.n_heads, 3, cfg.head_dim)
         q, k, v = [
-            jnp.moveaxis(qkv[:, :, j], 2, 1) for j in range(3)
+            jnp.moveaxis(qkv[:, :, :, j], 2, 1) for j in range(3)
         ]  # [B,H,T,hd], heads shardable over 'model'
         q = constrain(q, P("data", "model", "seq", None))
         k = constrain(k, P("data", "model", "seq", None))

@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..parallel import collectives
+
 #: Finite "minus infinity" for masked logits: keeps the online-softmax
 #: recurrence NaN-free when a block is fully masked (exp(-1e30 - m) == 0 for
 #: any finite m), where a true -inf would produce inf-inf = NaN.
@@ -74,18 +76,14 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False):
     Shapes per shard: q/k/v [B, H, T_local, D]; the global sequence is the
     concatenation over the axis in index order.
     """
-    n = lax.axis_size(axis_name)
-    my = lax.axis_index(axis_name)
+    n = collectives.axis_size(axis_name)
+    my = collectives.axis_index(axis_name)
     t_local = q.shape[2]
     scale = 1.0 / math.sqrt(q.shape[-1])
     q32, dtype = q.astype(jnp.float32), q.dtype
     o = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
     m = jnp.full(q.shape[:3] + (1,), NEG_INF, jnp.float32)
     l = jnp.zeros(q.shape[:3] + (1,), jnp.float32)
-
-    # Receive-from-next rotation: after i hops we hold shard (my + i) % n's
-    # k/v.  Every shard does n identical hops => a clean ICI ring schedule.
-    perm = [(j, (j - 1) % n) for j in range(n)]
 
     def body(carry, i):
         o, m, l, k, v = carry
@@ -100,10 +98,12 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False):
             q_offset=my * t_local,
             k_offset=src * t_local,
         )
-        # Uniform rotation every step (the nth hop returns k/v to their
-        # owners; XLA drops it as dead code since the outputs are unused).
+        # Receive-from-next rotation (shift=-1): after i hops we hold shard
+        # (my + i) % n's k/v; every shard does n identical hops => a clean
+        # ICI ring schedule.  The nth hop returns k/v to their owners; XLA
+        # drops it as dead code since the outputs are unused.
         k, v = jax.tree.map(
-            lambda x: lax.ppermute(x, axis_name, perm=perm), (k, v)
+            lambda x: collectives.ring_permute(x, axis_name, shift=-1), (k, v)
         )
         return (o, m, l, k, v), None
 
@@ -133,7 +133,7 @@ def sequence_parallel_attention(
     spec = P(batch_axis, h_entry, seq_axis, None)
 
     fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
-    mapped = jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    mapped = collectives.shard_map(
+        fn, mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
     return mapped(q, k, v)

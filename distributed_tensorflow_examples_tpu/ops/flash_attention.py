@@ -264,19 +264,36 @@ def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
 _flash_bhd.defvjp(_flash_fwd_rule, _bwd)
 
 
+def _pick_block(t: int, want: int) -> int:
+    """Largest divisor of ``t`` that is <= ``want``: any T works (e.g. 640 ->
+    128 with the default 512), degrading to smaller tiles rather than raising
+    at trace time.  Degenerate divisors (prime-ish T -> tiny tiles) get a
+    warning: pad T to a multiple of 128 for MXU-shaped blocks."""
+    b = min(want, t)
+    while t % b:
+        b -= 1
+    if b < 128 <= t:
+        import warnings
+
+        warnings.warn(
+            f"flash_attention: seq len {t} has no block-sized divisor <= "
+            f"{want}; using {b}-row tiles (slow on TPU). Pad T to a multiple "
+            "of 128 for MXU-shaped blocks."
+        )
+    return b
+
+
 def flash_attention(
     q, k, v, *, causal: bool = False, block_q: int = 512, block_k: int = 512
 ):
     """Drop-in for ``ops.attention.mha``: q/k/v [B, H, T, D] -> [B, H, T, D].
 
-    Requires T divisible by the block sizes (caller pads or adjusts blocks);
+    Block sizes auto-shrink to the largest divisor of T (so any T traces);
     differentiable (custom FA2 VJP); runs interpreted off-TPU.
     """
     B, H, T, D = q.shape
-    bq = min(block_q, T)
-    bk = min(block_k, T)
-    if T % bq or T % bk:
-        raise ValueError(f"seq len {T} not divisible by blocks ({bq}, {bk})")
+    bq = _pick_block(T, block_q)
+    bk = _pick_block(T, block_k)
     fold = lambda x: x.reshape(B * H, T, D)
     o = _flash_bhd(fold(q), fold(k), fold(v), causal, bq, bk)
     return o.reshape(B, H, T, D)

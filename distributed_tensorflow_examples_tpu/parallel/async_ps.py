@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import threading
 from typing import Any, Callable, Iterator
 
@@ -55,6 +56,10 @@ class AsyncPSConfig:
     replicas_to_aggregate: int | None = None  # sync mode; default num_workers
     max_staleness: int | None = None  # async mode: drop grads older than this
     train_steps: int = 100
+    # Checkpoint/resume (SURVEY.md section 5.4: the reference's PS world
+    # recovered async runs from Saver checkpoints; same contract here).
+    ckpt_dir: str | None = None
+    checkpoint_every: int = 50  # applied updates between saves
 
 
 class AsyncPSTrainer:
@@ -87,6 +92,7 @@ class AsyncPSTrainer:
         self.history: list[tuple[int, int, float]] = []  # (worker, local_step, loss)
         self._history_lock = threading.Lock()
         self.total_dropped = 0
+        self._worker_excs: list[tuple[int, BaseException]] = []
 
         leaves, self._treedef = jax.tree.flatten(self.params)
         self._leaf_shapes = [l.shape for l in leaves]
@@ -129,6 +135,24 @@ class AsyncPSTrainer:
         return [np.asarray(g).reshape(-1) for g in jax.tree.leaves(grads)]
 
     def _worker(self, wid: int, batches: Iterator):
+        """Thread wrapper: a worker crash must not strand the chief in a
+        blocking ``acc.take()``/``gq.pop()`` — record, cancel, re-raise from
+        ``run()`` (the reference surfaced worker errors through sess.run)."""
+        try:
+            self._worker_body(wid, batches)
+        except BaseException as e:  # noqa: BLE001 — propagated via run()
+            self._worker_excs.append((wid, e))
+            self._stop.set()
+            self._cancel_services()
+
+    def _cancel_services(self) -> None:
+        self._tq.cancel()
+        for acc in self._accs:
+            acc.cancel()
+        if self._gq is not None:
+            self._gq.cancel()
+
+    def _worker_body(self, wid: int, batches: Iterator):
         it = 0
         while not self._stop.is_set():
             if self.cfg.mode == "sync_replicas":
@@ -177,20 +201,22 @@ class AsyncPSTrainer:
     def _chief_sync(self):
         n_agg = self.cfg.replicas_to_aggregate or self.cfg.num_workers
         acc = self._accs[0]
-        self._tq.push(0, self.cfg.num_workers)
-        for step in range(self.cfg.train_steps):
+        acc.set_global_step(self.global_step)
+        self._tq.push(self.global_step, self.cfg.num_workers)
+        for step in range(self.global_step, self.cfg.train_steps):
             out = acc.take(n_agg)
             if out is None:
                 return
             self._apply_update(self._unflatten_concat(out))
             acc.set_global_step(self.global_step)
-            if step + 1 < self.cfg.train_steps:
+            self._maybe_checkpoint()
+            if self.global_step < self.cfg.train_steps:
                 self._tq.push(self.global_step, self.cfg.num_workers)
 
     def _chief_async(self):
         # Each gradient applies individually, in arrival order — the W2
         # semantics (no coalescing; see module docstring).
-        for _ in range(self.cfg.train_steps):
+        for _ in range(self.global_step, self.cfg.train_steps):
             item = self._gq.pop()
             if item is None:
                 return
@@ -198,6 +224,58 @@ class AsyncPSTrainer:
             self._apply_update(self._unflatten_concat(flat))
             if self.cfg.max_staleness is not None:
                 self._gq.set_min_step(self.global_step - self.cfg.max_staleness)
+            self._maybe_checkpoint()
+
+    # -- checkpoint/resume (section 5.4) --------------------------------------
+
+    def _ckpt_state(self) -> dict:
+        return {
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "step": np.asarray(self.global_step),
+        }
+
+    def _maybe_checkpoint(self) -> None:
+        # <=1 (incl. the CheckpointSaverHook convention of 0) = every step.
+        every = max(1, self.cfg.checkpoint_every)
+        if self.cfg.ckpt_dir and self.global_step % every == 0:
+            self.save_checkpoint()
+
+    def save_checkpoint(self) -> None:
+        """Synchronous save of params+opt_state+step (chief thread only —
+        host-side state is small; sync keeps it race-free vs worker snapshots)."""
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(os.path.join(self.cfg.ckpt_dir, str(self.global_step)))
+        if os.path.exists(path):
+            return
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path, self._ckpt_state())
+
+    def restore_latest(self) -> bool:
+        """Restore newest checkpoint under ``cfg.ckpt_dir`` if any; returns
+        whether a restore happened.  ``run()`` calls this automatically."""
+        import orbax.checkpoint as ocp
+
+        d = self.cfg.ckpt_dir
+        if not d or not os.path.isdir(d):
+            return False
+        steps = sorted(
+            (int(n) for n in os.listdir(d) if n.isdigit()), reverse=True
+        )
+        if not steps:
+            return False
+        template = jax.tree.map(ocp.utils.to_shape_dtype_struct, self._ckpt_state())
+        with ocp.StandardCheckpointer() as ckptr:
+            restored = ckptr.restore(
+                os.path.abspath(os.path.join(d, str(steps[0]))), template
+            )
+        with self._params_lock:
+            self.params = jax.tree.map(np.asarray, restored["params"])
+            self.opt_state = restored["opt_state"]
+            self.global_step = int(restored["step"])
+        log.info("async-PS resumed from step %d", self.global_step)
+        return True
 
     # -- run -----------------------------------------------------------------
 
@@ -207,6 +285,9 @@ class AsyncPSTrainer:
             raise ValueError(
                 f"need {self.cfg.num_workers} batch iterators, got {len(batch_fns)}"
             )
+        self.restore_latest()
+        if self.global_step >= self.cfg.train_steps:
+            return self.params
         workers = [
             threading.Thread(target=self._worker, args=(i, batch_fns[i]), daemon=True)
             for i in range(self.cfg.num_workers)
@@ -220,13 +301,14 @@ class AsyncPSTrainer:
                 self._chief_async()
         finally:
             self._stop.set()
-            self._tq.cancel()
-            for acc in self._accs:
-                acc.cancel()
-            if self._gq is not None:
-                self._gq.cancel()
+            self._cancel_services()
             for w in workers:
                 w.join(timeout=10)
+        if self._worker_excs:
+            wid, exc = self._worker_excs[0]
+            raise RuntimeError(f"async-PS worker {wid} failed") from exc
+        if self.cfg.ckpt_dir:
+            self.save_checkpoint()
         self.total_dropped = sum(acc.dropped for acc in self._accs) + (
             self._gq.dropped if self._gq is not None else 0
         )

@@ -4,79 +4,53 @@ native communication layer.
 The reference's collective stack is hand-written C++ — ring all-reduce
 (``ring_reducer.h``), ring gather, hierarchical broadcast, permuter, NCCL
 bindings, plus a gRPC Send/Recv rendezvous data plane (SURVEY.md section 2b,
-D10/D11).  On TPU every one of those algorithms is *emitted by XLA* from a
-named-axis primitive and scheduled onto ICI links; this module is the thin,
-documented vocabulary used inside ``shard_map``-decorated code.  Outside
-``shard_map``, plain ``jit`` over sharded arrays makes XLA insert these
-automatically — prefer that; reach for explicit collectives only when
-hand-scheduling (ring attention, async-PS emulation).
+D10/D11).  On TPU every one of those algorithms is *emitted by XLA* and
+scheduled onto ICI links; almost all of the framework therefore never calls a
+collective by name — the sharded ``jit`` train step (train/step.py) makes
+GSPMD insert the all-reduces/gathers/reduce-scatters that the reference's
+C++ performs (verified at the HLO level by tests/test_hlo_sharding.py).
 
-Mapping (reference C++ -> here):
-- ring_reducer.h / NcclAllReduce      -> ``all_reduce`` / ``all_reduce_mean``
-- ring_gatherer.h                     -> ``all_gather``
-- hierarchical_tree_broadcaster.h     -> ``broadcast``
-- permuter.h                          -> ``ring_permute``
-- all_to_all.h / NcclAllToAll         -> ``all_to_all``
-- reduce-scatter phase of ring        -> ``reduce_scatter``
+Role mapping (reference C++ -> TPU-native):
+- ring_reducer.h / NcclAllReduce   -> GSPMD all-reduce from the sharded step
+- ring_gatherer.h                  -> GSPMD all-gather from sharding constraints
+- reduce-scatter ring phase        -> GSPMD reduce-scatter likewise
+- permuter.h                       -> ``ring_permute`` below (hand-scheduled
+                                      ring attention is the one consumer that
+                                      genuinely needs an explicit schedule)
+- hierarchical_tree_broadcaster.h  -> jax.device_put / GSPMD replication
+
+This module keeps only the vocabulary that hand-scheduled ``shard_map`` code
+actually consumes (ops/attention.py ring, models/transformer.py flash
+sharding); everything XLA emits automatically was deliberately removed rather
+than exporting dead parity shims.
 """
 
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 
 
-def all_reduce(x, axis_name: str):
-    """Sum over the named mesh axis (XLA ``cross_replica_sum`` over ICI)."""
-    return lax.psum(x, axis_name)
-
-
-def all_reduce_mean(x, axis_name: str):
-    """Mean over the axis — the gradient-averaging step that the reference's
-    ``SyncReplicasOptimizer`` performs on accumulated grads (SURVEY.md D5)."""
-    return lax.pmean(x, axis_name)
-
-
-def all_gather(x, axis_name: str, *, axis: int = 0, tiled: bool = True):
-    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
-
-
-def reduce_scatter(x, axis_name: str, *, scatter_axis: int = 0, tiled: bool = True):
-    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis, tiled=tiled)
-
-
-def all_to_all(x, axis_name: str, *, split_axis: int, concat_axis: int, tiled: bool = True):
-    return lax.all_to_all(
-        x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled
-    )
-
-
 def axis_index(axis_name: str):
+    """This device's position along the named mesh axis."""
     return lax.axis_index(axis_name)
 
 
 def axis_size(axis_name: str):
+    """Number of devices along the named mesh axis."""
     return lax.axis_size(axis_name)
-
-
-def broadcast(x, axis_name: str, root: int = 0):
-    """Everyone receives ``root``'s value.  XLA lowers this to its tree/ring
-    broadcast — the hierarchical_tree_broadcaster.h role."""
-    src = lax.axis_index(axis_name) == root
-    zeros = jnp.zeros_like(x)
-    return lax.psum(jnp.where(src, x, zeros), axis_name)
 
 
 def ring_permute(x, axis_name: str, *, shift: int = 1):
     """Send to the neighbor ``shift`` hops around the axis ring; the building
-    block of ring attention / pipelined collectives (permuter.h role)."""
+    block of ring attention / pipelined collectives (permuter.h role).  XLA
+    lowers ``ppermute`` to neighbor ICI transfers."""
     n = lax.axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm=perm)
 
 
-def shard_map(fn, mesh, in_specs, out_specs, *, check_vma: bool = False):
+def shard_map(fn, mesh, *, in_specs, out_specs, check_vma: bool = False):
     """Project-standard wrapper over ``jax.shard_map`` (manual SPMD regions)."""
     return jax.shard_map(
         fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma

@@ -47,10 +47,17 @@ def path_of(key_path: tuple) -> str:
 
 
 def spec_for_path(path: str, rules: ShardingRules) -> PartitionSpec:
+    spec = match_rule(path, rules)
+    return REPLICATED if spec is None else spec
+
+
+def match_rule(path: str, rules: ShardingRules) -> PartitionSpec | None:
+    """First matching rule's spec, or None when NO rule matches (callers that
+    need to distinguish no-match from an explicit replicated rule)."""
     for pattern, spec in rules:
         if re.search(pattern, path):
             return spec
-    return REPLICATED
+    return None
 
 
 def _clamp_spec(spec: PartitionSpec, ndim: int, shape, mesh: Mesh) -> PartitionSpec:
@@ -75,12 +82,20 @@ def named_sharding(mesh: Mesh, *spec_entries) -> NamedSharding:
     return NamedSharding(mesh, P(*spec_entries))
 
 
-def sharding_tree(tree: Any, mesh: Mesh, rules: ShardingRules) -> Any:
+def sharding_tree(
+    tree: Any, mesh: Mesh, rules: ShardingRules, *, default_spec_fn=None
+) -> Any:
     """Pytree of ``NamedSharding`` matching ``tree`` — usable as jit
-    in/out shardings, checkpoint restore layouts, or device_put targets."""
+    in/out shardings, checkpoint restore layouts, or device_put targets.
+
+    ``default_spec_fn(path, leaf) -> PartitionSpec`` decides leaves NO rule
+    matches (the auto-partitioner hook, D4); default replicated."""
 
     def _one(key_path, leaf):
-        spec = spec_for_path(path_of(key_path), rules)
+        path = path_of(key_path)
+        spec = match_rule(path, rules)
+        if spec is None:
+            spec = default_spec_fn(path, leaf) if default_spec_fn else REPLICATED
         shape = getattr(leaf, "shape", ())
         spec = _clamp_spec(spec, len(shape), shape, mesh)
         return NamedSharding(mesh, spec)

@@ -15,6 +15,7 @@ from .state import TrainState, create_state, create_sharded_state  # noqa: F401
 from .step import build_eval_step, build_train_step  # noqa: F401
 from .loop import TrainSession  # noqa: F401
 from .runner import Experiment  # noqa: F401
+from .ps_experiment import run_ps_emulation, array_eval_fn, worker_count  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import hooks  # noqa: F401
 from . import preemption  # noqa: F401

@@ -68,10 +68,16 @@ class StepCounterHook(Hook):
         self.last_examples_per_sec_per_chip: float | None = None
 
     def begin(self, loop):
-        self._t0 = time.perf_counter()
-        self._s0 = loop.step
+        # Timing starts at the FIRST after_step, not here: the first step
+        # pays XLA compilation (tens of seconds), which would bias every
+        # short run's reported steps/sec down (round-1 review).
+        self._t0 = None
 
     def after_step(self, loop, metrics):
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+            self._s0 = loop.step
+            return
         if loop.step - self._s0 < self.every:
             return
         now = time.perf_counter()

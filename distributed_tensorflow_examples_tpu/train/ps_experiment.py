@@ -1,0 +1,141 @@
+"""CLI-level runner for the PS-emulation modes (SURVEY.md D5, section 3.1/3.2).
+
+One shared path so every example honors ``--sync_replicas`` uniformly
+(round-1 review: only cifar10_cnn did, and the token-gated ``sync_replicas``
+mode — W1's actual SyncReplicasOptimizer semantics — was reachable only from
+tests):
+
+- ``--sync_replicas=false``           -> async mode (W2: each worker's
+  gradient applies immediately, in arrival order).
+- ``--ps_emulation --sync_replicas``  -> token-gated sync_replicas mode (W1:
+  accumulate ``--replicas_to_aggregate`` grads, drop stale, chief applies,
+  workers proceed on tokens).
+
+Both run on ``parallel.async_ps.AsyncPSTrainer`` (native C++ accumulator /
+token-queue / gradient-queue services) with checkpoint/resume under
+``--log_dir`` and print the same scrapable FINAL line as ``Experiment``.
+
+Note on model_state: the emulation keeps non-parameter state (e.g. BatchNorm
+statistics) at its initial value — the reference's async-PS scripts hosted
+only *variables* on PS tasks; workloads with running statistics (W3) are not
+PS workloads in the reference either.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+log = logging.getLogger("dtx.ps_experiment")
+
+
+def worker_count(FLAGS) -> int:
+    """Emulated worker count from the legacy cluster flags (the ONE place
+    this is computed — CLIs that shard data per worker must use it too)."""
+    return max(2, len(FLAGS.worker_hosts.split(",")) if FLAGS.worker_hosts else 2)
+
+
+def run_ps_emulation(
+    *,
+    init_fn: Callable,
+    loss_fn: Callable,
+    optimizer,
+    batches_for_worker: Callable[[int, int], Iterator[dict]],
+    FLAGS,
+    mode: str,
+    eval_fn: Callable[[Any], dict[str, float]] | None = None,
+    model_state: Any = None,
+) -> Any:
+    """Run W1/W2 PS-emulation training; returns final params.
+
+    ``batches_for_worker(worker_id, local_batch_size, n_workers)`` yields
+    that worker's local batches (its data shard; the count is passed so data
+    sharding can never diverge from the thread count); ``eval_fn(params)``
+    computes final metrics for the FINAL line.
+    """
+    import jax
+
+    from ..parallel.async_ps import AsyncPSConfig, AsyncPSTrainer
+
+    n_workers = worker_count(FLAGS)
+    r2a = getattr(FLAGS, "replicas_to_aggregate", 0) or n_workers
+    log.info(
+        "PS emulation mode=%s: %d workers%s (native accumulator/token "
+        "services; semantics notes in parallel.async_ps)",
+        mode,
+        n_workers,
+        f", replicas_to_aggregate={r2a}" if mode == "sync_replicas" else "",
+    )
+    acfg = AsyncPSConfig(
+        num_workers=n_workers,
+        mode=mode,
+        replicas_to_aggregate=r2a,
+        max_staleness=getattr(FLAGS, "max_staleness", None) or None,
+        train_steps=FLAGS.train_steps,
+        ckpt_dir=os.path.join(FLAGS.log_dir, "ps_ckpt") if FLAGS.log_dir else None,
+        checkpoint_every=FLAGS.checkpoint_every_steps,
+    )
+    params = init_fn(jax.random.key(FLAGS.seed))
+    if isinstance(params, tuple):  # init_fn returning (params, model_state)
+        params, model_state = params
+    trainer = AsyncPSTrainer(
+        acfg,
+        loss_fn,
+        optimizer,
+        params,
+        model_state=model_state,
+        rng=jax.random.key(FLAGS.seed),
+    )
+    local_bs = max(1, FLAGS.batch_size // n_workers)
+    t0 = time.perf_counter()
+    final_params = trainer.run(
+        [
+            iter(batches_for_worker(w, local_bs, n_workers))
+            for w in range(n_workers)
+        ]
+    )
+    dt = time.perf_counter() - t0  # training window only (eval excluded)
+
+    metrics = eval_fn(final_params) if eval_fn is not None else {}
+    sps = trainer.global_step / dt if dt > 0 else 0.0
+    eps_per_chip = sps * local_bs / max(1, len(jax.devices()))
+    losses = [l for (_, _, l) in trainer.history] or [float("nan")]
+    parts = [
+        f"FINAL step={trainer.global_step}",
+        f"steps_per_sec={sps:.1f}",
+        f"examples_per_sec_per_chip={eps_per_chip:.0f}",
+        f"mode={mode}",
+        f"stale_dropped={trainer.total_dropped}",
+        f"first_loss={losses[0]:.4f}",
+        f"last_loss={losses[-1]:.4f}",
+    ]
+    for k, v in metrics.items():
+        parts.append(f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}")
+    print(" ".join(parts))
+    return final_params
+
+
+def array_eval_fn(apply_logits: Callable, test: dict[str, np.ndarray], batch_size: int):
+    """Standard accuracy eval over array test splits for the FINAL line."""
+    import jax
+
+    from ..models import layers
+
+    @jax.jit
+    def _acc(p, b):
+        return layers.accuracy(apply_logits(p, b), b["label"])
+
+    def eval_fn(params):
+        n = len(test["label"])
+        ebs = min(batch_size, n)
+        accs = [
+            float(_acc(params, {k: v[i : i + ebs] for k, v in test.items()}))
+            for i in range(0, (n // ebs) * ebs, ebs)
+        ]
+        return {"test_accuracy": float(np.mean(accs))}
+
+    return eval_fn

@@ -168,6 +168,10 @@ class Experiment:
         n = len(next(iter(arrays.values())))
         dp = self.mesh.shape.get("data", 1)
         ebs = min(batch_size or self.flags.batch_size, n // dp * dp)
+        # Round down to a multiple of the data-axis size: a --batch_size not
+        # divisible by dp (e.g. 100 on an 8-way mesh) must not crash eval
+        # after training completed.
+        ebs = (ebs // dp) * dp
         if ebs <= 0:
             return {}
         sums: dict[str, float] = {}

@@ -56,12 +56,19 @@ def create_sharded_state(
     *,
     mesh: Mesh,
     rules: ShardingRules = (),
+    auto_shard_min_bytes: int | None = None,
 ) -> tuple[TrainState, Any]:
     """Initialise the state *directly sharded*: the init function is jitted
     with ``out_shardings`` from the rule table, so large sharded parameters
     (e.g. W4's embedding table) are born distributed in mesh HBM and never
     materialise on one host — the analog of each PS task initialising only its
     own variables.
+
+    ``auto_shard_min_bytes`` opts into the D4 heuristic partitioner
+    (``parallel.partitioner.min_max_variable_partitioner``): any leaf NO rule
+    matches whose per-model-shard slice would still be at least this many
+    bytes gets its leading dim sharded over the ``model`` axis; smaller
+    leaves stay replicated.  Explicit rules always win.
 
     Returns ``(state, state_shardings)``; the shardings tree is reused as the
     train step's in/out shardings and the checkpoint restore layout.
@@ -77,7 +84,21 @@ def create_sharded_state(
             rng=rng,
         )
 
+    default_fn = None
+    if auto_shard_min_bytes is not None and mesh.shape.get("model", 1) > 1:
+        from ..parallel.partitioner import min_max_variable_partitioner
+
+        decide = min_max_variable_partitioner(auto_shard_min_bytes)
+        model_size = mesh.shape["model"]
+
+        def default_fn(path, leaf):
+            return decide(
+                getattr(leaf, "shape", ()),
+                getattr(getattr(leaf, "dtype", None), "itemsize", 4),
+                model_size,
+            )
+
     abstract = jax.eval_shape(_init, rng)
-    shardings = sharding_tree(abstract, mesh, rules)
+    shardings = sharding_tree(abstract, mesh, rules, default_spec_fn=default_fn)
     state = jax.jit(_init, out_shardings=shardings)(rng)
     return state, shardings

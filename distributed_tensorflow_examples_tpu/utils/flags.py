@@ -78,6 +78,28 @@ def define_legacy_cluster_flags():
     _define(
         "bool", "sync_replicas", True, "(legacy) SyncReplicasOptimizer on/off -> sync/async DP."
     )
+    _define(
+        "bool",
+        "ps_emulation",
+        False,
+        "Run the PS-emulation trainer even in sync mode: token-gated "
+        "SyncReplicasOptimizer semantics (accumulate/drop-stale/chief-apply/"
+        "token-dequeue) via the native accumulator service (D5).",
+    )
+    _define(
+        "integer",
+        "replicas_to_aggregate",
+        0,
+        "(legacy, sync_replicas) gradients to aggregate per update; 0 = "
+        "number of workers.",
+    )
+    _define(
+        "integer",
+        "max_staleness",
+        0,
+        "(async mode) drop gradients older than this many applied steps; "
+        "0 = unbounded (the reference's async behavior).",
+    )
 
 
 def resolve_legacy_cluster(FLAGS) -> dict:

@@ -1,0 +1,133 @@
+"""Compiled-HLO collective analysis (SURVEY.md section 6 scaling evidence).
+
+The reference's communication layer is observable: you can read
+``ring_reducer.h`` and count NCCL calls.  The TPU-native equivalent is
+XLA-emitted, so the observable artifact is the compiled HLO: this module
+parses ``compiled.as_text()`` and reports every cross-device collective
+(kind, result shape, bytes) so tests can assert sharding properties ("no
+full-table all-gather in the word2vec step") and the scaling analysis can
+model per-step communication volume vs device count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from collections import defaultdict
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "pred": 1,
+}
+
+#: Cross-device collectives XLA emits for SPMD programs.
+COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+    "collective-broadcast",
+)
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+@dataclasses.dataclass
+class Collective:
+    kind: str
+    shapes: list[str]  # e.g. ["f32[1024,128]"]
+    bytes: int  # total result payload
+    line: str  # the HLO line (trimmed), for debugging/asserts
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def parse_collectives(hlo_text: str) -> list[Collective]:
+    """Every collective instruction in an HLO module dump, with result bytes.
+
+    Handles variadic results (``(f32[..], f32[..]) all-reduce(...)``) and
+    ``X-start``/``X-done`` async pairs (the ``-start`` carries the shape;
+    ``-done`` lines are skipped to avoid double counting).
+    """
+    op_re = re.compile(
+        r"=\s*(.+?)\s+(" + "|".join(COLLECTIVE_OPS) + r")(-start|-done)?\("
+    )
+    out = []
+    for raw in hlo_text.splitlines():
+        line = raw.strip()
+        m = op_re.search(line)
+        if not m:
+            continue
+        result, op, suffix = m.groups()
+        if suffix == "-done":
+            continue  # async pair: the -start line carries the payload shape
+        shapes = _SHAPE_RE.findall(result)
+        if not shapes:
+            continue
+        sizes = [_shape_bytes(dt, dims) for dt, dims in shapes]
+        if suffix == "-start":
+            # Async form returns (operand, result, context...) — summing
+            # would double-count; the largest element is the payload.
+            total = max(sizes)
+        else:
+            total = sum(sizes)  # sync variadic tuple = genuinely N payloads
+        out.append(
+            Collective(
+                kind=op,
+                shapes=[f"{dt}[{dims}]" for dt, dims in shapes],
+                bytes=total,
+                line=line[:240],
+            )
+        )
+    return out
+
+
+def summarize(collectives: list[Collective]) -> dict:
+    """{kind: {"count": n, "bytes": total}} + grand totals."""
+    agg: dict = defaultdict(lambda: {"count": 0, "bytes": 0})
+    for c in collectives:
+        agg[c.kind]["count"] += 1
+        agg[c.kind]["bytes"] += c.bytes
+    agg = dict(agg)
+    agg["total"] = {
+        "count": sum(v["count"] for v in agg.values()),
+        "bytes": sum(v["bytes"] for v in agg.values()),
+    }
+    return agg
+
+
+def max_collective_bytes(hlo_text: str, kind: str | None = None) -> int:
+    """Largest single collective payload (optionally of one kind)."""
+    cs = parse_collectives(hlo_text)
+    if kind is not None:
+        cs = [c for c in cs if c.kind == kind]
+    return max((c.bytes for c in cs), default=0)
+
+
+def max_tensor_bytes(hlo_text: str, kind: str | None = None) -> int:
+    """Largest single TENSOR moved by any collective (XLA fuses many grads
+    into one variadic all-reduce, so per-op bytes overstate the largest
+    logical payload; per-tensor is the right unit for 'did a whole table
+    cross the mesh' assertions)."""
+    best = 0
+    for c in parse_collectives(hlo_text):
+        if kind is not None and c.kind != kind:
+            continue
+        for s in c.shapes:
+            m = _SHAPE_RE.match(s)
+            if m:
+                best = max(best, _shape_bytes(m.group(1), m.group(2)))
+    return best
+
+
+def compiled_step_hlo(step_fn, *example_args) -> str:
+    """Lower+compile a jitted step and return its optimized HLO text."""
+    return step_fn.lower(*example_args).compile().as_text()

@@ -36,7 +36,6 @@ FLAGS = flags.FLAGS
 def main(argv):
     del argv
     logging.basicConfig(level=logging.INFO, format="%(message)s")
-    import jax
     import optax
 
     info = resolve_legacy_cluster(FLAGS)
@@ -44,12 +43,79 @@ def main(argv):
         print("job_name=ps: parameter servers are not needed on TPU; exiting 0.")
         return
 
-    ds = data.datasets.cifar10(FLAGS.data_dir, seed=FLAGS.seed)
-    logging.info("cifar10 source: %s", ds.source)
+    # Out-of-core: a --data_dir of shard-*.npz chunks streams from disk
+    # (SURVEY.md T7); the .npz/pickle whole-dataset formats stay in-RAM.
+    shard_files = (
+        data.filestream.list_shards(FLAGS.data_dir) if FLAGS.data_dir else []
+    )
+    if shard_files:
+        # Never load the whole dataset when streaming; hold out the LAST
+        # shard as the test split (loaded alone — one chunk in RAM) so eval
+        # measures the streamed distribution, and train on the rest.
+        test_raw = data.filestream.load_chunk(shard_files[-1])
+        test = data.filestream.image_decode_fn(seed=FLAGS.seed)(test_raw)
+        if len(shard_files) > 1:
+            shard_files = shard_files[:-1]
+            held_out = "1 held-out eval shard"
+        else:
+            held_out = "eval REUSES the single train shard (memorization!)"
+        ds = data.datasets.ArrayDataset(
+            {}, test, f"stream:{FLAGS.data_dir}", num_classes=10
+        )
+        logging.info(
+            "cifar10 source: stream:%s (%d train shards, %s)",
+            FLAGS.data_dir, len(shard_files), held_out,
+        )
+    else:
+        ds = data.datasets.cifar10(FLAGS.data_dir, seed=FLAGS.seed)
+        logging.info("cifar10 source: %s", ds.source)
+
+    def worker_stream(w, bs, n_workers):
+        """Per-emulated-worker data shard: shard files stream out-of-core
+        (worker w plays host w of n_workers); otherwise in-RAM."""
+        if shard_files:
+            return iter(
+                data.FileStreamPipeline(
+                    shard_files,
+                    batch_size=bs * n_workers,
+                    decode_fn=data.filestream.image_decode_fn(
+                        augment=True, seed=FLAGS.seed
+                    ),
+                    seed=FLAGS.seed,
+                    process_index=w,
+                    process_count=n_workers,
+                )
+            )
+        return iter(
+            data.InMemoryPipeline(
+                ds.train, batch_size=bs, seed=FLAGS.seed + w,
+                process_index=0, process_count=1,
+            )
+        )
 
     cfg = models.cnn.Config()
-    if not FLAGS.sync_replicas:
-        return _run_async_ps(cfg, ds)
+    if not FLAGS.sync_replicas or FLAGS.ps_emulation:
+        # W2's true shape: async SGD, each (emulated) worker applying grads
+        # immediately to the host-hosted variables, coordinated by the native
+        # accumulator/token service; --ps_emulation keeps the token-gated
+        # sync mode available here too (parallel.async_ps has the semantics).
+        import optax as _optax
+
+        mode = "sync_replicas" if FLAGS.sync_replicas else "async"
+        train.run_ps_emulation(
+            init_fn=lambda rng: models.cnn.init(cfg, rng),
+            loss_fn=models.cnn.loss_fn(cfg),
+            optimizer=_optax.sgd(FLAGS.learning_rate),
+            batches_for_worker=worker_stream,
+            FLAGS=FLAGS,
+            mode=mode,
+            eval_fn=train.array_eval_fn(
+                lambda p, b: models.cnn.apply(cfg, p, b["image"]),
+                ds.test,
+                FLAGS.batch_size,
+            ),
+        )
+        return
 
     exp = train.Experiment(
         init_fn=lambda rng: models.cnn.init(cfg, rng),
@@ -58,81 +124,20 @@ def main(argv):
         rules=models.cnn.SHARDING_RULES,
         flags=FLAGS,
     )
-    pipe = data.InMemoryPipeline(ds.train, batch_size=FLAGS.batch_size, seed=FLAGS.seed)
+    if shard_files:
+        pipe = data.FileStreamPipeline(
+            shard_files,
+            batch_size=FLAGS.batch_size,
+            decode_fn=data.filestream.image_decode_fn(augment=True, seed=FLAGS.seed),
+            seed=FLAGS.seed,
+        )
+    else:
+        pipe = data.InMemoryPipeline(
+            ds.train, batch_size=FLAGS.batch_size, seed=FLAGS.seed
+        )
     exp.run(iter(pipe))
     metrics = exp.evaluate(ds.test)
     exp.finish(test_accuracy=metrics.get("accuracy", 0.0))
-
-
-def _run_async_ps(cfg, ds):
-    """W2's true shape: async SGD, each (emulated) worker applying grads to
-    the host-hosted variables immediately — coordinated by the native
-    accumulator/token service (parallel.async_ps; divergence notes there)."""
-    import jax
-    import numpy as np
-    import optax
-
-    from distributed_tensorflow_examples_tpu.parallel.async_ps import (
-        AsyncPSConfig,
-        AsyncPSTrainer,
-    )
-
-    n_workers = max(2, len(FLAGS.worker_hosts.split(",")) if FLAGS.worker_hosts else 2)
-    logging.info(
-        "--sync_replicas=false: async-PS emulation, %d workers "
-        "(see parallel.async_ps for semantics)", n_workers
-    )
-    acfg = AsyncPSConfig(
-        num_workers=n_workers, mode="async", train_steps=FLAGS.train_steps
-    )
-    params = models.cnn.init(cfg, jax.random.key(FLAGS.seed))
-    trainer = AsyncPSTrainer(
-        acfg,
-        models.cnn.loss_fn(cfg),
-        optax.sgd(FLAGS.learning_rate),
-        params,
-        rng=jax.random.key(FLAGS.seed),
-    )
-    import time as _time
-
-    t0 = _time.perf_counter()
-    local_bs = max(1, FLAGS.batch_size // n_workers)
-    its = [
-        iter(
-            data.InMemoryPipeline(
-                ds.train,
-                batch_size=local_bs,
-                seed=FLAGS.seed + w,
-                process_index=0,
-                process_count=1,
-            )
-        )
-        for w in range(n_workers)
-    ]
-    final_params = trainer.run(its)
-    dt = _time.perf_counter() - t0  # training window only (eval excluded)
-
-    # Final eval with the trained params.
-    eval_fn = jax.jit(
-        lambda p, b: models.layers.accuracy(models.cnn.apply(cfg, p, b["image"]), b["label"])
-    )
-    accs = []
-    ebs = min(FLAGS.batch_size, len(ds.test["label"]))
-    for i in range(0, (len(ds.test["label"]) // ebs) * ebs, ebs):
-        b = {k: v[i : i + ebs] for k, v in ds.test.items()}
-        accs.append(float(eval_fn(final_params, b)))
-    sps = trainer.global_step / dt if dt > 0 else 0.0
-    eps_per_chip = sps * local_bs / max(1, len(jax.devices()))
-    losses = [l for (_, _, l) in trainer.history] or [float("nan")]
-    # Same scrapable fields as Experiment.finish().
-    print(
-        f"FINAL step={trainer.global_step} "
-        f"steps_per_sec={sps:.1f} "
-        f"examples_per_sec_per_chip={eps_per_chip:.0f} "
-        f"stale_dropped={trainer.total_dropped} "
-        f"first_loss={losses[0]:.4f} last_loss={losses[-1]:.4f} "
-        f"test_accuracy={float(np.mean(accs)):.4f}"
-    )
 
 
 if __name__ == "__main__":

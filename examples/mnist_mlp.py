@@ -54,6 +54,31 @@ def main(argv):
     logging.info("mnist source: %s", ds.source)
 
     cfg = models.mlp.Config(hidden=tuple(int(h) for h in FLAGS.hidden_units))
+    if not FLAGS.sync_replicas or FLAGS.ps_emulation:
+        # W1 *is* SyncReplicasOptimizer: --ps_emulation runs its token-gated
+        # accumulate/drop-stale/chief-apply semantics on the native service;
+        # --sync_replicas=false selects the async (W2-style) apply path.
+        mode = "sync_replicas" if FLAGS.sync_replicas else "async"
+        train.run_ps_emulation(
+            init_fn=lambda rng: models.mlp.init(cfg, rng),
+            loss_fn=models.mlp.loss_fn(cfg),
+            optimizer=optax.sgd(FLAGS.learning_rate),
+            batches_for_worker=lambda w, bs, nw: iter(
+                data.InMemoryPipeline(
+                    ds.train, batch_size=bs, seed=FLAGS.seed + w,
+                    process_index=0, process_count=1,
+                )
+            ),
+            FLAGS=FLAGS,
+            mode=mode,
+            eval_fn=train.array_eval_fn(
+                lambda p, b: models.mlp.apply(cfg, p, b["image"]),
+                ds.test,
+                FLAGS.batch_size,
+            ),
+        )
+        return
+
     exp = train.Experiment(
         init_fn=lambda rng: models.mlp.init(cfg, rng),
         loss_fn=models.mlp.loss_fn(cfg),

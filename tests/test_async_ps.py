@@ -171,17 +171,56 @@ def test_sync_replicas_matches_sequential_sgd():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6)
 
 
-def test_async_staleness_bound_drops():
-    """max_staleness=0 forces every applied grad to be computed against the
-    newest params; concurrent workers then suffer drops, and training still
-    reaches the step target (the knob of SURVEY.md section 5.2)."""
-    tr = _make_trainer("async", steps=20, max_staleness=0, lr=0.02)
+def test_async_staleness_bound_drops_deterministically():
+    """max_staleness=0: drive one chief iteration by hand (pop -> apply ->
+    set_min_step, exactly ``_chief_async``'s body), then a gradient computed
+    against the pre-apply snapshot MUST drop — no thread race involved."""
+    tr = _make_trainer("async", steps=3, max_staleness=0, lr=0.02)
+    g = np.zeros(sum(tr._leaf_sizes), np.float32)
+    assert tr._gq.push(0, g)  # fresh: snapshot step == global step == 0
+    _, flat = tr._gq.pop()
+    tr._apply_update(tr._unflatten_concat(flat))  # global_step -> 1
+    tr._gq.set_min_step(tr.global_step - tr.cfg.max_staleness)
+    assert not tr._gq.push(0, g)  # stale snapshot: deterministically dropped
+    assert tr._gq.dropped == 1
+    assert tr._gq.push(1, g)  # fresh snapshot passes the gate
+
+
+def test_async_worker_exception_propagates():
+    """A worker crash (e.g. a broken batch iterator) must not strand the
+    chief in a blocking pop: run() raises instead of hanging (ADVICE r1)."""
+
+    def poison():
+        raise RuntimeError("boom")
+        yield  # pragma: no cover
+
+    tr = _make_trainer("async", steps=50, lr=0.02)
+    with pytest.raises(RuntimeError, match="worker"):
+        tr.run([_blob_batches(1), poison()])
+
+
+def test_async_ps_checkpoint_resume(tmp_path):
+    """Kill-and-restart: a second trainer with the same ckpt_dir resumes from
+    the saved step instead of starting over (SURVEY.md section 5.4)."""
+    d = str(tmp_path / "ps_ckpt")
+    tr = _make_trainer("async", steps=10, lr=0.02, ckpt_dir=d, checkpoint_every=5)
     tr.run([_blob_batches(1), _blob_batches(2)])
-    assert tr.global_step == 20
-    # With two racing workers and a zero staleness bound, at least one grad
-    # is typically dropped; assert only the mechanism is alive (counter >= 0
-    # and run completed) to avoid a flaky race assertion.
-    assert tr.total_dropped >= 0
+    assert tr.global_step == 10
+
+    # "Restart": fresh trainer, same dir, higher step target -> must resume
+    # from 10, not 0.
+    tr2 = _make_trainer("async", steps=12, lr=0.02, ckpt_dir=d, checkpoint_every=5)
+    assert tr2.restore_latest()
+    assert tr2.global_step == 10
+    for a, b in zip(jax.tree.leaves(tr.params), jax.tree.leaves(tr2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    tr2.run([_blob_batches(3), _blob_batches(4)])
+    assert tr2.global_step == 12
+
+    # Already-done target: run() returns immediately after restore.
+    tr3 = _make_trainer("async", steps=12, lr=0.02, ckpt_dir=d)
+    tr3.run([_blob_batches(5), _blob_batches(6)])
+    assert tr3.global_step == 12
 
 
 def test_gradient_queue_fifo_no_coalescing():

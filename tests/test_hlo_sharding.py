@@ -1,0 +1,153 @@
+"""HLO-level proof of the sharded-embedding path (SURVEY.md section 7
+hard-part #4, section 3.5).
+
+Round-1 review: the claim that the model-axis-sharded embedding tables
+compile to bounded ICI collectives (no full-[V,D] all-gather, no
+all-to-all blowup in the scatter-add backward) was asserted in docstrings
+but never checked.  These tests compile the real train steps on a
+data x model mesh at a vocab size where replication would be unmissable
+(100k x 128 f32 = 51 MB/table) and grep the optimized HLO.
+
+Observed collective pattern (asserted below): the forward gather and
+backward scatter-add stay at ACTIVATION scale (O(B*D) bytes — the rows
+actually touched), and gradient reduction happens on SHARD-sized pieces;
+nothing ever moves a whole [V,D] table across the mesh.
+"""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_examples_tpu import models, train
+from distributed_tensorflow_examples_tpu.data.pipeline import as_global
+from distributed_tensorflow_examples_tpu.utils import hlo_analysis
+
+
+def _compile_step(model_loss, opt, mesh, rules, state_init, batch, batch_spec=None):
+    state, shardings = train.create_sharded_state(
+        state_init, opt, jax.random.key(0), mesh=mesh, rules=rules
+    )
+    step = train.build_train_step(
+        model_loss, opt, mesh=mesh, state_shardings=shardings, batch_spec=batch_spec
+    )
+    gbatch = as_global(batch, mesh, spec=batch_spec)
+    return step.lower(state, gbatch).compile().as_text()
+
+
+def test_word2vec_sharded_table_no_full_allgather(mesh_4x2):
+    """W4 at vocab=100k on data=4 x model=2: the compiled step must never
+    all-gather (or otherwise move) a whole [V,D] table."""
+    cfg = models.word2vec.Config(vocab_size=100_000, dim=128)
+    opt = optax.sgd(0.1)
+    B = 256
+    rng = np.random.default_rng(0)
+    batch = {
+        "center": rng.integers(0, cfg.vocab_size, size=(B,)).astype(np.int32),
+        "context": rng.integers(0, cfg.vocab_size, size=(B,)).astype(np.int32),
+    }
+    hlo = _compile_step(
+        models.word2vec.loss_fn(cfg),
+        opt,
+        mesh_4x2,
+        models.word2vec.SHARDING_RULES,
+        lambda r: models.word2vec.init(cfg, r),
+        batch,
+    )
+    table_bytes = cfg.vocab_size * cfg.dim * 4  # 51.2 MB
+    cs = hlo_analysis.parse_collectives(hlo)
+    # The step is distributed (collectives exist)...
+    assert cs, "expected collectives in a 4x2-mesh step"
+    # Observed pattern (documents SURVEY section 3.5's TPU shape): the
+    # forward gather moves only the B rows touched (activation scale), and
+    # the backward is a dense scatter-add whose [V/tp, D] SHARD all-reduces
+    # over the data axis (the Megatron-standard dense embedding-grad
+    # reduction).  So: per-TENSOR, nothing full-table-sized ever crosses.
+    shard_bytes = table_bytes // mesh_4x2.shape["model"]
+    biggest_tensor = hlo_analysis.max_tensor_bytes(hlo)
+    assert biggest_tensor <= shard_bytes, (
+        f"a {biggest_tensor/1e6:.1f} MB tensor crossed the mesh (full table "
+        f"= {table_bytes/1e6:.1f} MB, shard = {shard_bytes/1e6:.1f} MB)"
+    )
+    # And the GSPMD failure mode specifically: no all-gather anywhere near
+    # table size (forward must gather rows, not replicate the table).
+    ag = hlo_analysis.max_tensor_bytes(hlo, "all-gather")
+    assert ag < table_bytes // 16, f"all-gather of {ag/1e6:.1f} MB"
+
+
+def test_word2vec_replicated_mesh_differs(mesh8):
+    """Control: on a pure-data mesh (no model axis) the rules clamp to
+    replicated; the forward gather is then local (still no table-sized
+    collective, but for the opposite reason — only grad all-reduce crosses).
+    This guards the test above against vacuously-passing parsers."""
+    cfg = models.word2vec.Config(vocab_size=10_000, dim=64)
+    B = 128
+    rng = np.random.default_rng(0)
+    batch = {
+        "center": rng.integers(0, cfg.vocab_size, size=(B,)).astype(np.int32),
+        "context": rng.integers(0, cfg.vocab_size, size=(B,)).astype(np.int32),
+    }
+    hlo = _compile_step(
+        models.word2vec.loss_fn(cfg),
+        optax.sgd(0.1),
+        mesh8,
+        models.word2vec.SHARDING_RULES,
+        lambda r: models.word2vec.init(cfg, r),
+        batch,
+    )
+    cs = hlo_analysis.parse_collectives(hlo)
+    assert cs, "data-parallel grad all-reduce expected"
+    # Replicated tables mean table-sized gradient ALL-REDUCE is expected
+    # here — the parser must see it (proves the 100k test could fail).
+    table_bytes = cfg.vocab_size * cfg.dim * 4
+    assert hlo_analysis.max_collective_bytes(hlo, "all-reduce") >= table_bytes // 4
+
+
+def test_transformer_megatron_no_full_weight_movement(mesh_4x2):
+    """Megatron TP rules: column/row-sharded kernels must never be gathered
+    whole; cross-device traffic stays at activation scale + shard-sized grad
+    reductions."""
+    cfg = models.transformer.Config(
+        vocab_size=8192, dim=256, n_layers=2, n_heads=8, max_seq_len=128,
+        compute_dtype="float32", attention="xla",
+    )
+    opt = optax.sgd(0.1)
+    B, T = 8, 128
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, size=(B, T + 1)).astype(np.int32)
+    batch = {"x": toks[:, :-1], "y": toks[:, 1:]}
+    hlo = _compile_step(
+        models.transformer.loss_fn(cfg, mesh=mesh_4x2),
+        opt,
+        mesh_4x2,
+        models.transformer.SHARDING_RULES,
+        lambda r: models.transformer.init(cfg, r),
+        batch,
+    )
+    emb_bytes = cfg.vocab_size * cfg.dim * 4  # 8.4 MB, the largest param
+    ag = hlo_analysis.max_collective_bytes(hlo, "all-gather")
+    assert ag < emb_bytes, f"all-gather of {ag/1e6:.1f} MB >= full table"
+    # Logits [B,T,V] are the legitimate big tensor; weights are bigger than
+    # any activation here only for emb/head, so a blanket bound works:
+    biggest = hlo_analysis.max_collective_bytes(hlo)
+    assert biggest <= max(emb_bytes, B * T * cfg.vocab_size * 4), (
+        f"unexpectedly large collective: {biggest/1e6:.1f} MB"
+    )
+
+
+def test_parser_sees_known_collectives():
+    """Unit check of the HLO parser on a synthetic dump."""
+    hlo = """
+  %ar = f32[1024,128]{1,0} all-reduce(f32[1024,128]{1,0} %x), replica_groups={}
+  %ag.1 = bf16[64,64]{1,0} all-gather(bf16[32,64]{1,0} %y), dimensions={0}
+  %cp = f32[8]{0} collective-permute(f32[8]{0} %z), source_target_pairs={{0,1}}
+  %tup = (f32[16]{0}, f32[16]{0}) all-reduce(f32[16]{0} %a, f32[16]{0} %b)
+  %done = f32[64,64]{1,0} all-gather-done(f32[64,64] %ag2)
+"""
+    cs = hlo_analysis.parse_collectives(hlo)
+    kinds = sorted(c.kind for c in cs)
+    assert kinds == ["all-gather", "all-reduce", "all-reduce", "collective-permute"]
+    s = hlo_analysis.summarize(cs)
+    assert s["all-reduce"]["count"] == 2
+    assert s["all-reduce"]["bytes"] == 1024 * 128 * 4 + 2 * 16 * 4
+    assert s["all-gather"]["bytes"] == 64 * 64 * 2

@@ -1,0 +1,177 @@
+"""Hook hardening (round-1 review): ProfilerHook trace windows incl. the
+unroll-straddle arithmetic, and StepCounterHook's compile-time exclusion.
+Also the D4 auto-partitioner wiring (create_sharded_state opt-in)."""
+
+import glob
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_examples_tpu import train
+from distributed_tensorflow_examples_tpu.train import hooks as hooks_lib
+from distributed_tensorflow_examples_tpu.train.loop import TrainSession
+
+
+class _FakeLoop:
+    """Minimal loop protocol for hook unit tests."""
+
+    def __init__(self, steps_per_call=1):
+        self.step = 0
+        self.steps_per_call = steps_per_call
+        self.records = {}
+
+    def record(self, **kv):
+        self.records.update(kv)
+
+
+# ----------------------------------------------------------------------------
+# ProfilerHook
+# ----------------------------------------------------------------------------
+
+
+def test_profiler_hook_writes_trace(tmp_path):
+    """A real jax.profiler window produces trace files under log_dir."""
+    hook = hooks_lib.ProfilerHook(str(tmp_path), start_step=2, num_steps=2)
+    loop = _FakeLoop()
+    x = jnp.ones((64, 64))
+    for _ in range(6):
+        hook.before_step(loop)
+        (x @ x).block_until_ready()
+        loop.step += 1
+        hook.after_step(loop, {})
+    hook.end(loop)
+    assert not hook._active
+    traces = glob.glob(str(tmp_path / "**" / "*.trace*"), recursive=True) + glob.glob(
+        str(tmp_path / "**" / "*.xplane.pb"), recursive=True
+    )
+    assert traces, f"no trace files under {tmp_path}: {list(tmp_path.rglob('*'))}"
+
+
+@pytest.mark.parametrize(
+    "steps_per_call,expect_windows",
+    [
+        (1, [(10, True), (15, False)]),  # plain: active inside [10, 15)
+        (4, [(8, True), (16, False)]),  # unroll=4 straddles the window
+        (32, [(0, True), (32, False)]),  # one call jumps clean over [10,15)
+    ],
+)
+def test_profiler_hook_straddle_arithmetic(steps_per_call, expect_windows, monkeypatch):
+    """The unroll-straddle check: the window activates for any call that
+    OVERLAPS [start, stop), even when step jumps over it entirely."""
+    events = []
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda d: events.append("start"))
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: events.append("stop"))
+    hook = hooks_lib.ProfilerHook("/tmp/unused", start_step=10, num_steps=5)
+    loop = _FakeLoop(steps_per_call=steps_per_call)
+    states = {}
+    for _ in range(0, 64, steps_per_call):
+        hook.before_step(loop)
+        states.setdefault(loop.step, hook._active)
+        loop.step += steps_per_call
+        hook.after_step(loop, {})
+    hook.end(loop)
+    assert events == ["start", "stop"], events  # exactly one window
+    for step, expected in expect_windows:
+        assert states.get(step, None) == expected, (step, states)
+
+
+# ----------------------------------------------------------------------------
+# StepCounterHook
+# ----------------------------------------------------------------------------
+
+
+def test_step_counter_excludes_first_step_compile_time():
+    """The first (compile-bearing) step must not enter the steps/sec window:
+    simulate a 0.5 s 'compile' step followed by fast steps and assert the
+    reported rate reflects only the fast ones."""
+    hook = hooks_lib.StepCounterHook(every_steps=5, batch_size=10)
+    loop = _FakeLoop()
+    hook.begin(loop)
+    # Step 1: slow (compile).  begin() must NOT have started the clock.
+    time.sleep(0.5)
+    loop.step += 1
+    hook.after_step(loop, {})  # starts the window here
+    for _ in range(5):
+        time.sleep(0.01)
+        loop.step += 1
+        hook.after_step(loop, {})
+    assert hook.last_steps_per_sec is not None
+    # 5 steps in ~0.05s -> ~100/s; including the 0.5s step would give <12/s.
+    assert hook.last_steps_per_sec > 30, hook.last_steps_per_sec
+    assert loop.records["steps_per_sec"] == hook.last_steps_per_sec
+
+
+def test_step_counter_in_session_excludes_compile(monkeypatch):
+    """Integration: through TrainSession, the recorded steps/sec ignores a
+    slow first call."""
+    calls = {"n": 0}
+
+    def step_fn(state, batch):
+        if calls["n"] == 0:
+            time.sleep(0.3)
+        calls["n"] += 1
+        return state, {"loss": jnp.float32(0.0)}
+
+    state = train.create_state(
+        lambda r: {"w": jnp.zeros((2,))}, optax.sgd(0.1), jax.random.key(0)
+    )
+    sess = TrainSession(
+        step_fn,
+        state,
+        hooks=[
+            hooks_lib.StopAtStepHook(8),
+            hooks_lib.StepCounterHook(every_steps=4, batch_size=4),
+        ],
+    )
+    sess.run(iter([{"x": np.zeros(1)}] * 100))
+    assert sess.records["steps_per_sec"] > 30, sess.records
+
+
+# ----------------------------------------------------------------------------
+# D4 auto-partitioner wiring (create_sharded_state opt-in)
+# ----------------------------------------------------------------------------
+
+
+def test_auto_shard_min_bytes_shards_big_leaves(mesh_4x2):
+    """Opt-in heuristic: a big rule-less table shards its leading dim over
+    'model'; a small bias stays replicated; explicit rules still win."""
+    from jax.sharding import PartitionSpec as P
+
+    def init(rng):
+        return {
+            "big_table": jnp.zeros((4096, 128), jnp.float32),  # 2 MB
+            "small_bias": jnp.zeros((128,), jnp.float32),  # 512 B
+            "ruled": jnp.zeros((4096, 128), jnp.float32),
+        }
+
+    state, shardings = train.create_sharded_state(
+        init,
+        optax.sgd(0.1),
+        jax.random.key(0),
+        mesh=mesh_4x2,
+        rules=((r"ruled", P(None, "model")),),
+        auto_shard_min_bytes=64 << 10,  # 64 KB/shard floor
+    )
+    p = shardings.params
+    assert p["big_table"].spec == P("model")  # auto-sharded
+    assert p["small_bias"].spec == P()  # too small
+    assert p["ruled"].spec == P(None, "model")  # explicit rule wins
+    # Optimizer slots (sgd has none, but step/rng leaves) stayed replicated.
+    assert shardings.step.spec == P()
+
+
+def test_auto_shard_off_by_default(mesh_4x2):
+    from jax.sharding import PartitionSpec as P
+
+    state, shardings = train.create_sharded_state(
+        lambda r: {"big_table": jnp.zeros((4096, 128), jnp.float32)},
+        optax.sgd(0.1),
+        jax.random.key(0),
+        mesh=mesh_4x2,
+    )
+    assert shardings.params["big_table"].spec == P()
