@@ -45,6 +45,12 @@ def init(cfg: Config, rng: jax.Array, *, image_size: int = 32, in_channels: int 
         params[f"dense_{j}"] = layers.dense_init(rngs[n_conv + j], din, dout)
         din = dout
     params["logits"] = layers.dense_init(rngs[-1], din, cfg.num_classes)
+    # Zero-init the softmax layer (the TF CIFAR tutorial uses stddev=1/192
+    # for the same reason): glorot-scale logits on 192 inputs start the loss
+    # at ~4.6 instead of ln(10), and the resulting ~50x-too-big first
+    # gradients collapse the relu stack to the uniform plateau (observed:
+    # 400 steps stuck at loss 2.303) or NaN outright at lr>=0.1.
+    params["logits"]["kernel"] = jnp.zeros_like(params["logits"]["kernel"])
     return params
 
 

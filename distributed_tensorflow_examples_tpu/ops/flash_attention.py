@@ -39,6 +39,23 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+#: q/k.T with K-dim contraction (dim 1 of both operands).
+_TRANS_B = (((1,), (1,)), ((), ()))
+
+
+def _dot_nt(a, b):
+    """a @ b.T at the MXU's native input rate: operands keep their storage
+    dtype (bf16 runs 8x the f32 rate on v5e) and accumulate in f32 via
+    ``preferred_element_type`` — f32-casting the inputs first (the r1 kernel)
+    silently ran every matmul at the f32 rate."""
+    return jax.lax.dot_general(a, b, _TRANS_B, preferred_element_type=jnp.float32)
+
+
+def _dot(a, b):
+    """a @ b, f32 accumulation; ``a`` is cast to ``b``'s dtype first (the
+    softmax weights are f32 — feed the MXU its native input width)."""
+    return jax.lax.dot(a.astype(b.dtype), b, preferred_element_type=jnp.float32)
+
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -78,17 +95,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *, scal
 
     @pl.when(jnp.logical_or(not causal, _visible(qi, kj, bq, bk)))
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
-        k = k_ref[0].astype(jnp.float32)  # [bk, d]
-        v = v_ref[0].astype(jnp.float32)
-        s = q @ k.T  # [bq, bk]
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]  # native dtype into the MXU
+        s = _dot_nt(q, k) * scale  # [bq, bk] f32
         if causal:
             s = _mask(s, qi, kj, bq, bk)
         m_prev, l_prev = m_sc[:], l_sc[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new) * (s > NEG_INF / 2)
         alpha = jnp.exp(m_prev - m_new)
-        acc_sc[:] = acc_sc[:] * alpha + p @ v
+        acc_sc[:] = acc_sc[:] * alpha + _dot(p, v)
         l_sc[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         m_sc[:] = m_new
 
@@ -145,18 +160,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc, *
 
     @pl.when(jnp.logical_or(not causal, _visible(qi, kj, bq, bk)))
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         lse = lse_ref[0]  # [bq, 1]
         delta = delta_ref[0]
-        s = q @ k.T
+        s = _dot_nt(q, k) * scale
         if causal:
             s = _mask(s, qi, kj, bq, bk)
         p = jnp.exp(s - lse) * (s > NEG_INF / 2)
-        ds = p * (do @ v.T - delta)
-        dq_sc[:] = dq_sc[:] + ds @ k
+        ds = p * (_dot_nt(do, v) - delta)
+        dq_sc[:] = dq_sc[:] + _dot(ds, k)
 
     @pl.when(kj == nk - 1)
     def _finish():
@@ -174,19 +186,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
     @pl.when(jnp.logical_or(not causal, _visible(qi, kj, bq, bk)))
     def _compute():
-        q = q_ref[0].astype(jnp.float32)  # unscaled
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
-        s = (q * scale) @ k.T
+        s = _dot_nt(q, k) * scale
         if causal:
             s = _mask(s, qi, kj, bq, bk)
         p = jnp.exp(s - lse) * (s > NEG_INF / 2)
-        dv_sc[:] = dv_sc[:] + p.T @ do
-        ds = p * (do @ v.T - delta)
-        dk_sc[:] = dk_sc[:] + (ds.T @ q) * scale
+        dv_sc[:] = dv_sc[:] + _dot(p.T, do)
+        ds = p * (_dot_nt(do, v) - delta)
+        dk_sc[:] = dk_sc[:] + _dot(ds.T, q) * scale
 
     @pl.when(qi == nq - 1)
     def _finish():

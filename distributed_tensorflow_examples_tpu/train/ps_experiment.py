@@ -44,7 +44,7 @@ def run_ps_emulation(
     init_fn: Callable,
     loss_fn: Callable,
     optimizer,
-    batches_for_worker: Callable[[int, int], Iterator[dict]],
+    batches_for_worker: Callable[[int, int, int], Iterator[dict]],
     FLAGS,
     mode: str,
     eval_fn: Callable[[Any], dict[str, float]] | None = None,

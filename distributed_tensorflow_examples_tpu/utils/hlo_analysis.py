@@ -74,9 +74,12 @@ def parse_collectives(hlo_text: str) -> list[Collective]:
             continue
         sizes = [_shape_bytes(dt, dims) for dt, dims in shapes]
         if suffix == "-start":
-            # Async form returns (operand, result, context...) — summing
-            # would double-count; the largest element is the payload.
-            total = max(sizes)
+            # Async form returns (operands..., results..., context...): the
+            # operand and result halves mirror each other, so half the tuple
+            # total is the payload (context scalars are ~0 bytes).  max()
+            # would count only the largest tensor of a variadic fused
+            # collective and undercount multi-tensor all-reduces badly.
+            total = sum(sizes) // 2 if len(sizes) > 1 else sizes[0]
         else:
             total = sum(sizes)  # sync variadic tuple = genuinely N payloads
         out.append(

@@ -39,11 +39,23 @@ def test_cnn_shapes_and_loss_falls(mesh8):
     ds = data.datasets.cifar10(None, seed=0)
     pipe = data.InMemoryPipeline(ds.train, batch_size=64, seed=0)
     it = iter(pipe)
-    batches = [as_global(next(it), mesh8) for _ in range(25)]
-    _, first, last = _train_some(
-        models.cnn, cfg, lambda r: models.cnn.init(cfg, r), batches, mesh8
+    opt = optax.sgd(0.1)
+    state, sh = train.create_sharded_state(
+        lambda r: models.cnn.init(cfg, r), opt, jax.random.key(0), mesh=mesh8, rules=()
     )
-    assert last < first * 0.8, (first, last)
+    step = train.build_train_step(
+        models.cnn.loss_fn(cfg), opt, mesh=mesh8, state_shardings=sh
+    )
+    losses = []
+    for _ in range(30):
+        state, m = step(state, as_global(next(it), mesh8))
+        losses.append(float(m["loss"]))
+    # Zero-init logits start the loss exactly at ln(10); any drop below it is
+    # real learning (the old 0.8x-relative gate only measured the decay of an
+    # inflated glorot-logits init).  Average the tail: single-batch losses
+    # are noisy at this scale.
+    assert abs(losses[0] - 2.3026) < 1e-3, losses[0]
+    assert sum(losses[-10:]) / 10 < 2.27, losses[-10:]
 
 
 # ----------------------------------------------------------------------------
@@ -240,3 +252,36 @@ def test_lstm_reset_carry():
     reset = models.lstm.reset_carry(carry)
     for leaf in jax.tree.leaves(reset):
         assert np.all(np.asarray(leaf) == 0)
+
+
+def test_resnet_s2d_stem_equals_conv7():
+    """The space-to-depth stem is an exact re-indexing of the 7x7/s2 conv
+    (models/resnet.py _stem_conv) — same outputs to f32 numerics."""
+    cfg7 = models.resnet.Config(num_classes=10, stage_sizes=(1,), width=8,
+                                compute_dtype="float32", stem="conv7")
+    cfgs = models.resnet.Config(num_classes=10, stage_sizes=(1,), width=8,
+                                compute_dtype="float32", stem="s2d")
+    p, s = models.resnet.init(cfg7, jax.random.key(1))
+    x = jax.random.normal(jax.random.key(2), (4, 64, 64, 3), jnp.float32)
+    y7, _ = models.resnet.apply(cfg7, p, s, x, train=False)
+    ys, _ = models.resnet.apply(cfgs, p, s, x, train=False)
+    np.testing.assert_allclose(np.asarray(y7), np.asarray(ys), rtol=2e-4, atol=2e-4)
+    # Odd spatial dims fall back to the literal conv (no crash).
+    xo = jax.random.normal(jax.random.key(3), (2, 33, 33, 3), jnp.float32)
+    yo, _ = models.resnet.apply(cfgs, p, s, xo, train=False)
+    assert yo.shape == (2, 10)
+
+
+def test_batchnorm_one_pass_stats_match_two_pass():
+    """E[x^2]-E[x]^2 must agree with jnp.var to f32 numerics (layers.batchnorm)."""
+    from distributed_tensorflow_examples_tpu.models import layers
+
+    x = jax.random.normal(jax.random.key(0), (32, 7, 7, 16), jnp.float32) * 3 + 1.5
+    p, s = layers.batchnorm_init(16)
+    _, new_s = layers.batchnorm(p, s, x, train=True, momentum=0.0)
+    np.testing.assert_allclose(
+        np.asarray(new_s["mean"]), np.asarray(jnp.mean(x, axis=(0, 1, 2))), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(new_s["var"]), np.asarray(jnp.var(x, axis=(0, 1, 2))), rtol=1e-4, atol=1e-4
+    )
