@@ -78,7 +78,25 @@ def _step_flops(compiled) -> float | None:
         return None
 
 
-def _bench(name, model_mod, cfg, optimizer, make_batch, *, steps, batch_per_chip, warmup):
+def _bench(
+    name,
+    model_mod,
+    cfg,
+    optimizer,
+    make_batch,
+    *,
+    steps,
+    batch_per_chip,
+    warmup,
+    loss_fn_factory=None,
+    init_fn_factory=None,
+    unit_per_example=1,
+):
+    """``unit_per_example``: how many headline units one batch row carries
+    (1 image for the conv nets, seq_len tokens for the LMs).  The factories
+    receive ``(mesh, global_batch)`` — mesh-dependent losses (ring
+    attention) and batch-shaped state (the LSTM carry) hook in there.
+    """
     import jax
     import numpy as np
 
@@ -88,15 +106,23 @@ def _bench(name, model_mod, cfg, optimizer, make_batch, *, steps, batch_per_chip
     n_chips = mesh.size
     global_batch = batch_per_chip * n_chips
 
+    init_fn = (
+        init_fn_factory(mesh, global_batch)
+        if init_fn_factory
+        else (lambda rng: model_mod.init(cfg, rng))
+    )
     state, shardings = train.create_sharded_state(
-        lambda rng: model_mod.init(cfg, rng),
+        init_fn,
         optimizer,
         jax.random.key(0),
         mesh=mesh,
         rules=model_mod.SHARDING_RULES,
     )
     step_fn = train.build_train_step(
-        model_mod.loss_fn(cfg), optimizer, mesh=mesh, state_shardings=shardings
+        loss_fn_factory(mesh, global_batch) if loss_fn_factory else model_mod.loss_fn(cfg),
+        optimizer,
+        mesh=mesh,
+        state_shardings=shardings,
     )
     rng = np.random.default_rng(0)
     batch = data.pipeline.as_global(make_batch(rng, global_batch), mesh)
@@ -110,7 +136,7 @@ def _bench(name, model_mod, cfg, optimizer, make_batch, *, steps, batch_per_chip
     except Exception:
         pass
     dt = _bench_step_loop(step_fn, state, batch, steps=steps, warmup=warmup)
-    images_per_sec = steps * global_batch / dt
+    images_per_sec = steps * global_batch * unit_per_example / dt
     out = {
         "model": name,
         "images_per_sec": images_per_sec,
@@ -171,6 +197,91 @@ def bench_resnet50(steps: int, batch_per_chip: int, image_size: int = 224):
     )
 
 
+def bench_transformer(steps: int, batch_per_chip: int, seq_len: int = 2048):
+    """Transformer LM tokens/sec/chip + MFU (flash attention on TPU)."""
+    import numpy as np
+    import optax
+
+    from distributed_tensorflow_examples_tpu import models
+
+    cfg = models.transformer.Config(
+        vocab_size=32000, dim=1024, n_layers=12, n_heads=16, max_seq_len=seq_len
+    )
+
+    def make_batch(rng: np.random.Generator, n: int):
+        toks = rng.integers(0, cfg.vocab_size, size=(n, seq_len + 1)).astype("int32")
+        return {"x": toks[:, :-1], "y": toks[:, 1:]}
+
+    return _bench(
+        "transformer",
+        models.transformer,
+        cfg,
+        optax.adamw(1e-3),
+        make_batch,
+        steps=steps,
+        batch_per_chip=batch_per_chip,
+        warmup=3,
+        loss_fn_factory=lambda mesh, _: models.transformer.loss_fn(cfg, mesh=mesh),
+        unit_per_example=seq_len,  # headline unit = tokens
+    )
+
+
+def bench_lstm(steps: int, batch_per_chip: int, seq_len: int = 20):
+    """W5 PTB LSTM tokens/sec/chip (batch rows x seq_len per step)."""
+    import numpy as np
+    import optax
+
+    from distributed_tensorflow_examples_tpu import models
+
+    cfg = models.lstm.Config(vocab_size=10000, dim=200, num_layers=2)
+
+    def make_batch(rng: np.random.Generator, n: int):
+        toks = rng.integers(0, cfg.vocab_size, size=(n, seq_len + 1)).astype("int32")
+        return {"x": toks[:, :-1], "y": toks[:, 1:]}
+
+    return _bench(
+        "ptb_lstm",
+        models.lstm,
+        cfg,
+        optax.sgd(1.0),
+        make_batch,
+        steps=steps,
+        batch_per_chip=batch_per_chip,
+        warmup=3,
+        init_fn_factory=lambda _, gb: (
+            lambda rng: models.lstm.init(cfg, rng, batch_size=gb)
+        ),
+        unit_per_example=seq_len,
+    )
+
+
+def bench_word2vec(steps: int, batch_per_chip: int):
+    """W4 skip-gram pairs/sec/chip (NCE, sharded-table workload)."""
+    import numpy as np
+    import optax
+
+    from distributed_tensorflow_examples_tpu import models
+
+    cfg = models.word2vec.Config(vocab_size=100_000, dim=256)
+
+    def make_batch(rng: np.random.Generator, n: int):
+        return {
+            "center": rng.integers(0, cfg.vocab_size, size=(n,)).astype("int32"),
+            "context": rng.integers(0, cfg.vocab_size, size=(n,)).astype("int32"),
+        }
+
+    return _bench(
+        "word2vec",
+        models.word2vec,
+        cfg,
+        optax.sgd(0.5),
+        make_batch,
+        steps=steps,
+        batch_per_chip=batch_per_chip,
+        warmup=5,
+    )
+
+
 def bench_mlp(steps: int, batch_per_chip: int):
     import optax
 
@@ -191,25 +302,49 @@ def bench_mlp(steps: int, batch_per_chip: int):
     )
 
 
+_UNITS = {
+    "resnet50": "images/sec/chip",
+    "mnist_mlp": "images/sec/chip",
+    "transformer": "tokens/sec/chip",
+    "ptb_lstm": "tokens/sec/chip",
+    "word2vec": "pairs/sec/chip",
+}
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="resnet50", choices=["resnet50", "mlp"])
+    ap.add_argument(
+        "--model",
+        default="resnet50",
+        choices=["resnet50", "mlp", "transformer", "lstm", "word2vec"],
+    )
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--batch-per-chip", type=int, default=None)
+    ap.add_argument("--seq-len", type=int, default=None)
     args = ap.parse_args()
 
     if args.model == "resnet50":
-        r = bench_resnet50(args.steps or 30, args.batch_per_chip or 128)
+        # Headline (BASELINE.md): per-chip batch 256 is the measured optimum.
+        r = bench_resnet50(args.steps or 30, args.batch_per_chip or 256)
+    elif args.model == "transformer":
+        r = bench_transformer(
+            args.steps or 10, args.batch_per_chip or 8, args.seq_len or 2048
+        )
+    elif args.model == "lstm":
+        r = bench_lstm(args.steps or 50, args.batch_per_chip or 256, args.seq_len or 20)
+    elif args.model == "word2vec":
+        r = bench_word2vec(args.steps or 50, args.batch_per_chip or 4096)
     else:
         r = bench_mlp(args.steps or 200, args.batch_per_chip or 1024)
-    metric = f"{r['model']}_images_per_sec_per_chip"
+    unit = _UNITS[r["model"]]
+    metric = f"{r['model']}_{unit.split('/')[0]}_per_sec_per_chip"
     value = round(r["images_per_sec_per_chip"], 1)
     print(
         json.dumps(
             {
                 "metric": metric,
                 "value": value,
-                "unit": "images/sec/chip",
+                "unit": unit,
                 "vs_baseline": _vs_baseline(metric, value),
                 "detail": {k: round(v, 4) if isinstance(v, float) else v for k, v in r.items()},
             }

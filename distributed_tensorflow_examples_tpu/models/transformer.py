@@ -44,6 +44,24 @@ class Config:
     causal: bool = True
     attention: str = "auto"  # "auto" | "xla" | "flash" (auto: flash on TPU)
     compute_dtype: str = "bfloat16"
+    #: >1 enables pipeline parallelism: blocks are STACKED (params carry a
+    #: leading layer dim sharded P('pipe')) and run under the GPipe schedule
+    #: of parallel.pipeline.  Requires n_layers % pipeline_stages == 0 and a
+    #: mesh whose 'pipe' axis == pipeline_stages.  Attention inside the
+    #: pipeline uses XLA mha (a Pallas call cannot sit on an auto axis of a
+    #: partial-manual shard_map); seq-axis ring attention likewise stays on
+    #: the non-pipelined path.
+    pipeline_stages: int = 1
+    #: GPipe microbatches per step (bubble = (S-1)/(M+S-1)).
+    microbatches: int = 4
+    #: >0 replaces every block's dense MLP with a mixture-of-experts FFN
+    #: (ops/moe.py): experts shard over the mesh 'expert' axis (GShard
+    #: dispatch -> all_to_all), top-k routing, Switch load-balance aux loss
+    #: added by loss_fn.  Not composable with pipeline_stages>1 (v1).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 1e-2
 
     @property
     def dtype(self):
@@ -93,8 +111,24 @@ def _flash_sharded(mesh: Mesh, q, k, v, *, causal: bool):
     )(q, k, v)
 
 
+def _moe_cfg(cfg: Config):
+    from ..ops import moe as moe_ops
+
+    return moe_ops.MoEConfig(
+        n_experts=cfg.moe_experts,
+        top_k=cfg.moe_top_k,
+        capacity_factor=cfg.moe_capacity_factor,
+    )
+
+
 def init(cfg: Config, rng: jax.Array):
     n = cfg.n_layers
+    if cfg.pipeline_stages > 1 and n % cfg.pipeline_stages:
+        raise ValueError(
+            f"n_layers={n} not divisible by pipeline_stages={cfg.pipeline_stages}"
+        )
+    if cfg.moe_experts > 0 and cfg.pipeline_stages > 1:
+        raise ValueError("moe_experts and pipeline_stages>1 do not compose (v1)")
     rngs = jax.random.split(rng, 4 * n + 3)
     params: dict = {
         "emb": layers.embedding_init(rngs[0], cfg.vocab_size, cfg.dim),
@@ -103,25 +137,110 @@ def init(cfg: Config, rng: jax.Array):
         "head": layers.dense_init(rngs[2], cfg.dim, cfg.vocab_size, use_bias=False),
     }
     h = cfg.dim * cfg.mlp_ratio
+    blocks = []
     for i in range(n):
         r = rngs[3 + 4 * i : 3 + 4 * (i + 1)]
-        params[f"block_{i}"] = {
+        b = {
             "ln1": _layernorm_init(cfg.dim),
             "qkv": layers.dense_init(r[0], cfg.dim, 3 * cfg.dim, use_bias=False),
             "proj": layers.dense_init(r[1], cfg.dim, cfg.dim, use_bias=False),
             "ln2": _layernorm_init(cfg.dim),
-            "mlp_in": layers.dense_init(r[2], cfg.dim, h),
-            "mlp_out": layers.dense_init(r[3], h, cfg.dim),
         }
+        if cfg.moe_experts > 0:
+            from ..ops import moe as moe_ops
+
+            b["moe"] = moe_ops.init(r[2], cfg.dim, h, _moe_cfg(cfg))
+        else:
+            b["mlp_in"] = layers.dense_init(r[2], cfg.dim, h)
+            b["mlp_out"] = layers.dense_init(r[3], h, cfg.dim)
+        blocks.append(b)
+    if cfg.pipeline_stages > 1:
+        # Pipeline mode: one stacked pytree (leading layer dim, sharded
+        # P('pipe') per sharding_rules) instead of per-layer keys.
+        from ..parallel import pipeline as pipeline_lib
+
+        params["blocks"] = pipeline_lib.stack_stages(blocks)
+    else:
+        for i, b in enumerate(blocks):
+            params[f"block_{i}"] = b
     return params
 
 
-def apply(cfg: Config, params, x, *, mesh: Mesh | None = None):
-    """x: [B, T] int32 -> logits [B, T, V].
+def _attention(cfg: Config, mesh, q, k, v, *, allow_custom: bool, warn: bool):
+    """Attention dispatch: seq-ring / flash / XLA mha (see apply)."""
+    T = q.shape[2]
+    if allow_custom and mesh is not None and mesh.shape.get("seq", 1) > 1:
+        # Sequence sharded: ring attention over the seq axis.  (Per-chip
+        # block compute is the ring's own online-softmax; an explicit
+        # --attention=flash does not apply here.)
+        if cfg.attention == "flash" and warn:
+            import warnings
+
+            warnings.warn(
+                "attention='flash' is overridden by sequence parallelism "
+                "(seq axis > 1 routes attention through the ppermute "
+                "ring); per-chip compute uses the ring's online softmax."
+            )
+        return attn_ops.sequence_parallel_attention(mesh, q, k, v, causal=cfg.causal)
+    if allow_custom and _use_flash(cfg, T):
+        if mesh is not None:
+            return _flash_sharded(mesh, q, k, v, causal=cfg.causal)
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=cfg.causal)
+    return attn_ops.mha(q, k, v, causal=cfg.causal)
+
+
+def _block(cfg: Config, p, h, *, mesh, constrain, allow_custom_attn=True, warn=False):
+    """One pre-norm decoder block: attention + (dense | MoE) FFN.
+
+    Returns ``(h, aux)``; ``aux`` is the MoE load-balance loss contribution
+    (0.0 for the dense MLP).
+    """
+    B, T = h.shape[0], h.shape[1]
+    y = _layernorm(p["ln1"], h)
+    qkv = layers.dense(p["qkv"], y, dtype=cfg.dtype)  # [B,T,3D]
+    # Interpret the 3D output columns as (H, 3, hd) — head-major — so a
+    # 'model'-axis shard of the column-parallel qkv kernel owns WHOLE
+    # heads (its q, k and v slices for those heads).  The (3, H, hd)
+    # layout would give a TP shard all of q plus part of k, forcing GSPMD
+    # to reshard every layer to satisfy P('data','model','seq',None).
+    qkv = qkv.reshape(B, T, cfg.n_heads, 3, cfg.head_dim)
+    q, k, v = [
+        jnp.moveaxis(qkv[:, :, :, j], 2, 1) for j in range(3)
+    ]  # [B,H,T,hd], heads shardable over 'model'
+    q = constrain(q, P("data", "model", "seq", None))
+    k = constrain(k, P("data", "model", "seq", None))
+    v = constrain(v, P("data", "model", "seq", None))
+    o = _attention(cfg, mesh, q, k, v, allow_custom=allow_custom_attn, warn=warn)
+    o = jnp.moveaxis(o, 1, 2).reshape(B, T, cfg.dim)
+    h = h + layers.dense(p["proj"], o, dtype=cfg.dtype)
+    h = constrain(h, P("data", "seq", None))
+
+    y = _layernorm(p["ln2"], h)
+    aux = jnp.float32(0.0)
+    if "moe" in p:
+        from ..ops import moe as moe_ops
+
+        y, aux = moe_ops.apply(p["moe"], y, _moe_cfg(cfg), dtype=cfg.dtype)
+        h = h + y
+    else:
+        y = layers.dense(p["mlp_in"], y, dtype=cfg.dtype)  # column-parallel
+        y = constrain(y, P("data", "seq", "model"))
+        y = jax.nn.gelu(y)
+        h = h + layers.dense(p["mlp_out"], y, dtype=cfg.dtype)  # row-parallel
+    return constrain(h, P("data", "seq", None)), aux
+
+
+def apply(cfg: Config, params, x, *, mesh: Mesh | None = None, return_aux=False):
+    """x: [B, T] int32 -> logits [B, T, V] (or (logits, moe_aux) with
+    ``return_aux``).
 
     With ``mesh``: activations carry sharding constraints
     ([B,T,D] -> P('data','seq',None)) so XLA partitions every dense op, and
     attention routes through the seq-axis ring when the mesh shards ``seq``.
+    With ``cfg.pipeline_stages > 1``: the block stack runs under the GPipe
+    schedule of ``parallel.pipeline`` over the mesh 'pipe' axis.
     """
     B, T = x.shape
 
@@ -136,66 +255,70 @@ def apply(cfg: Config, params, x, *, mesh: Mesh | None = None):
     h = h + params["pos"]["table"][:T].astype(cfg.dtype)[None]
     h = constrain(h, P("data", "seq", None))
 
-    for i in range(cfg.n_layers):
-        p = params[f"block_{i}"]
-        y = _layernorm(p["ln1"], h)
-        qkv = layers.dense(p["qkv"], y, dtype=cfg.dtype)  # [B,T,3D]
-        # Interpret the 3D output columns as (H, 3, hd) — head-major — so a
-        # 'model'-axis shard of the column-parallel qkv kernel owns WHOLE
-        # heads (its q, k and v slices for those heads).  The (3, H, hd)
-        # layout would give a TP shard all of q plus part of k, forcing GSPMD
-        # to reshard every layer to satisfy P('data','model','seq',None).
-        qkv = qkv.reshape(B, T, cfg.n_heads, 3, cfg.head_dim)
-        q, k, v = [
-            jnp.moveaxis(qkv[:, :, :, j], 2, 1) for j in range(3)
-        ]  # [B,H,T,hd], heads shardable over 'model'
-        q = constrain(q, P("data", "model", "seq", None))
-        k = constrain(k, P("data", "model", "seq", None))
-        v = constrain(v, P("data", "model", "seq", None))
-        if mesh is not None and mesh.shape.get("seq", 1) > 1:
-            # Sequence sharded: ring attention over the seq axis.  (Per-chip
-            # block compute is the ring's own online-softmax; an explicit
-            # --attention=flash does not apply here.)
-            if cfg.attention == "flash" and i == 0:
-                import warnings
+    if cfg.pipeline_stages > 1:
+        from ..parallel import pipeline as pipeline_lib
 
-                warnings.warn(
-                    "attention='flash' is overridden by sequence parallelism "
-                    "(seq axis > 1 routes attention through the ppermute "
-                    "ring); per-chip compute uses the ring's online softmax."
+        def constrain_in_manual(y, spec):
+            # Inside the partial-manual shard_map the context mesh marks
+            # 'pipe' Manual; a NamedSharding built from the concrete mesh
+            # (all-Auto) is rejected there.  The bare-PartitionSpec form
+            # resolves against the context mesh and constrains only the
+            # auto axes — exactly what the TP/DP specs name.
+            if mesh is None:
+                return y
+            return jax.lax.with_sharding_constraint(y, spec)
+
+        def stage_fn(rank_blocks, x):
+            # rank_blocks: this rank's layer slice (leading dim L/S); inside
+            # the partial-manual shard_map a Pallas call can't sit on an
+            # auto axis, so blocks use XLA attention here.  (MoE is barred
+            # from pipeline mode at init, so aux is always 0 here.)
+            def body(x, p):
+                x, _ = _block(
+                    cfg, p, x, mesh=mesh, constrain=constrain_in_manual,
+                    allow_custom_attn=False,
                 )
-            o = attn_ops.sequence_parallel_attention(mesh, q, k, v, causal=cfg.causal)
-        elif _use_flash(cfg, T):
-            if mesh is not None:
-                o = _flash_sharded(mesh, q, k, v, causal=cfg.causal)
-            else:
-                from ..ops.flash_attention import flash_attention
+                return x, None
 
-                o = flash_attention(q, k, v, causal=cfg.causal)
+            x, _ = jax.lax.scan(body, x, rank_blocks)
+            return x
+
+        if mesh is None:
+            h = stage_fn(params["blocks"], h)
         else:
-            o = attn_ops.mha(q, k, v, causal=cfg.causal)
-        o = jnp.moveaxis(o, 1, 2).reshape(B, T, cfg.dim)
-        h = h + layers.dense(p["proj"], o, dtype=cfg.dtype)
-        h = constrain(h, P("data", "seq", None))
-
-        y = _layernorm(p["ln2"], h)
-        y = layers.dense(p["mlp_in"], y, dtype=cfg.dtype)  # column-parallel
-        y = constrain(y, P("data", "seq", "model"))
-        y = jax.nn.gelu(y)
-        h = h + layers.dense(p["mlp_out"], y, dtype=cfg.dtype)  # row-parallel
-        h = constrain(h, P("data", "seq", None))
+            h = pipeline_lib.pipeline_apply(
+                mesh, stage_fn, params["blocks"], h,
+                microbatches=cfg.microbatches,
+            )
+        aux_total = jnp.float32(0.0)
+    else:
+        aux_total = jnp.float32(0.0)
+        for i in range(cfg.n_layers):
+            h, aux = _block(
+                cfg, params[f"block_{i}"], h, mesh=mesh, constrain=constrain,
+                warn=(i == 0),
+            )
+            aux_total = aux_total + aux
 
     h = _layernorm(params["ln_f"], h)
-    return layers.dense(params["head"], h, dtype=cfg.dtype)
+    logits = layers.dense(params["head"], h, dtype=cfg.dtype)
+    if return_aux:
+        return logits, aux_total
+    return logits
 
 
 def loss_fn(cfg: Config, *, mesh: Mesh | None = None):
     def f(params, model_state, batch, rng):
-        logits = apply(cfg, params, batch["x"], mesh=mesh)
-        loss = layers.softmax_cross_entropy(
+        logits, aux = apply(cfg, params, batch["x"], mesh=mesh, return_aux=True)
+        ce = layers.softmax_cross_entropy(
             logits.reshape(-1, cfg.vocab_size), batch["y"].reshape(-1)
         )
-        return loss, (model_state, {"loss": loss, "perplexity": jnp.exp(loss)})
+        metrics = {"loss": ce, "perplexity": jnp.exp(ce)}
+        loss = ce
+        if cfg.moe_experts > 0:
+            loss = ce + cfg.moe_aux_weight * aux
+            metrics["moe_aux"] = aux
+        return loss, (model_state, metrics)
 
     return f
 
@@ -205,15 +328,42 @@ def batch_spec() -> P:
     return P("data", "seq")
 
 
-#: Megatron-style TP rule table: qkv/mlp_in column-sharded (output dim),
-#: proj/mlp_out row-sharded (input dim); embedding + head over vocab.
-SHARDING_RULES: tuple = (
-    (r"block_\d+/qkv/kernel", P(None, "model")),
-    (r"block_\d+/proj/kernel", P("model", None)),
-    (r"block_\d+/mlp_in/kernel", P(None, "model")),
-    (r"block_\d+/mlp_in/bias", P("model")),
-    (r"block_\d+/mlp_out/kernel", P("model", None)),
+#: Megatron-style TP rules for ONE block: qkv/mlp_in column-sharded (output
+#: dim), proj/mlp_out row-sharded (input dim).  Patterns are block-relative;
+#: both layouts below derive from this single table.
+_BLOCK_RULES: tuple = (
+    (r"qkv/kernel", P(None, "model")),
+    (r"proj/kernel", P("model", None)),
+    (r"mlp_in/kernel", P(None, "model")),
+    (r"mlp_in/bias", P("model")),
+    (r"mlp_out/kernel", P("model", None)),
+)
+
+_TOP_RULES: tuple = (
     (r"emb/table", P("model", None)),
     (r"pos/table", P(None, None)),
     (r"head/kernel", P(None, "model")),
 )
+
+#: Per-layer storage (block_0, block_1, ...).
+SHARDING_RULES: tuple = (
+    tuple((rf"block_\d+/{pat}", spec) for pat, spec in _BLOCK_RULES) + _TOP_RULES
+)
+
+
+def _pipeline_rules() -> tuple:
+    # Stacked-block storage: leading layer dim shards over 'pipe' (each rank
+    # holds its stage's layers in HBM), inner dims keep the Megatron specs.
+    from ..parallel import pipeline as pipeline_lib
+
+    return pipeline_lib.stage_sharding_rules(_BLOCK_RULES, "blocks") + _TOP_RULES
+
+
+def sharding_rules(cfg: Config) -> tuple:
+    if cfg.pipeline_stages > 1:
+        return _pipeline_rules()
+    if cfg.moe_experts > 0:
+        from ..ops import moe as moe_ops
+
+        return moe_ops.SHARDING_RULES + SHARDING_RULES
+    return SHARDING_RULES

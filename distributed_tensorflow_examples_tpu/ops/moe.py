@@ -1,0 +1,166 @@
+"""Mixture-of-Experts FFN with expert parallelism over the ``expert`` axis.
+
+No reference analog (SURVEY.md §2b strategy table: EP "not needed" for
+parity) — provided because a complete TPU framework serves the axis, and
+because MoE is where the ``expert`` mesh axis and ``all_to_all`` earn their
+keep (the same role D11's ``collective_nccl_all_to_all.h`` plays in the
+reference's native layer).
+
+TPU-first formulation — the GShard/Mesh-TF einsum dispatch, not a gather
+loop: token->expert routing materialises as STATIC-shaped one-hot dispatch/
+combine tensors and three einsums, so XLA sees dense MXU work plus a
+layout change it lowers to ``all_to_all`` over the expert axis when the
+expert dim is sharded (dynamic shapes would fall off the MXU entirely).
+Capacity-bounded: each expert processes at most C tokens per step;
+overflow tokens are dropped (contribute zero) exactly as in Switch/GShard.
+
+Components:
+- top-k router (k=2 default) with renormalised gates,
+- capacity C = ceil(k*N/E * capacity_factor),
+- load-balance auxiliary loss (Switch eq. 4): E * sum_e f_e * p_e,
+- expert FFN: per-expert GELU MLP, weights stacked [E, ...] and sharded
+  ``P('expert', ...)`` so each rank holds only its experts (rules below).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..models import layers
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    #: Routing-group size (GShard's G): tokens route within fixed-size
+    #: groups so the dispatch tensor is [G, g, E, C_g] with C_g ~ k*g/E —
+    #: total memory O(N*g*k), NOT the O(N^2*k) of ungrouped [N, E, C]
+    #: dispatch (which OOMs at real sequence lengths).
+    group_size: int = 1024
+
+
+def init(rng, dim: int, hidden: int, moe: MoEConfig):
+    ks = jax.random.split(rng, 3)
+    E = moe.n_experts
+    # Per-expert glorot: fan_in/out of ONE expert's matrices.
+    w1 = jax.vmap(lambda k: layers.glorot_uniform(k, (dim, hidden)))(
+        jax.random.split(ks[0], E)
+    )
+    w2 = jax.vmap(lambda k: layers.glorot_uniform(k, (hidden, dim)))(
+        jax.random.split(ks[1], E)
+    )
+    return {
+        "router": {"kernel": layers.glorot_uniform(ks[2], (dim, E))},
+        "w1": w1,
+        "b1": jnp.zeros((E, hidden), jnp.float32),
+        "w2": w2,
+        "b2": jnp.zeros((E, dim), jnp.float32),
+    }
+
+
+def capacity(group_tokens: int, moe: MoEConfig) -> int:
+    c = math.ceil(moe.top_k * group_tokens / moe.n_experts * moe.capacity_factor)
+    return max(4, c)
+
+
+def _group(n: int, want: int) -> int:
+    """Largest divisor of ``n`` that is <= ``want`` (the routing-group size)."""
+    g = min(want, n)
+    while n % g:
+        g -= 1
+    return g
+
+
+def apply(p, x, moe: MoEConfig, *, dtype=None):
+    """x: [B, T, D] -> (y [B, T, D], aux_loss scalar f32).
+
+    Routing runs in f32 (softmax/top-k numerics); expert matmuls in
+    ``dtype`` (bf16 on TPU) like every other dense layer.  Tokens route
+    within groups of ``moe.group_size`` (capacity is per group), the GShard
+    construction that keeps the dispatch tensors linear in total tokens.
+    """
+    B, T, D = x.shape
+    E, k = moe.n_experts, moe.top_k
+    N = B * T
+    g = _group(N, moe.group_size)
+    G = N // g
+    C = capacity(g, moe)
+    tok = x.reshape(G, g, D)
+
+    logits = jnp.einsum("gnd,de->gne", tok.astype(jnp.float32), p["router"]["kernel"])
+    probs = jax.nn.softmax(logits, axis=-1)  # [G, g, E]
+
+    # Top-k expert choice per token; gates renormalised over the chosen k.
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)  # [G, g, k]
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+    )
+
+    # Position of each (token, choice) within its expert's per-group
+    # capacity buffer: rank by arrival order (cumsum over the one-hot),
+    # GShard's position-in-group; positions >= C are dropped.
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [G, g, k, E]
+    # Priority: every token's FIRST choice ranks before any second choice
+    # (GShard's ordering) — lay choices out [k, g] inside each group.
+    flat = onehot.transpose(0, 2, 1, 3).reshape(G, k * g, E)
+    pos_flat = jnp.cumsum(flat, axis=1) - flat
+    pos_in_expert = pos_flat.reshape(G, k, g, E).transpose(0, 2, 1, 3)
+    pos = jnp.sum(pos_in_expert * onehot, axis=-1)  # [G, g, k]
+    keep = pos < C
+    gate_vals = gate_vals * keep
+
+    # combine[g, n, e, c]: gate weight of token n at slot c of expert e.
+    slot = jax.nn.one_hot(
+        jnp.where(keep, pos, C).astype(jnp.int32), C, dtype=jnp.float32
+    )  # [G, g, k, C]
+    combine = jnp.einsum("gnke,gnkc->gnec", onehot, slot * gate_vals[..., None])
+    dispatch = jnp.einsum("gnke,gnkc->gnec", onehot, slot * keep[..., None])
+
+    cd = jnp.float32 if dtype is None else dtype
+    expert_in = jnp.einsum(
+        "gnec,gnd->egcd", dispatch.astype(cd), tok.astype(cd)
+    )  # [E, G, C, D] — expert x group: the all_to_all boundary (expert
+    # sharded over 'expert', groups follow the batch's 'data' sharding)
+    expert_in = _constrain_expert(expert_in)
+    h = jnp.einsum("egcd,edh->egch", expert_in, p["w1"].astype(cd))
+    h = jax.nn.gelu(h + p["b1"].astype(cd)[:, None, None, :])
+    out = jnp.einsum("egch,ehd->egcd", h, p["w2"].astype(cd))
+    out = out + p["b2"].astype(cd)[:, None, None, :]
+    out = _constrain_expert(out)
+    y = jnp.einsum("gnec,egcd->gnd", combine.astype(cd), out)
+
+    # Switch load-balance loss: E * sum_e (tokens routed to e / N) * mean_e
+    # router prob.  Uses the FIRST choice's routing fraction (Switch eq. 4),
+    # computed over ALL tokens (groups together).
+    frac = jnp.mean(onehot[:, :, 0, :], axis=(0, 1))  # [E]
+    mean_prob = jnp.mean(probs, axis=(0, 1))  # [E]
+    aux = E * jnp.sum(frac * mean_prob)
+
+    return y.reshape(B, T, D).astype(x.dtype), aux
+
+
+def _constrain_expert(t):
+    """Pin the expert dim's sharding when a mesh context is live (group/
+    capacity dims are left to propagation — the group count can be 1, which
+    must not be forced onto the 'data' axis)."""
+    try:
+        return jax.lax.with_sharding_constraint(t, P("expert", None, None, None))
+    except Exception:
+        return t  # no mesh context (pure CPU unit tests)
+
+
+#: Rule fragment for a block containing one MoE layer under prefix `moe/`.
+SHARDING_RULES: tuple = (
+    (r".*moe/router/kernel", P(None, None)),
+    (r".*moe/w1", P("expert", None, "model")),
+    (r".*moe/b1", P("expert", "model")),
+    (r".*moe/w2", P("expert", "model", None)),
+    (r".*moe/b2", P("expert", None)),
+)

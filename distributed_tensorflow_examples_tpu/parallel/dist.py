@@ -163,3 +163,151 @@ def barrier(name: str = "barrier") -> None:
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices(name)
+
+
+# ----------------------------------------------------------------------------
+# Failure detection: peer-heartbeat watchdog (SURVEY.md section 5.3)
+# ----------------------------------------------------------------------------
+
+#: Exit code a process uses when the watchdog declares a peer dead.  The
+#: supervisor (utils.supervisor) treats any nonzero exit as "restart me";
+#: a distinct code makes the cause greppable in task logs.
+EXIT_PEER_LOST = 83
+
+_watchdog_thread = None
+_watchdog_stop = None
+
+
+def start_watchdog(
+    *,
+    interval_s: float = 2.0,
+    grace_s: float = 10.0,
+    startup_grace_s: float = 120.0,
+    on_failure=None,
+):
+    """Detect dead peers and fail FAST instead of hanging in a collective.
+
+    The recovery model is the reference's (SURVEY.md section 5.3): crash-
+    restart, not elastic.  A restarted worker cannot rejoin a live
+    coordination service (the service and all XLA collectives are formed
+    over a fixed process set), so the correct behavior when any peer dies
+    is: every surviving process exits promptly (``EXIT_PEER_LOST``), the
+    per-task supervisor (``utils.supervisor``) relaunches the whole job with
+    the same TF_CONFIG, the coordination service re-forms, and training
+    auto-resumes from the last checkpoint (TrainSession auto-restore).
+    Without this, survivors block forever in the next all-reduce — the gloo/
+    ICI collective has no peer-death signal of its own.
+
+    Mechanism: every process overwrites ``dtx/hb/<idx>`` in the coordination
+    service's KV store with a local sequence number every ``interval_s``; a
+    monitor thread samples all peers every ``grace_s`` and declares any peer
+    whose counter stopped advancing dead.  Threads are daemons: a clean exit
+    0 needs no teardown.
+
+    A peer whose heartbeat value is ``"done"`` departed CLEANLY (it called
+    ``stop_watchdog()``, as ``Experiment.finish`` does) and is never
+    declared dead — without this, end-of-job skew between workers larger
+    than ``grace_s`` would kill survivors mid-final-checkpoint.  A peer that
+    NEVER publishes a first beat within ``startup_grace_s`` (it died between
+    joining the coordination service and its first beat, e.g. an init-time
+    OOM) is declared dead too — first-beat silence must not be an unbounded
+    blind spot.
+
+    ``on_failure(dead: list[int])`` overrides the default ``os._exit``.
+    Returns True if started (multi-process with a live client), else False.
+    """
+    global _watchdog_thread, _watchdog_stop
+    import threading
+    import time as _time
+
+    if _watchdog_thread is not None:
+        return True
+    client = getattr(jax._src.distributed.global_state, "client", None)
+    if client is None or jax.process_count() < 2:
+        return False
+    if grace_s < 3 * interval_s:
+        # A grace below ~3 beats would declare live peers dead whenever two
+        # monitor samples land inside one beat interval.
+        log.warning(
+            "watchdog: grace_s=%.1f < 3x interval_s=%.1f; clamping to %.1f",
+            grace_s, interval_s, 3 * interval_s,
+        )
+        grace_s = 3 * interval_s
+    idx, count = jax.process_index(), jax.process_count()
+    stop = threading.Event()
+
+    def _beat():
+        seq = 0
+        while not stop.is_set():
+            seq += 1
+            try:
+                client.key_value_set(f"dtx/hb/{idx}", str(seq), allow_overwrite=True)
+            except Exception:  # service shutting down: let the monitor decide
+                return
+            stop.wait(interval_s)
+
+    def _fail(dead: list[int]):
+        log.critical(
+            "watchdog: peer heartbeat lost for process(es) %s; exiting %d "
+            "for supervisor restart (a dead peer cannot rejoin a live "
+            "coordination service — the whole job restarts and auto-resumes "
+            "from the last checkpoint).",
+            dead,
+            EXIT_PEER_LOST,
+        )
+        os._exit(EXIT_PEER_LOST)
+
+    fail = on_failure or _fail
+
+    def _monitor():
+        last: dict[int, str] = {}
+        t0 = _time.monotonic()
+        while not stop.is_set():
+            stop.wait(grace_s)
+            if stop.is_set():
+                return
+            try:
+                pairs = dict(client.key_value_dir_get("dtx/hb/"))
+            except Exception:
+                return  # service gone (normal shutdown path)
+            now = {p: pairs.get(f"dtx/hb/{p}") for p in range(count) if p != idx}
+            dead = [
+                p
+                for p, seq in now.items()
+                if seq != "done"
+                and (
+                    (seq is not None and last.get(p) == seq)
+                    or (seq is None and _time.monotonic() - t0 > startup_grace_s)
+                )
+            ]
+            if dead:
+                fail(dead)
+                return
+            last.update({p: s for p, s in now.items() if s is not None})
+
+    _watchdog_stop = stop
+    _watchdog_thread = threading.Thread(target=_monitor, daemon=True, name="dtx-watchdog")
+    threading.Thread(target=_beat, daemon=True, name="dtx-heartbeat").start()
+    _watchdog_thread.start()
+    log.info(
+        "watchdog up: %d peers, beat %.1fs, grace %.1fs", count - 1, interval_s, grace_s
+    )
+    return True
+
+
+def stop_watchdog() -> None:
+    """Stop heartbeating and announce a CLEAN departure to the peers (they
+    must not treat this process's silence as a crash)."""
+    global _watchdog_thread, _watchdog_stop
+    if _watchdog_stop is not None:
+        _watchdog_stop.set()
+        client = getattr(jax._src.distributed.global_state, "client", None)
+        if client is not None:
+            try:
+                client.key_value_set(
+                    f"dtx/hb/{jax.process_index()}", "done", allow_overwrite=True
+                )
+            except Exception:
+                pass  # service already torn down
+    _watchdog_thread = None
+    _watchdog_stop = None

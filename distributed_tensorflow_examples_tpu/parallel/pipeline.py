@@ -1,0 +1,149 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis (GPipe schedule).
+
+No reference analog (the reference's five workloads are data-parallel /
+PS-sharded only — SURVEY.md §2b strategy table lists PP as "out of scope for
+parity; note only"); this module exists because a complete TPU framework
+must scale depth-wise past one chip's HBM, and because pipeline parallelism
+composes with the other axes this framework already serves (data/model/seq).
+
+TPU-first design:
+
+- The layer stack is STACKED: per-layer pytrees become one pytree whose
+  leaves carry a leading layer dim, sharded ``P('pipe')`` — each pipe rank
+  physically holds only its own stage's weights in HBM (the depth analog of
+  PS variable sharding).
+- The schedule is a ``lax.scan`` over ``M + S - 1`` ticks inside a
+  PARTIAL-MANUAL ``jax.shard_map``: manual over ``pipe`` only
+  (``axis_names={'pipe'}``) — stage handoff is an explicit ``ppermute``
+  ring over ICI — while ``data``/``seq``/``model`` stay AUTO axes, so the
+  stage body remains ordinary jnp code that GSPMD shards for dp/sp/tp.
+  This is the idiomatic JAX composition: hand-schedule exactly the axis
+  whose dataflow XLA cannot infer (the pipeline), delegate the rest.
+- Microbatching: the batch splits into ``M`` microbatches; bubble fraction
+  is ``(S-1)/(M+S-1)`` (GPipe).  The backward schedule is jax.grad applied
+  to the scan — reverse ticks with reversed ``ppermute``s, no hand-written
+  backward.
+- Each stage body is wrapped in ``jax.checkpoint``: activations are
+  rematerialised in the backward pipeline instead of being saved per tick
+  (the standard GPipe memory trade).
+
+Caveat (documented, enforced): a Pallas custom call cannot live on an AUTO
+axis inside a partial-manual shard_map, so blocks inside the pipeline use
+XLA attention (``ops.attention.mha``) rather than the flash kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import AXIS_PIPE
+
+
+def stack_stages(per_stage: list[Any]) -> Any:
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading stage dim."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
+
+
+def unstack_stages(stacked: Any, n: int) -> list[Any]:
+    return [jax.tree.map(lambda x: x[i], stacked) for i in range(n)]
+
+
+def pipeline_apply(
+    mesh: Mesh,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    h: jax.Array,
+    *,
+    microbatches: int,
+    axis: str = AXIS_PIPE,
+    remat: bool = True,
+):
+    """Run ``h`` through ``S`` pipeline stages; returns the final activations.
+
+    ``stacked_params``: pytree whose leaves carry a leading LAYER dim L
+    (L % S == 0), sharded ``P(axis)`` on that dim — each pipe rank holds
+    L/S consecutive layers.  ``stage_fn(rank_params, x) -> x`` is one
+    stage's forward; ``rank_params`` keeps the leading dim (length L/S),
+    so the stage body typically ``lax.scan``s over its local layers.
+    ``h``: [B, ...] activations; B must divide by ``microbatches``.
+
+    Differentiable end-to-end; the output is replicated over ``axis`` (last
+    rank's results are broadcast by a masked psum — one [B, ...] all-reduce
+    over the pipe axis per call).
+    """
+    S = mesh.shape.get(axis, 1)
+    if S == 1:
+        # No pipe axis: the whole stack is one "stage".
+        return stage_fn(stacked_params, h)
+
+    M = microbatches
+    B = h.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches={M}")
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+
+    # The shard_map BOUNDARY is f32 on both sides: a replicated (P())
+    # input's transpose inserts a psum of the cotangent over the manual
+    # axis, and a bf16 psum on a partial-manual axis crashes XLA CPU
+    # ("Invalid binary instruction opcode copy").  Casting at the boundary
+    # keeps every pipe-axis collective — fwd broadcast and bwd input
+    # cotangent — in f32; stage compute stays in the caller's dtype.
+    dtype = h.dtype
+    h_mb = h.reshape(M, B // M, *h.shape[1:]).astype(jnp.float32)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def pipelined(stage_params, h_mb):
+        # stage_params: this rank's layer slice (leading dim L/S).
+        r = lax.axis_index(axis)
+        n_ticks = M + S - 1
+
+        def tick(buf, t):
+            # Rank 0 injects a fresh microbatch; everyone else consumes the
+            # activation its predecessor pushed last tick.  Trailing ticks
+            # re-inject the last microbatch on rank 0 — bubble compute whose
+            # output is never collected (inherent GPipe bubble).
+            inject = lax.dynamic_index_in_dim(
+                h_mb, jnp.minimum(t, M - 1), axis=0, keepdims=False
+            )
+            x = jnp.where(r == 0, inject.astype(dtype), buf)
+            out = stage_fn(stage_params, x)
+            return lax.ppermute(out, axis, perm), out
+
+        buf0 = jnp.zeros(h_mb.shape[1:], dtype)
+        _, outs = lax.scan(tick, buf0, jnp.arange(n_ticks))
+        # Valid results live on the LAST rank at ticks S-1 .. S-1+M-1.
+        valid = lax.dynamic_slice_in_dim(outs, S - 1, M, axis=0)
+        mask = (r == S - 1).astype(jnp.float32)
+        return lax.psum(valid.astype(jnp.float32) * mask, axis)
+
+    out_mb = jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        axis_names={axis},
+        check_vma=False,
+    )(stacked_params, h_mb)
+    return out_mb.reshape(B, *h.shape[1:]).astype(dtype)
+
+
+def stage_sharding_rules(inner_rules: tuple, prefix: str, axis: str = AXIS_PIPE) -> tuple:
+    """Lift a per-layer rule table onto stacked params: every leaf gains a
+    leading stage dim sharded over ``axis``; inner specs shift right.
+
+    ``(r"qkv/kernel", P(None, "model"))`` ->
+    ``(rf"{prefix}/qkv/kernel", P("pipe", None, "model"))``.
+    """
+    out = []
+    for pat, spec in inner_rules:
+        out.append((f"{prefix}/{pat}", P(axis, *spec)))
+    # Default: any stacked leaf not matched above still shards its stage dim.
+    out.append((f"{prefix}/.*", P(axis)))
+    return tuple(out)

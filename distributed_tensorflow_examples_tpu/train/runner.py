@@ -66,6 +66,11 @@ class Experiment:
                 "servers are not needed on TPU; exiting 0."
             )
             raise SystemExit(0)
+        if getattr(flags, "watchdog", True):
+            # Multi-process fail-fast (no-op single-process): a dead peer
+            # must crash the job promptly so the per-task supervisor can
+            # restart it — see utils.supervisor for the recovery story.
+            dist.start_watchdog(grace_s=getattr(flags, "watchdog_grace_secs", 10.0))
         self.mesh = mesh if mesh is not None else build_mesh(MeshSpec.parse(flags.mesh))
         log.info("mesh: %s over %d devices", dict(self.mesh.shape), self.mesh.size)
         if loss_fn is None:
@@ -202,3 +207,7 @@ class Experiment:
         self.writer.close()
         if self.ckpt is not None:
             self.ckpt.close()
+        # Announce clean departure: peers' watchdogs must not read this
+        # process's end-of-job silence as a crash (finish-time skew between
+        # workers can exceed the heartbeat grace).
+        dist.stop_watchdog()

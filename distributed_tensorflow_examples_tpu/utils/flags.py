@@ -59,6 +59,20 @@ def define_training_flags(default_batch_size: int = 128, default_steps: int = 10
     _define("bool", "profile", False, "Capture a jax.profiler trace window.")
     _define(
         "bool",
+        "watchdog",
+        True,
+        "Multi-process peer-heartbeat watchdog: exit fast (code 83) when a "
+        "peer dies instead of hanging in the next collective, so a "
+        "supervisor can restart the job (crash-restart recovery).",
+    )
+    _define(
+        "float",
+        "watchdog_grace_secs",
+        10.0,
+        "Heartbeat staleness after which a peer is declared dead.",
+    )
+    _define(
+        "bool",
         "deterministic",
         False,
         "Run-to-run determinism (enable_op_determinism analog): partitionable "

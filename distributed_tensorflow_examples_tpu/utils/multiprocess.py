@@ -60,7 +60,11 @@ class MultiProcessRunner:
         *,
         env: dict[str, str] | None = None,
         timeout: float = 120.0,
+        prelude: bool = True,
     ):
+        """``prelude=False`` skips the ``dist.initialize()`` header: the task
+        script manages (or delegates) cluster bootstrap itself — e.g. a
+        supervisor task whose *child* joins the coordination service."""
         self.n = num_processes
         self.timeout = timeout
         self.port = _free_port()
@@ -68,7 +72,10 @@ class MultiProcessRunner:
         repo_root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         )
-        script = _WORKER_PRELUDE.format(repo_root=repo_root) + worker_src
+        header = _WORKER_PRELUDE.format(repo_root=repo_root) if prelude else (
+            f"import sys\nsys.path.insert(0, {repo_root!r})\n"
+        )
+        script = header + worker_src
         self.script_path = os.path.join(self._dir, "worker.py")
         with open(self.script_path, "w") as f:
             f.write(script)

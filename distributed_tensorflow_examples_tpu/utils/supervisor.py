@@ -1,0 +1,106 @@
+"""Per-task supervisor: the whole-job crash-restart half of fault recovery.
+
+The reference's recovery model (SURVEY.md section 5.3) is crash-restart from
+checkpoint: non-chief workers blocked in ``wait_for_session``, the chief
+re-``prepare_session``-ed from the newest checkpoint.  The TPU-native analog
+has two parts:
+
+1. detection — ``parallel.dist.start_watchdog``: when any peer's heartbeat
+   stops, every surviving process exits ``EXIT_PEER_LOST`` promptly rather
+   than hanging in the next collective;
+2. restart — THIS module: each cluster task runs under ``supervise()``,
+   which relaunches its child with the same environment (same TF_CONFIG,
+   same flags) whenever it exits nonzero.  All tasks restart within one
+   grace period of each other, the coordination service re-forms over the
+   fixed process set, and ``TrainSession`` auto-resumes from the last
+   checkpoint.
+
+Single-worker *rejoin into a live job* is deliberately NOT supported: the
+coordination service and every compiled collective are formed over a fixed
+process set, so a restarted process cannot re-enter an existing incarnation
+(documented divergence shared with the reference, which was equally
+non-elastic).
+
+Usage (one per cluster task, e.g. from a launcher)::
+
+    python -m distributed_tensorflow_examples_tpu.utils.supervisor \
+        --max_restarts=3 -- python examples/mnist_mlp.py --log_dir=...
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import time
+
+log = logging.getLogger("dtx.supervisor")
+
+
+def supervise(
+    argv: list[str],
+    *,
+    max_restarts: int = 3,
+    backoff_s: float = 1.0,
+    env: dict[str, str] | None = None,
+) -> int:
+    """Run ``argv`` as a child process, restarting it on nonzero exit.
+
+    Returns the final exit code: 0 on eventual success, the child's last
+    code once ``max_restarts`` is exhausted.  Each restart logs the incident
+    and waits ``backoff_s`` (linearly growing) so all tasks of a job have
+    time to die before the new incarnation forms.
+    """
+    attempt = 0
+    while True:
+        proc = subprocess.run(argv, env=env)
+        if proc.returncode == 0:
+            if attempt:
+                log.info("supervise: child succeeded after %d restart(s)", attempt)
+            return 0
+        if attempt >= max_restarts:
+            log.error(
+                "supervise: child exited %d; restart budget (%d) exhausted",
+                proc.returncode,
+                max_restarts,
+            )
+            return proc.returncode
+        attempt += 1
+        delay = backoff_s * attempt
+        log.warning(
+            "supervise: child exited %d; restart %d/%d in %.1fs "
+            "(whole-job crash-restart — training auto-resumes from the last "
+            "checkpoint)",
+            proc.returncode,
+            attempt,
+            max_restarts,
+            delay,
+        )
+        time.sleep(delay)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    max_restarts, backoff = 3, 1.0
+    while argv and argv[0].startswith("--"):
+        flag = argv.pop(0)
+        if flag == "--":
+            break
+        key, _, val = flag.lstrip("-").partition("=")
+        if key == "max_restarts":
+            max_restarts = int(val)
+        elif key == "backoff_s":
+            backoff = float(val)
+        else:
+            print(f"supervisor: unknown flag {flag!r}", file=sys.stderr)
+            return 2
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    return supervise(argv, max_restarts=max_restarts, backoff_s=backoff, env=dict(os.environ))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

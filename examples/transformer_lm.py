@@ -41,6 +41,22 @@ flags.DEFINE_enum(
     "attention", "auto", ["auto", "xla", "flash"], "Per-chip attention impl."
 )
 flags.DEFINE_float("clip_norm", 1.0, "Global-norm gradient clip.")
+flags.DEFINE_integer(
+    "pipeline_stages",
+    1,
+    ">1 runs the block stack under the GPipe schedule over the mesh 'pipe' "
+    'axis (pass a matching --mesh, e.g. "data=2,pipe=4"); must divide '
+    "--n_layers.",
+)
+flags.DEFINE_integer("microbatches", 4, "GPipe microbatches per step.")
+flags.DEFINE_integer(
+    "moe_experts",
+    0,
+    ">0 swaps every block's MLP for a mixture-of-experts FFN sharded over "
+    'the mesh "expert" axis (pass e.g. --mesh "data=2,expert=4"); '
+    "top-2 routing, Switch aux loss.",
+)
+flags.DEFINE_float("moe_capacity_factor", 1.25, "Expert capacity factor.")
 
 FLAGS = flags.FLAGS
 
@@ -71,6 +87,10 @@ def main(argv):
         n_heads=FLAGS.n_heads,
         max_seq_len=FLAGS.seq_len,
         attention=FLAGS.attention,
+        pipeline_stages=FLAGS.pipeline_stages,
+        microbatches=FLAGS.microbatches,
+        moe_experts=FLAGS.moe_experts,
+        moe_capacity_factor=FLAGS.moe_capacity_factor,
     )
     exp = train.Experiment(
         init_fn=lambda rng: models.transformer.init(cfg, rng),
@@ -79,7 +99,7 @@ def main(argv):
             optax.clip_by_global_norm(FLAGS.clip_norm),
             optax.adamw(FLAGS.learning_rate),
         ),
-        rules=models.transformer.SHARDING_RULES,
+        rules=models.transformer.sharding_rules(cfg),
         flags=FLAGS,
         loss_fn_factory=lambda mesh: models.transformer.loss_fn(cfg, mesh=mesh),
         batch_spec=models.transformer.batch_spec(),

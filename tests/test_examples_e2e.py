@@ -210,6 +210,28 @@ def test_transformer_unroll(tmp_path):
     assert 0 < f["final_perplexity"] < 2 * 512, f
 
 
+def test_transformer_pipeline_parallel(tmp_path):
+    """Flagship on a data=2,pipe=2,model=2 mesh: GPipe pipeline from the CLI."""
+    out = _run(
+        "transformer_lm.py",
+        "--pipeline_stages=2",
+        "--microbatches=2",
+        "--mesh=data=2,pipe=2,model=2",
+        "--train_steps=8",
+        "--batch_size=8",
+        "--dim=64",
+        "--n_layers=4",
+        "--n_heads=4",
+        "--seq_len=64",
+        "--vocab_size=512",
+        "--attention=xla",
+        f"--log_dir={tmp_path}",
+    )
+    f = _final(out)
+    assert f["step"] == 8
+    assert 0 < f["final_perplexity"] < 2 * 512, f
+
+
 def test_legacy_ps_process_exits_zero():
     """The reference launches one process per PS task; ours must exit 0
     immediately with an explanation (CLI contract, SURVEY.md §5.6)."""
