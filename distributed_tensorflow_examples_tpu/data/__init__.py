@@ -18,4 +18,4 @@ prefetch.  The reference's pipeline machinery (``Dataset.shard/batch/prefetch``,
 
 from .pipeline import InMemoryPipeline, prefetch_to_mesh  # noqa: F401
 from .filestream import FileStreamPipeline  # noqa: F401
-from . import datasets, filestream  # noqa: F401
+from . import datasets, filestream, native_loader, streams  # noqa: F401

@@ -1,0 +1,232 @@
+"""Native (C++) out-of-core input pipeline: the tf.data C++ runtime slot.
+
+The reference's input layer is tf.data, whose hot path (file IO, shuffling,
+batch assembly) is C++ (SURVEY.md §2c T7: "5286 LoC, Py+C++").  The Python
+``FileStreamPipeline`` (data/filestream.py) covers the streaming role with
+threads; THIS module moves the hot path into ``native/dataloader.cc`` — a
+worker-pool + bounded-ring loader behind a C ABI — so batch assembly costs
+no GIL time at accelerator rates.
+
+Shard format: ``DTXRAW1`` raw-record files (fixed-size records, header-
+described fields) — written by :func:`write_raw_shards`, read by the C++
+core.  Decode/augment beyond raw assembly stays in Python (compose with
+``filestream.image_decode_fn`` downstream); normalization of u8 image bytes
+to f32 happens in numpy on the assembled batch view.
+
+Usage::
+
+    write_raw_shards(dir, {"image": x_u8, "label": y_i32}, shard_records=4096)
+    pipe = NativeFileStream(list_raw_shards(dir), batch_size=256, seed=0)
+    for batch in pipe:   # {"image": [B,32,32,3] u8, "label": [B] i32}
+        ...
+"""
+
+from __future__ import annotations
+
+import ctypes
+import glob
+import os
+from typing import Iterator
+
+import numpy as np
+
+_DTYPE_CODE = {np.dtype(np.uint8): 0, np.dtype(np.int32): 1, np.dtype(np.float32): 2}
+_CODE_DTYPE = {"u8": np.uint8, "i32": np.int32, "f32": np.float32}
+MAGIC = b"DTXRAW1\n"
+
+
+def write_raw_shards(
+    directory: str,
+    arrays: dict[str, np.ndarray],
+    *,
+    shard_records: int = 4096,
+    prefix: str = "shard",
+) -> list[str]:
+    """Split record-aligned arrays into DTXRAW1 shard files."""
+    os.makedirs(directory, exist_ok=True)
+    n = len(next(iter(arrays.values())))
+    fields = []
+    for name, a in arrays.items():
+        if len(a) != n:
+            raise ValueError(f"field {name!r} length {len(a)} != {n}")
+        if a.dtype not in _DTYPE_CODE:
+            raise ValueError(f"field {name!r}: unsupported dtype {a.dtype}")
+        fields.append((name, np.ascontiguousarray(a)))
+
+    def header() -> bytes:
+        out = [MAGIC, np.uint32(len(fields)).tobytes()]
+        for name, a in fields:
+            nb = name.encode()
+            out += [bytes([len(nb)]), nb, bytes([_DTYPE_CODE[a.dtype]])]
+            dims = a.shape[1:]
+            out += [bytes([len(dims)])] + [np.uint32(d).tobytes() for d in dims]
+        return b"".join(out)
+
+    paths = []
+    for si, start in enumerate(range(0, n, shard_records)):
+        stop = min(start + shard_records, n)
+        path = os.path.join(directory, f"{prefix}-{si:05d}.dtxr")
+        with open(path, "wb") as f:
+            f.write(header())
+            f.write(np.uint64(stop - start).tobytes())
+            # Record-major interleave, matching the C++ reader.
+            views = [a[start:stop].reshape(stop - start, -1) for _, a in fields]
+            for r in range(stop - start):
+                for v in views:
+                    f.write(v[r].tobytes())
+        paths.append(path)
+    return paths
+
+
+def list_raw_shards(directory: str, pattern: str = "shard-*.dtxr") -> list[str]:
+    return sorted(glob.glob(os.path.join(directory, pattern)))
+
+
+def read_raw_shard(path: str) -> dict[str, np.ndarray]:
+    """Host-side (numpy) read of ONE shard — for eval splits; the training
+    path goes through the C++ loader."""
+    with open(path, "rb") as f:
+        if f.read(8) != MAGIC:
+            raise ValueError(f"not a DTXRAW1 shard: {path}")
+        n_fields = int(np.frombuffer(f.read(4), np.uint32)[0])
+        fields = []
+        for _ in range(n_fields):
+            name_len = f.read(1)[0]
+            name = f.read(name_len).decode()
+            dtype = np.dtype([np.uint8, np.int32, np.float32][f.read(1)[0]])
+            ndim = f.read(1)[0]
+            shape = tuple(
+                int(np.frombuffer(f.read(4), np.uint32)[0]) for _ in range(ndim)
+            )
+            fields.append((name, dtype, shape))
+        n = int(np.frombuffer(f.read(8), np.uint64)[0])
+        raw = f.read()
+    rec_bytes = sum(
+        int(np.prod(s, dtype=np.int64)) * d.itemsize for _, d, s in fields
+    )
+    recs = np.frombuffer(raw, np.uint8, count=n * rec_bytes).reshape(n, rec_bytes)
+    out, off = {}, 0
+    for name, dtype, shape in fields:
+        nb = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        out[name] = (
+            np.ascontiguousarray(recs[:, off : off + nb])
+            .view(dtype)
+            .reshape((n, *shape))
+        )
+        off += nb
+    return out
+
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    from ..native import _load as _load_native  # builds libdtx_native.so on demand
+
+    lib = _load_native()
+    lib.dtx_dl_new.restype = ctypes.c_void_p
+    lib.dtx_dl_new.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.dtx_dl_schema.restype = ctypes.c_int
+    lib.dtx_dl_schema.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.dtx_dl_batch_bytes.restype = ctypes.c_int64
+    lib.dtx_dl_batch_bytes.argtypes = [ctypes.c_void_p]
+    lib.dtx_dl_next.restype = ctypes.c_int
+    lib.dtx_dl_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+    ]
+    lib.dtx_dl_error.restype = ctypes.c_int
+    lib.dtx_dl_error.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.dtx_dl_produced.restype = ctypes.c_int64
+    lib.dtx_dl_produced.argtypes = [ctypes.c_void_p]
+    lib.dtx_dl_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class NativeFileStream:
+    """Iterate DTXRAW1 shards through the C++ worker-pool loader.
+
+    Yields ``{field: np.ndarray}`` batches.  ``repeat=True`` streams epochs
+    forever (chunk order reshuffled per epoch, records shuffled per chunk —
+    both seeded).  Remainder batches are dropped (fixed shapes keep XLA from
+    recompiling).
+    """
+
+    def __init__(
+        self,
+        paths: list[str],
+        *,
+        batch_size: int,
+        n_workers: int = 2,
+        capacity: int = 8,
+        seed: int = 0,
+        repeat: bool = True,
+        timeout_s: float = 120.0,
+    ):
+        if not paths:
+            raise ValueError("no shard paths")
+        self._lib = _load()
+        arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
+        self._h = self._lib.dtx_dl_new(
+            arr, len(paths), batch_size, n_workers, capacity, seed,
+            int(repeat), 1,
+        )
+        if not self._h:
+            raise ValueError(f"cannot open DTXRAW1 shards: {paths[0]}")
+        self.batch_size = batch_size
+        self.timeout_s = timeout_s
+        buf = ctypes.create_string_buffer(4096)
+        if self._lib.dtx_dl_schema(self._h, buf, 4096) < 0:
+            raise RuntimeError("schema too large")
+        self.schema = []
+        for part in buf.value.decode().split(";"):
+            name, dt, dims = part.split(":")
+            shape = () if dims == "-" else tuple(int(d) for d in dims.split("x"))
+            self.schema.append((name, np.dtype(_CODE_DTYPE[dt]), shape))
+        self._batch_bytes = self._lib.dtx_dl_batch_bytes(self._h)
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        out = np.empty(self._batch_bytes, np.uint8)
+        ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        while True:
+            n = self._lib.dtx_dl_next(self._h, ptr, int(self.timeout_s * 1000))
+            if n == 0:
+                return
+            if n == -1:
+                raise TimeoutError(
+                    f"native loader: no batch within {self.timeout_s}s "
+                    "(starved or shard files unreadable)"
+                )
+            if n == -2:
+                err = ctypes.create_string_buffer(1024)
+                self._lib.dtx_dl_error(self._h, err, 1024)
+                raise RuntimeError(f"native loader: {err.value.decode()}")
+            batch, off = {}, 0
+            for name, dtype, shape in self.schema:
+                nbytes = n * int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+                batch[name] = (
+                    out[off : off + nbytes].view(dtype).reshape((n, *shape)).copy()
+                )
+                off += nbytes
+            yield batch
+
+    @property
+    def batches_produced(self) -> int:
+        return self._lib.dtx_dl_produced(self._h)
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.dtx_dl_free(self._h)
+            self._h = None
+
+    def __del__(self):  # noqa: D105
+        try:
+            self.close()
+        except Exception:
+            pass

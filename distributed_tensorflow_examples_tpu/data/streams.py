@@ -1,0 +1,127 @@
+"""Shared --data_dir resolution for the image CLIs (W2 cifar10, W3 resnet50).
+
+One place implements the three-way source selection every image example
+needs (SURVEY.md T7), so the CLIs cannot drift:
+
+- ``shard-*.dtxr``  -> NATIVE C++ loader (native/dataloader.cc),
+- ``shard-*.npz``   -> Python streaming pipeline (filestream),
+- anything else     -> in-RAM dataset from ``fallback()`` (real file or
+                       synthetic).
+
+The LAST shard is held out as the eval split (one chunk in RAM) so test
+accuracy measures the streamed distribution; a single-shard directory
+reuses it for eval with an explicit memorization warning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Iterator
+
+import numpy as np
+
+from . import datasets, filestream, native_loader
+from .pipeline import InMemoryPipeline
+
+log = logging.getLogger("dtx.data")
+
+
+@dataclasses.dataclass(frozen=True)
+class ImageSource:
+    kind: str  # "native" | "stream" | "memory"
+    ds: datasets.ArrayDataset  # .test always populated; .train only for memory
+    train_shards: list[str]
+
+
+def resolve_image_source(
+    data_dir: str | None,
+    *,
+    fallback: Callable[[], datasets.ArrayDataset],
+    seed: int,
+    num_classes: int,
+    name: str = "dataset",
+) -> ImageSource:
+    raw = native_loader.list_raw_shards(data_dir) if data_dir else []
+    if raw:
+        test = filestream.image_decode_fn(seed=seed)(
+            native_loader.read_raw_shard(raw[-1])
+        )
+        train, held = _holdout(raw)
+        log.info(
+            "%s source: native:%s (%d train shards, C++ loader, %s)",
+            name, data_dir, len(train), held,
+        )
+        return ImageSource(
+            "native",
+            datasets.ArrayDataset({}, test, f"native:{data_dir}", num_classes),
+            train,
+        )
+    npz = filestream.list_shards(data_dir) if data_dir else []
+    if npz:
+        test = filestream.image_decode_fn(seed=seed)(filestream.load_chunk(npz[-1]))
+        train, held = _holdout(npz)
+        log.info(
+            "%s source: stream:%s (%d train shards, %s)",
+            name, data_dir, len(train), held,
+        )
+        return ImageSource(
+            "stream",
+            datasets.ArrayDataset({}, test, f"stream:{data_dir}", num_classes),
+            train,
+        )
+    ds = fallback()
+    log.info("%s source: %s", name, ds.source)
+    return ImageSource("memory", ds, [])
+
+
+def _holdout(shards: list[str]) -> tuple[list[str], str]:
+    if len(shards) > 1:
+        return shards[:-1], "1 held-out eval shard"
+    return shards, "eval REUSES the single train shard (memorization!)"
+
+
+def train_iter(
+    src: ImageSource,
+    *,
+    batch_size: int,
+    seed: int,
+    augment: bool = True,
+    worker: int | None = None,
+    n_workers: int = 1,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Training batches of ``batch_size`` from the resolved source.
+
+    ``worker``/``n_workers``: the PS-emulation per-worker split — worker w
+    streams a disjoint shard subset (native) / row stride (stream) / its own
+    sample stream (memory), each with a worker-distinct seed.
+    """
+    w = 0 if worker is None else worker
+    decode = filestream.image_decode_fn(augment=augment, seed=seed)
+    if src.kind == "native":
+        shards = src.train_shards[w::n_workers] or src.train_shards
+        return (
+            decode(b)
+            for b in native_loader.NativeFileStream(
+                shards, batch_size=batch_size, seed=seed + w, repeat=True
+            )
+        )
+    if src.kind == "stream":
+        return iter(
+            filestream.FileStreamPipeline(
+                src.train_shards,
+                batch_size=batch_size * n_workers,
+                decode_fn=decode,
+                seed=seed,
+                process_index=w,
+                process_count=n_workers,
+            )
+        )
+    if worker is not None:
+        return iter(
+            InMemoryPipeline(
+                src.ds.train, batch_size=batch_size, seed=seed + w,
+                process_index=0, process_count=1,
+            )
+        )
+    return iter(InMemoryPipeline(src.ds.train, batch_size=batch_size, seed=seed))

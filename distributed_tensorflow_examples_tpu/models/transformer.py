@@ -258,6 +258,14 @@ def apply(cfg: Config, params, x, *, mesh: Mesh | None = None, return_aux=False)
     if cfg.pipeline_stages > 1:
         from ..parallel import pipeline as pipeline_lib
 
+        if mesh is not None and mesh.shape.get("pipe", 1) != cfg.pipeline_stages:
+            raise ValueError(
+                f"pipeline_stages={cfg.pipeline_stages} needs a mesh whose "
+                f"'pipe' axis is exactly that size; got "
+                f"{dict(mesh.shape)} (pass e.g. --mesh "
+                f'"data=...,pipe={cfg.pipeline_stages}")'
+            )
+
         def constrain_in_manual(y, spec):
             # Inside the partial-manual shard_map the context mesh marks
             # 'pipe' Manual; a NamedSharding built from the concrete mesh

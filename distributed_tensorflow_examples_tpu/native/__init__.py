@@ -28,9 +28,10 @@ def _load() -> ctypes.CDLL:
     with _build_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH) or os.path.getmtime(
-            _LIB_PATH
-        ) < os.path.getmtime(os.path.join(_DIR, "accumulator.cc")):
+        sources = ("accumulator.cc", "dataloader.cc")
+        if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < max(
+            os.path.getmtime(os.path.join(_DIR, s)) for s in sources
+        ):
             proc = subprocess.run(
                 ["make", "-s"], cwd=_DIR, capture_output=True, text=True
             )

@@ -238,12 +238,21 @@ def start_watchdog(
 
     def _beat():
         seq = 0
+        misses = 0
         while not stop.is_set():
             seq += 1
             try:
                 client.key_value_set(f"dtx/hb/{idx}", str(seq), allow_overwrite=True)
-            except Exception:  # service shutting down: let the monitor decide
-                return
+                misses = 0
+            except Exception as e:
+                # Transient RPC errors must NOT silently stop the heartbeat
+                # (peers would falsely declare us dead); retry next beat.
+                # Several consecutive failures = the service is gone
+                # (process-exit teardown) — stop quietly.
+                misses += 1
+                if misses >= 3:
+                    return
+                log.warning("watchdog: heartbeat publish failed (%s); retrying", e)
             stop.wait(interval_s)
 
     def _fail(dead: list[int]):
@@ -262,14 +271,26 @@ def start_watchdog(
     def _monitor():
         last: dict[int, str] = {}
         t0 = _time.monotonic()
+        misses = 0
         while not stop.is_set():
             stop.wait(grace_s)
             if stop.is_set():
                 return
             try:
                 pairs = dict(client.key_value_dir_get("dtx/hb/"))
-            except Exception:
-                return  # service gone (normal shutdown path)
+                misses = 0
+            except Exception as e:
+                # Retry transient KV errors — exiting here would silently
+                # disable failure detection for the rest of the run.  Three
+                # consecutive failures = service gone (shutdown teardown).
+                misses += 1
+                if misses >= 3:
+                    log.warning(
+                        "watchdog: coordination service unreachable 3x (%s); "
+                        "monitor disabled", e,
+                    )
+                    return
+                continue
             now = {p: pairs.get(f"dtx/hb/{p}") for p in range(count) if p != idx}
             dead = [
                 p

@@ -86,6 +86,7 @@ class Experiment:
             jax.random.key(flags.seed),
             mesh=self.mesh,
             rules=rules,
+            zero_opt_sharding=getattr(flags, "zero_opt", False),
         )
         self.step_fn = build_train_step(
             loss_fn,

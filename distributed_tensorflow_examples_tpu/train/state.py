@@ -57,6 +57,8 @@ def create_sharded_state(
     mesh: Mesh,
     rules: ShardingRules = (),
     auto_shard_min_bytes: int | None = None,
+    zero_opt_sharding: bool = False,
+    zero_min_elements: int = 65536,
 ) -> tuple[TrainState, Any]:
     """Initialise the state *directly sharded*: the init function is jitted
     with ``out_shardings`` from the rule table, so large sharded parameters
@@ -69,6 +71,15 @@ def create_sharded_state(
     matches whose per-model-shard slice would still be at least this many
     bytes gets its leading dim sharded over the ``model`` axis; smaller
     leaves stay replicated.  Explicit rules always win.
+
+    ``zero_opt_sharding`` (ZeRO-1, the T5X/praxis mechanism): every
+    still-replicated optimizer-state leaf of >= ``zero_min_elements`` whose
+    some dim divides the ``data`` axis gets that dim sharded over ``data``.
+    Params stay replicated — GSPMD then emits reduce-scatter(grads) ->
+    sharded optimizer update -> all-gather(params), cutting optimizer-state
+    HBM by the data-parallel degree with identical numerics.  The reference
+    has no analog (its PS *hosted* slot variables off-device; this is the
+    mesh-era version of not paying for optimizer state per replica).
 
     Returns ``(state, state_shardings)``; the shardings tree is reused as the
     train step's in/out shardings and the checkpoint restore layout.
@@ -100,5 +111,33 @@ def create_sharded_state(
 
     abstract = jax.eval_shape(_init, rng)
     shardings = sharding_tree(abstract, mesh, rules, default_spec_fn=default_fn)
+    if zero_opt_sharding and mesh.shape.get("data", 1) > 1:
+        shardings.opt_state = _zero_shard_opt(
+            shardings.opt_state, abstract.opt_state, mesh, zero_min_elements
+        )
     state = jax.jit(_init, out_shardings=shardings)(rng)
     return state, shardings
+
+
+def _zero_shard_opt(opt_shardings, abstract_opt, mesh: Mesh, min_elements: int):
+    """Shard replicated optimizer-state leaves over the 'data' axis (ZeRO-1)."""
+    import math
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dsize = mesh.shape["data"]
+
+    def one(sh, leaf):
+        shape = getattr(leaf, "shape", ())
+        if not shape or math.prod(shape) < min_elements:
+            return sh
+        if any(e is not None for e in sh.spec):
+            return sh  # already sharded by a rule (e.g. Megatron TP mirror)
+        for d, s in enumerate(shape):
+            if s % dsize == 0:
+                spec = [None] * len(shape)
+                spec[d] = "data"
+                return NamedSharding(mesh, P(*spec))
+        return sh
+
+    return jax.tree.map(one, opt_shardings, abstract_opt)

@@ -59,6 +59,14 @@ def define_training_flags(default_batch_size: int = 128, default_steps: int = 10
     _define("bool", "profile", False, "Capture a jax.profiler trace window.")
     _define(
         "bool",
+        "zero_opt",
+        False,
+        "ZeRO-1 optimizer-state sharding: shard replicated optimizer slots "
+        "over the data axis (reduce-scatter grads, sharded update, "
+        "all-gather params — identical numerics, 1/dp the optimizer HBM).",
+    )
+    _define(
+        "bool",
         "watchdog",
         True,
         "Multi-process peer-heartbeat watchdog: exit fast (code 83) when a "

@@ -87,13 +87,22 @@ def main(argv: list[str] | None = None) -> int:
         flag = argv.pop(0)
         if flag == "--":
             break
-        key, _, val = flag.lstrip("-").partition("=")
-        if key == "max_restarts":
-            max_restarts = int(val)
-        elif key == "backoff_s":
-            backoff = float(val)
-        else:
+        key, has_eq, val = flag.lstrip("-").partition("=")
+        if key not in ("max_restarts", "backoff_s"):
             print(f"supervisor: unknown flag {flag!r}", file=sys.stderr)
+            return 2
+        if not has_eq:  # space-separated form: --max_restarts 3
+            if not argv:
+                print(f"supervisor: flag {flag!r} needs a value", file=sys.stderr)
+                return 2
+            val = argv.pop(0)
+        try:
+            if key == "max_restarts":
+                max_restarts = int(val)
+            else:
+                backoff = float(val)
+        except ValueError:
+            print(f"supervisor: bad value for {flag!r}: {val!r}", file=sys.stderr)
             return 2
     if not argv:
         print(__doc__, file=sys.stderr)

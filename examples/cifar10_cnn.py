@@ -43,54 +43,22 @@ def main(argv):
         print("job_name=ps: parameter servers are not needed on TPU; exiting 0.")
         return
 
-    # Out-of-core: a --data_dir of shard-*.npz chunks streams from disk
-    # (SURVEY.md T7); the .npz/pickle whole-dataset formats stay in-RAM.
-    shard_files = (
-        data.filestream.list_shards(FLAGS.data_dir) if FLAGS.data_dir else []
+    # Out-of-core: shard-*.dtxr chunks stream through the NATIVE C++ loader,
+    # shard-*.npz through the Python pipeline, else in-RAM (SURVEY.md T7);
+    # source selection + eval-shard holdout shared in data.streams.
+    src = data.streams.resolve_image_source(
+        FLAGS.data_dir,
+        fallback=lambda: data.datasets.cifar10(FLAGS.data_dir, seed=FLAGS.seed),
+        seed=FLAGS.seed,
+        num_classes=10,
+        name="cifar10",
     )
-    if shard_files:
-        # Never load the whole dataset when streaming; hold out the LAST
-        # shard as the test split (loaded alone — one chunk in RAM) so eval
-        # measures the streamed distribution, and train on the rest.
-        test_raw = data.filestream.load_chunk(shard_files[-1])
-        test = data.filestream.image_decode_fn(seed=FLAGS.seed)(test_raw)
-        if len(shard_files) > 1:
-            shard_files = shard_files[:-1]
-            held_out = "1 held-out eval shard"
-        else:
-            held_out = "eval REUSES the single train shard (memorization!)"
-        ds = data.datasets.ArrayDataset(
-            {}, test, f"stream:{FLAGS.data_dir}", num_classes=10
-        )
-        logging.info(
-            "cifar10 source: stream:%s (%d train shards, %s)",
-            FLAGS.data_dir, len(shard_files), held_out,
-        )
-    else:
-        ds = data.datasets.cifar10(FLAGS.data_dir, seed=FLAGS.seed)
-        logging.info("cifar10 source: %s", ds.source)
+    ds = src.ds
 
     def worker_stream(w, bs, n_workers):
-        """Per-emulated-worker data shard: shard files stream out-of-core
-        (worker w plays host w of n_workers); otherwise in-RAM."""
-        if shard_files:
-            return iter(
-                data.FileStreamPipeline(
-                    shard_files,
-                    batch_size=bs * n_workers,
-                    decode_fn=data.filestream.image_decode_fn(
-                        augment=True, seed=FLAGS.seed
-                    ),
-                    seed=FLAGS.seed,
-                    process_index=w,
-                    process_count=n_workers,
-                )
-            )
-        return iter(
-            data.InMemoryPipeline(
-                ds.train, batch_size=bs, seed=FLAGS.seed + w,
-                process_index=0, process_count=1,
-            )
+        """Per-emulated-worker data shard (worker w plays host w)."""
+        return data.streams.train_iter(
+            src, batch_size=bs, seed=FLAGS.seed, worker=w, n_workers=n_workers
         )
 
     cfg = models.cnn.Config()
@@ -124,18 +92,9 @@ def main(argv):
         rules=models.cnn.SHARDING_RULES,
         flags=FLAGS,
     )
-    if shard_files:
-        pipe = data.FileStreamPipeline(
-            shard_files,
-            batch_size=FLAGS.batch_size,
-            decode_fn=data.filestream.image_decode_fn(augment=True, seed=FLAGS.seed),
-            seed=FLAGS.seed,
-        )
-    else:
-        pipe = data.InMemoryPipeline(
-            ds.train, batch_size=FLAGS.batch_size, seed=FLAGS.seed
-        )
-    exp.run(iter(pipe))
+    exp.run(
+        data.streams.train_iter(src, batch_size=FLAGS.batch_size, seed=FLAGS.seed)
+    )
     metrics = exp.evaluate(ds.test)
     exp.finish(test_accuracy=metrics.get("accuracy", 0.0))
 

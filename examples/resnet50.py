@@ -49,39 +49,23 @@ def main(argv):
         print("job_name=ps: parameter servers are not needed on TPU; exiting 0.")
         return
 
-    # Out-of-core path: --data_dir with shard-*.npz chunk files streams from
-    # disk (tf.data's real role — SURVEY.md T7); anything else falls back to
-    # the in-RAM synthetic stream.
-    shard_files = (
-        data.filestream.list_shards(FLAGS.data_dir) if FLAGS.data_dir else []
-    )
-    if shard_files:
-        # Hold out the LAST shard as the eval split (one chunk in RAM) so
-        # test accuracy measures the streamed distribution.
-        test_raw = data.filestream.load_chunk(shard_files[-1])
-        test = data.filestream.image_decode_fn(seed=FLAGS.seed)(test_raw)
-        if len(shard_files) > 1:
-            shard_files = shard_files[:-1]
-            held_out = "1 held-out eval shard"
-        else:
-            held_out = "eval REUSES the single train shard (memorization!)"
-        ds = data.datasets.ArrayDataset(
-            {}, test, f"stream:{FLAGS.data_dir}", num_classes=FLAGS.num_classes
-        )
-        logging.info(
-            "imagenet source: stream:%s (%d train shards, %s, %d classes)",
-            FLAGS.data_dir, len(shard_files), held_out, FLAGS.num_classes,
-        )
-    else:
-        ds = data.datasets.imagenet_synthetic(
+    # Out-of-core: shard-*.dtxr chunks stream through the NATIVE C++ loader,
+    # shard-*.npz through the Python pipeline, else the in-RAM synthetic
+    # stream (tf.data's role — SURVEY.md T7); selection + eval-shard holdout
+    # shared in data.streams.
+    src = data.streams.resolve_image_source(
+        FLAGS.data_dir,
+        fallback=lambda: data.datasets.imagenet_synthetic(
             image_size=FLAGS.image_size,
             n_train=FLAGS.synthetic_examples,
             num_classes=FLAGS.num_classes,
             seed=FLAGS.seed,
-        )
-        logging.info(
-            "imagenet source: %s (%d classes)", ds.source, FLAGS.num_classes
-        )
+        ),
+        seed=FLAGS.seed,
+        num_classes=FLAGS.num_classes,
+        name="imagenet",
+    )
+    ds = src.ds
 
     cfg = models.resnet.Config(num_classes=FLAGS.num_classes)
     # Stepwise decay at 60/80% of the run (the 30/60/80-epoch recipe scaled
@@ -97,18 +81,9 @@ def main(argv):
         rules=models.resnet.SHARDING_RULES,
         flags=FLAGS,
     )
-    if shard_files:
-        pipe = data.FileStreamPipeline(
-            shard_files,
-            batch_size=FLAGS.batch_size,
-            decode_fn=data.filestream.image_decode_fn(augment=True, seed=FLAGS.seed),
-            seed=FLAGS.seed,
-        )
-    else:
-        pipe = data.InMemoryPipeline(
-            ds.train, batch_size=FLAGS.batch_size, seed=FLAGS.seed
-        )
-    exp.run(iter(pipe))
+    exp.run(
+        data.streams.train_iter(src, batch_size=FLAGS.batch_size, seed=FLAGS.seed)
+    )
 
     def eval_fn(params, mstate, batch):
         import jax.numpy as jnp

@@ -232,6 +232,36 @@ def test_transformer_pipeline_parallel(tmp_path):
     assert 0 < f["final_perplexity"] < 2 * 512, f
 
 
+def test_cifar10_native_loader(tmp_path):
+    """--data_dir of .dtxr shards streams through the C++ loader end-to-end."""
+    import numpy as np
+
+    from distributed_tensorflow_examples_tpu.data import native_loader
+
+    rng = np.random.default_rng(0)
+    proto = rng.normal(size=(10, 32, 32, 3))
+    y = rng.integers(0, 10, size=(1024,)).astype(np.int32)
+    x = np.clip(
+        (0.5 * proto[y] + rng.normal(size=(1024, 32, 32, 3))) * 40 + 128, 0, 255
+    ).astype(np.uint8)
+    data_dir = tmp_path / "shards"
+    native_loader.write_raw_shards(
+        str(data_dir), {"image": x, "label": y}, shard_records=256
+    )
+    out = _run(
+        "cifar10_cnn.py",
+        f"--data_dir={data_dir}",
+        "--batch_size=64",
+        "--train_steps=30",
+        "--learning_rate=0.05",
+        f"--log_dir={tmp_path / 'log'}",
+    )
+    assert "C++ loader" in out
+    f = _final(out)
+    assert f["step"] == 30
+    assert "test_accuracy" in f
+
+
 def test_legacy_ps_process_exits_zero():
     """The reference launches one process per PS task; ours must exit 0
     immediately with an explanation (CLI contract, SURVEY.md §5.6)."""

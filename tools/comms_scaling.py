@@ -125,6 +125,40 @@ def _workloads(n: int):
             per_chip=2,
             batch_spec=True,
         ),
+        "transformer_pp": dict(
+            # Pipeline parallel: per-rank stage weights, ppermute handoff.
+            mesh={"data": n // 4, "pipe": 2, "model": 2},
+            model=models.transformer,
+            cfg=models.transformer.Config(
+                vocab_size=8192, dim=256, n_layers=4, n_heads=8,
+                max_seq_len=256, compute_dtype="float32", attention="xla",
+                pipeline_stages=2, microbatches=2,
+            ),
+            opt=optax.adam(1e-3),
+            batch=lambda rng, b: {
+                "x": rng.integers(0, 8192, size=(b, 256)).astype("int32"),
+                "y": rng.integers(0, 8192, size=(b, 256)).astype("int32"),
+            },
+            per_chip=2,
+            batch_spec=True,
+        ),
+        "transformer_moe": dict(
+            # Expert parallel: GShard dispatch einsums over 'expert'.
+            mesh={"data": n // 2, "expert": 2},
+            model=models.transformer,
+            cfg=models.transformer.Config(
+                vocab_size=8192, dim=256, n_layers=2, n_heads=8,
+                max_seq_len=256, compute_dtype="float32", attention="xla",
+                moe_experts=4,
+            ),
+            opt=optax.adam(1e-3),
+            batch=lambda rng, b: {
+                "x": rng.integers(0, 8192, size=(b, 256)).astype("int32"),
+                "y": rng.integers(0, 8192, size=(b, 256)).astype("int32"),
+            },
+            per_chip=2,
+            batch_spec=True,
+        ),
     }
 
 
@@ -155,9 +189,14 @@ def worker(n: int) -> dict:
             if "init_kwargs" in w
             else {}
         )
+        rules = (
+            model_mod.sharding_rules(cfg)
+            if hasattr(model_mod, "sharding_rules")
+            else model_mod.SHARDING_RULES
+        )
         state, shardings = train.create_sharded_state(
             lambda r: model_mod.init(cfg, r, **ikw), w["opt"], jax.random.key(0),
-            mesh=mesh, rules=model_mod.SHARDING_RULES,
+            mesh=mesh, rules=rules,
         )
         spec = model_mod.batch_spec() if w.get("batch_spec") else None
         loss = (
