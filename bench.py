@@ -197,7 +197,9 @@ def bench_resnet50(steps: int, batch_per_chip: int, image_size: int = 224):
     )
 
 
-def bench_transformer(steps: int, batch_per_chip: int, seq_len: int = 2048):
+def bench_transformer(
+    steps: int, batch_per_chip: int, seq_len: int = 2048, remat: bool = False
+):
     """Transformer LM tokens/sec/chip + MFU (flash attention on TPU)."""
     import numpy as np
     import optax
@@ -205,7 +207,8 @@ def bench_transformer(steps: int, batch_per_chip: int, seq_len: int = 2048):
     from distributed_tensorflow_examples_tpu import models
 
     cfg = models.transformer.Config(
-        vocab_size=32000, dim=1024, n_layers=12, n_heads=16, max_seq_len=seq_len
+        vocab_size=32000, dim=1024, n_layers=12, n_heads=16, max_seq_len=seq_len,
+        remat=remat,
     )
 
     def make_batch(rng: np.random.Generator, n: int):
@@ -321,6 +324,7 @@ def main():
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--batch-per-chip", type=int, default=None)
     ap.add_argument("--seq-len", type=int, default=None)
+    ap.add_argument("--remat", action="store_true")
     args = ap.parse_args()
 
     if args.model == "resnet50":
@@ -328,7 +332,8 @@ def main():
         r = bench_resnet50(args.steps or 30, args.batch_per_chip or 256)
     elif args.model == "transformer":
         r = bench_transformer(
-            args.steps or 10, args.batch_per_chip or 8, args.seq_len or 2048
+            args.steps or 10, args.batch_per_chip or 8, args.seq_len or 2048,
+            remat=args.remat,
         )
     elif args.model == "lstm":
         r = bench_lstm(args.steps or 50, args.batch_per_chip or 256, args.seq_len or 20)

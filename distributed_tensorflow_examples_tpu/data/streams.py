@@ -99,7 +99,16 @@ def train_iter(
     w = 0 if worker is None else worker
     decode = filestream.image_decode_fn(augment=augment, seed=seed)
     if src.kind == "native":
-        shards = src.train_shards[w::n_workers] or src.train_shards
+        shards = src.train_shards[w::n_workers]
+        if not shards:
+            # Disjointness is the contract (the npz path row-strides, so any
+            # n_workers works there); silently re-streaming ALL shards would
+            # duplicate data across workers.
+            raise ValueError(
+                f"native loader: {len(src.train_shards)} train shard(s) "
+                f"cannot give {n_workers} workers disjoint streams — write "
+                f"more shards (shard_records smaller) or fewer workers"
+            )
         return (
             decode(b)
             for b in native_loader.NativeFileStream(

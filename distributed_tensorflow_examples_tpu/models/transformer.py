@@ -24,6 +24,7 @@ they restore without error but produce garbage attention).
 from __future__ import annotations
 
 import dataclasses
+import math
 
 import jax
 import jax.numpy as jnp
@@ -62,6 +63,11 @@ class Config:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 1e-2
+    #: Rematerialise each block in the backward pass (jax.checkpoint): trades
+    #: ~1/3 more FLOPs for activation memory ~O(n_layers) smaller — the knob
+    #: that fits bigger batches / longer context in HBM.  (Pipeline mode
+    #: always remats its stages — parallel/pipeline.py.)
+    remat: bool = False
 
     @property
     def dtype(self):
@@ -301,11 +307,14 @@ def apply(cfg: Config, params, x, *, mesh: Mesh | None = None, return_aux=False)
         aux_total = jnp.float32(0.0)
     else:
         aux_total = jnp.float32(0.0)
+
+        def block_fn(p, h, warn=False):
+            return _block(cfg, p, h, mesh=mesh, constrain=constrain, warn=warn)
+
+        if cfg.remat:
+            block_fn = jax.checkpoint(block_fn, static_argnums=(2,))
         for i in range(cfg.n_layers):
-            h, aux = _block(
-                cfg, params[f"block_{i}"], h, mesh=mesh, constrain=constrain,
-                warn=(i == 0),
-            )
+            h, aux = block_fn(params[f"block_{i}"], h, i == 0)
             aux_total = aux_total + aux
 
     h = _layernorm(params["ln_f"], h)
@@ -313,6 +322,112 @@ def apply(cfg: Config, params, x, *, mesh: Mesh | None = None, return_aux=False)
     if return_aux:
         return logits, aux_total
     return logits
+
+
+# ----------------------------------------------------------------------------
+# Autoregressive decoding (KV cache) — the inference path
+# ----------------------------------------------------------------------------
+
+
+def init_cache(cfg: Config, batch: int, max_len: int):
+    """Per-layer K/V cache [B, H, max_len, hd] (bf16 like the compute)."""
+    shape = (batch, cfg.n_heads, max_len, cfg.head_dim)
+    return {
+        f"block_{i}": {
+            "k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+        }
+        for i in range(cfg.n_layers)
+    }
+
+
+def _block_decode(cfg: Config, p, h, layer_cache, pos):
+    """One block for ONE new token: h [B, 1, D], cache updated at ``pos``.
+
+    Static shapes throughout (cache is max_len long, masked beyond ``pos``)
+    so the jitted step never recompiles as decoding advances.
+    """
+    B = h.shape[0]
+    y = _layernorm(p["ln1"], h)
+    qkv = layers.dense(p["qkv"], y, dtype=cfg.dtype)
+    qkv = qkv.reshape(B, 1, cfg.n_heads, 3, cfg.head_dim)
+    q, k, v = [jnp.moveaxis(qkv[:, :, :, j], 2, 1) for j in range(3)]  # [B,H,1,hd]
+    ck = jax.lax.dynamic_update_slice(layer_cache["k"], k, (0, 0, pos, 0))
+    cv = jax.lax.dynamic_update_slice(layer_cache["v"], v, (0, 0, pos, 0))
+    s = jnp.einsum(
+        "bhqd,bhtd->bhqt", q, ck, preferred_element_type=jnp.float32
+    ) / math.sqrt(cfg.head_dim)
+    t_idx = jnp.arange(ck.shape[2])
+    s = jnp.where(t_idx[None, None, None, :] <= pos, s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1).astype(cfg.dtype)
+    o = jnp.einsum("bhqt,bhtd->bhqd", w, cv)
+    o = jnp.moveaxis(o, 1, 2).reshape(B, 1, cfg.dim)
+    h = h + layers.dense(p["proj"], o, dtype=cfg.dtype)
+    y = _layernorm(p["ln2"], h)
+    y = layers.dense(p["mlp_in"], y, dtype=cfg.dtype)
+    y = jax.nn.gelu(y)
+    h = h + layers.dense(p["mlp_out"], y, dtype=cfg.dtype)
+    return h, {"k": ck, "v": cv}
+
+
+def decode_step(cfg: Config, params, cache, token, pos):
+    """token [B] int32 at position ``pos`` -> (logits [B, V], new cache)."""
+    if cfg.moe_experts > 0 or cfg.pipeline_stages > 1:
+        raise NotImplementedError("decode supports the dense non-pipelined model")
+    h = layers.embedding_lookup(params["emb"], token[:, None], dtype=cfg.dtype)
+    h = h + jax.lax.dynamic_slice_in_dim(
+        params["pos"]["table"], pos, 1, axis=0
+    ).astype(cfg.dtype)[None]
+    new_cache = {}
+    for i in range(cfg.n_layers):
+        h, new_cache[f"block_{i}"] = _block_decode(
+            cfg, params[f"block_{i}"], h, cache[f"block_{i}"], pos
+        )
+    h = _layernorm(params["ln_f"], h)
+    return layers.dense(params["head"], h, dtype=cfg.dtype)[:, 0], new_cache
+
+
+def generate(
+    cfg: Config,
+    params,
+    prompt,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+):
+    """Autoregressive generation: prompt [B, Tp] -> [B, Tp + max_new_tokens].
+
+    One jitted ``lax.scan`` over positions with a static-shape KV cache —
+    prompt positions are teacher-forced (their logits discarded), then
+    greedy (temperature 0) or temperature sampling.  The framework's
+    inference surface; no reference analog (the reference trains only).
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)  # numpy prompts: traced indexing
+    B, Tp = prompt.shape
+    total = Tp + max_new_tokens
+    if total > cfg.max_seq_len:
+        raise ValueError(f"{total} tokens > max_seq_len={cfg.max_seq_len}")
+    rng = jax.random.key(0) if rng is None else rng
+
+    def step(carry, pos):
+        cache, tok, rng = carry
+        logits, cache = decode_step(cfg, params, cache, tok, pos)
+        rng, sub = jax.random.split(rng)
+        if temperature > 0:
+            sampled = jax.random.categorical(sub, logits.astype(jnp.float32) / temperature)
+        else:
+            sampled = jnp.argmax(logits, axis=-1)
+        # Teacher-force while still inside the prompt.
+        nxt = jnp.where(pos + 1 < Tp, prompt[:, jnp.minimum(pos + 1, Tp - 1)], sampled)
+        return (cache, nxt.astype(jnp.int32), rng), nxt.astype(jnp.int32)
+
+    cache = init_cache(cfg, B, total)
+    (_, _, _), toks = jax.lax.scan(
+        step, (cache, prompt[:, 0], rng), jnp.arange(total - 1)
+    )
+    out = jnp.concatenate([prompt[:, :1], toks.T], axis=1)  # [B, total]
+    return out
 
 
 def loss_fn(cfg: Config, *, mesh: Mesh | None = None):

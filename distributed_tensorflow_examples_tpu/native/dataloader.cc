@@ -50,6 +50,18 @@ struct Header {
   size_t record_bytes = 0;
 };
 
+bool same_schema(const Header& a, const Header& b) {
+  // record_bytes alone is NOT enough: shards with reordered/retyped fields
+  // of equal total size would be silently misparsed into scrambled batches.
+  if (a.fields.size() != b.fields.size()) return false;
+  for (size_t i = 0; i < a.fields.size(); ++i) {
+    const Field &x = a.fields[i], &y = b.fields[i];
+    if (x.name != y.name || x.dtype != y.dtype || x.dims != y.dims)
+      return false;
+  }
+  return true;
+}
+
 bool read_header(FILE* f, Header* h) {
   char magic[8];
   if (fread(magic, 1, 8, f) != 8 || memcmp(magic, "DTXRAW1\n", 8) != 0)
@@ -159,13 +171,25 @@ struct Loader {
         break;
       }
       Header h;
-      if (!read_header(f, &h) || h.record_bytes != schema.record_bytes) {
+      if (!read_header(f, &h) || !same_schema(h, schema)) {
         fclose(f);
         std::lock_guard<std::mutex> lk(mu);
         error = "bad/mismatched shard header: " + path;
         break;
       }
       size_t n = (size_t)h.n_records;
+      if (n < (size_t)batch && drop_remainder) {
+        // This chunk can never emit a batch; with repeat=true the pool
+        // would otherwise busy-spin reading/shuffling forever while the
+        // consumer times out "starved".
+        fclose(f);
+        std::lock_guard<std::mutex> lk(mu);
+        error = "batch_size " + std::to_string(batch) + " > " +
+                std::to_string(n) + " records in " + path +
+                " (drop_remainder): rewrite shards with more records or "
+                "shrink the batch";
+        break;
+      }
       std::vector<uint8_t> raw(n * h.record_bytes);
       if (fread(raw.data(), 1, raw.size(), f) != raw.size()) {
         fclose(f);

@@ -245,14 +245,19 @@ def start_watchdog(
                 client.key_value_set(f"dtx/hb/{idx}", str(seq), allow_overwrite=True)
                 misses = 0
             except Exception as e:
-                # Transient RPC errors must NOT silently stop the heartbeat
-                # (peers would falsely declare us dead); retry next beat.
-                # Several consecutive failures = the service is gone
-                # (process-exit teardown) — stop quietly.
+                # NEVER stop beating while the process lives: a silently
+                # frozen heartbeat makes every peer declare us dead and
+                # kills a healthy job.  A service outage longer than the
+                # peers' grace does that anyway — but then the supervisor
+                # restart is at least the designed response.  (At clean
+                # shutdown the stop event ends this loop; at process exit
+                # the daemon thread dies with it.)
                 misses += 1
-                if misses >= 3:
-                    return
-                log.warning("watchdog: heartbeat publish failed (%s); retrying", e)
+                if misses <= 3 or misses % 30 == 0:
+                    log.warning(
+                        "watchdog: heartbeat publish failed %dx (%s); retrying",
+                        misses, e,
+                    )
             stop.wait(interval_s)
 
     def _fail(dead: list[int]):

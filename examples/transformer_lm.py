@@ -41,6 +41,15 @@ flags.DEFINE_enum(
     "attention", "auto", ["auto", "xla", "flash"], "Per-chip attention impl."
 )
 flags.DEFINE_float("clip_norm", 1.0, "Global-norm gradient clip.")
+flags.DEFINE_bool(
+    "remat", False, "Rematerialise blocks in backward (fits bigger batches)."
+)
+flags.DEFINE_integer(
+    "sample_tokens",
+    0,
+    ">0: after training, greedy-decode this many tokens from a corpus "
+    "prompt via the KV-cache inference path and log the token ids.",
+)
 flags.DEFINE_integer(
     "pipeline_stages",
     1,
@@ -71,6 +80,13 @@ def main(argv):
     if info["is_legacy_ps_process"]:
         print("job_name=ps: parameter servers are not needed on TPU; exiting 0.")
         return
+    if FLAGS.sample_tokens and 16 + FLAGS.sample_tokens > FLAGS.seq_len:
+        # Validate BEFORE training: generate() would raise after the whole
+        # run completed and lose the FINAL line.
+        raise app.UsageError(
+            f"--sample_tokens={FLAGS.sample_tokens} + 16 prompt tokens "
+            f"exceeds --seq_len={FLAGS.seq_len}"
+        )
 
     ids, vocab, source = data.datasets.text_corpus(
         FLAGS.data_dir,
@@ -91,6 +107,7 @@ def main(argv):
         microbatches=FLAGS.microbatches,
         moe_experts=FLAGS.moe_experts,
         moe_capacity_factor=FLAGS.moe_capacity_factor,
+        remat=FLAGS.remat,
     )
     exp = train.Experiment(
         init_fn=lambda rng: models.transformer.init(cfg, rng),
@@ -119,6 +136,16 @@ def main(argv):
         local_ids, batch_size=local_rows, seq_len=FLAGS.seq_len
     )
     exp.run(it)
+
+    if FLAGS.sample_tokens > 0 and FLAGS.pipeline_stages == 1 and not FLAGS.moe_experts:
+        # Inference surface: KV-cache greedy decode from a corpus prompt.
+        import numpy as np
+
+        prompt = np.asarray(ids[:16], dtype=np.int32)[None]
+        out = models.transformer.generate(
+            cfg, exp.state.params, prompt, max_new_tokens=FLAGS.sample_tokens
+        )
+        logging.info("sampled token ids: %s", np.asarray(out)[0, 16:].tolist())
     m = exp.session.last_metrics
     exp.finish(final_perplexity=float(m.get("perplexity", 0.0)))
 

@@ -117,3 +117,14 @@ def test_trains_resnet_shapes_from_native_stream(tmp_path, mesh8):
         state, m = step(state, as_global(b, mesh8))
     assert np.isfinite(float(m["loss"]))
     pipe.close()
+
+
+def test_batch_larger_than_shard_errors_clearly(tmp_path):
+    """batch > per-shard records must fail fast with a clear message, not
+    busy-spin the worker pool into a consumer timeout."""
+    data = _dataset(n=64)
+    paths = nl.write_raw_shards(str(tmp_path), data, shard_records=64)
+    pipe = nl.NativeFileStream(paths, batch_size=128, seed=0, repeat=True, timeout_s=30)
+    with pytest.raises(RuntimeError, match="batch_size 128 > 64"):
+        next(iter(pipe))
+    pipe.close()

@@ -99,3 +99,64 @@ def test_transformer_flash_under_mesh():
     o1 = f_flash(params, b["x"])
     o2 = f_xla(params, b["x"])
     np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), rtol=2e-4, atol=2e-4)
+
+
+def test_decode_step_matches_full_forward():
+    """KV-cache decoding (models/transformer.py decode_step) must reproduce
+    the training forward's logits position by position (teacher-forced)."""
+    cfg = models.transformer.Config(
+        vocab_size=97, dim=32, n_layers=2, n_heads=4, max_seq_len=16,
+        attention="xla", compute_dtype="float32",
+    )
+    params = models.transformer.init(cfg, jax.random.key(0))
+    x = jax.random.randint(jax.random.key(1), (3, 10), 0, 97)
+    ref = models.transformer.apply(cfg, params, x)  # [B, T, V]
+
+    cache = models.transformer.init_cache(cfg, 3, 10)
+    step = jax.jit(
+        lambda c, t, p: models.transformer.decode_step(cfg, params, c, t, p)
+    )
+    for pos in range(10):
+        logits, cache = step(cache, x[:, pos], pos)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(ref[:, pos]), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_generate_greedy_continues_prompt():
+    cfg = models.transformer.Config(
+        vocab_size=61, dim=32, n_layers=2, n_heads=4, max_seq_len=24,
+        attention="xla", compute_dtype="float32",
+    )
+    params = models.transformer.init(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(2), (2, 6), 0, 61)
+    out = models.transformer.generate(cfg, params, prompt, max_new_tokens=8)
+    assert out.shape == (2, 14)
+    np.testing.assert_array_equal(np.asarray(out[:, :6]), np.asarray(prompt))
+    # Greedy continuation must equal argmax of the full forward at each step
+    # (the scan's own outputs are self-consistent by the parity test above;
+    # here check end-to-end against apply on the generated prefix).
+    full = models.transformer.apply(cfg, params, out[:, :-1])
+    np.testing.assert_array_equal(
+        np.asarray(jnp.argmax(full[:, 5:], axis=-1)), np.asarray(out[:, 6:])
+    )
+
+
+def test_remat_matches_no_remat():
+    """cfg.remat changes memory scheduling, not numerics."""
+    kw = dict(vocab_size=64, dim=32, n_layers=2, n_heads=2, max_seq_len=16,
+              attention="xla", compute_dtype="float32")
+    p = models.transformer.init(models.transformer.Config(**kw), jax.random.key(0))
+    x = jax.random.randint(jax.random.key(1), (2, 16), 0, 64)
+
+    def loss(cfg, p):
+        logits = models.transformer.apply(cfg, p, x)
+        return jnp.sum(logits.astype(jnp.float32) ** 2) / logits.size
+
+    c0 = models.transformer.Config(**kw)
+    c1 = models.transformer.Config(**kw, remat=True)
+    l0, g0 = jax.value_and_grad(lambda p: loss(c0, p))(p)
+    l1, g1 = jax.value_and_grad(lambda p: loss(c1, p))(p)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
