@@ -82,24 +82,35 @@ def list_raw_shards(directory: str, pattern: str = "shard-*.dtxr") -> list[str]:
     return sorted(glob.glob(os.path.join(directory, pattern)))
 
 
+def _read_header(f) -> tuple[list, int]:
+    if f.read(8) != MAGIC:
+        raise ValueError(f"not a DTXRAW1 shard: {f.name}")
+    n_fields = int(np.frombuffer(f.read(4), np.uint32)[0])
+    fields = []
+    for _ in range(n_fields):
+        name_len = f.read(1)[0]
+        name = f.read(name_len).decode()
+        dtype = np.dtype([np.uint8, np.int32, np.float32][f.read(1)[0]])
+        ndim = f.read(1)[0]
+        shape = tuple(
+            int(np.frombuffer(f.read(4), np.uint32)[0]) for _ in range(ndim)
+        )
+        fields.append((name, dtype, shape))
+    n = int(np.frombuffer(f.read(8), np.uint64)[0])
+    return fields, n
+
+
+def peek_shard(path: str) -> tuple[list, int]:
+    """(fields, n_records) from a shard header — no data read."""
+    with open(path, "rb") as f:
+        return _read_header(f)
+
+
 def read_raw_shard(path: str) -> dict[str, np.ndarray]:
     """Host-side (numpy) read of ONE shard — for eval splits; the training
     path goes through the C++ loader."""
     with open(path, "rb") as f:
-        if f.read(8) != MAGIC:
-            raise ValueError(f"not a DTXRAW1 shard: {path}")
-        n_fields = int(np.frombuffer(f.read(4), np.uint32)[0])
-        fields = []
-        for _ in range(n_fields):
-            name_len = f.read(1)[0]
-            name = f.read(name_len).decode()
-            dtype = np.dtype([np.uint8, np.int32, np.float32][f.read(1)[0]])
-            ndim = f.read(1)[0]
-            shape = tuple(
-                int(np.frombuffer(f.read(4), np.uint32)[0]) for _ in range(ndim)
-            )
-            fields.append((name, dtype, shape))
-        n = int(np.frombuffer(f.read(8), np.uint64)[0])
+        fields, n = _read_header(f)
         raw = f.read()
     rec_bytes = sum(
         int(np.prod(s, dtype=np.int64)) * d.itemsize for _, d, s in fields
@@ -178,6 +189,25 @@ class NativeFileStream:
             int(repeat), 1,
         )
         if not self._h:
+            # Diagnose precisely (the C ABI only reports failure): bad
+            # header, mismatched schemas, or batch > every shard.
+            ref_fields, max_n = None, 0
+            for p in paths:
+                fields, n = peek_shard(p)  # raises on a bad header
+                if ref_fields is None:
+                    ref_fields = fields
+                elif fields != ref_fields:
+                    raise ValueError(
+                        f"shard schema mismatch: {paths[0]} has {ref_fields}, "
+                        f"{p} has {fields}"
+                    )
+                max_n = max(max_n, n)
+            if batch_size > max_n:
+                raise ValueError(
+                    f"batch_size {batch_size} > {max_n} records in the "
+                    "largest shard (drop_remainder): rewrite shards with "
+                    "more records or shrink the batch"
+                )
             raise ValueError(f"cannot open DTXRAW1 shards: {paths[0]}")
         self.batch_size = batch_size
         self.timeout_s = timeout_s

@@ -223,19 +223,28 @@ def _block(cfg: Config, p, h, *, mesh, constrain, allow_custom_attn=True, warn=F
     h = h + layers.dense(p["proj"], o, dtype=cfg.dtype)
     h = constrain(h, P("data", "seq", None))
 
-    y = _layernorm(p["ln2"], h)
     aux = jnp.float32(0.0)
     if "moe" in p:
         from ..ops import moe as moe_ops
 
+        y = _layernorm(p["ln2"], h)
         y, aux = moe_ops.apply(p["moe"], y, _moe_cfg(cfg), dtype=cfg.dtype)
-        h = h + y
+        h = constrain(h + y, P("data", "seq", None))
     else:
-        y = layers.dense(p["mlp_in"], y, dtype=cfg.dtype)  # column-parallel
-        y = constrain(y, P("data", "seq", "model"))
-        y = jax.nn.gelu(y)
-        h = h + layers.dense(p["mlp_out"], y, dtype=cfg.dtype)  # row-parallel
-    return constrain(h, P("data", "seq", None)), aux
+        h = _mlp_tail(cfg, p, h, constrain)
+    return h, aux
+
+
+def _mlp_tail(cfg: Config, p, h, constrain):
+    """ln2 -> column-parallel dense -> GELU -> row-parallel dense, residual.
+    Shared by the training block and the KV-cache decode block so the two
+    paths cannot drift."""
+    y = _layernorm(p["ln2"], h)
+    y = layers.dense(p["mlp_in"], y, dtype=cfg.dtype)
+    y = constrain(y, P("data", "seq", "model"))
+    y = jax.nn.gelu(y)
+    h = h + layers.dense(p["mlp_out"], y, dtype=cfg.dtype)
+    return constrain(h, P("data", "seq", None))
 
 
 def apply(cfg: Config, params, x, *, mesh: Mesh | None = None, return_aux=False):
@@ -363,10 +372,7 @@ def _block_decode(cfg: Config, p, h, layer_cache, pos):
     o = jnp.einsum("bhqt,bhtd->bhqd", w, cv)
     o = jnp.moveaxis(o, 1, 2).reshape(B, 1, cfg.dim)
     h = h + layers.dense(p["proj"], o, dtype=cfg.dtype)
-    y = _layernorm(p["ln2"], h)
-    y = layers.dense(p["mlp_in"], y, dtype=cfg.dtype)
-    y = jax.nn.gelu(y)
-    h = h + layers.dense(p["mlp_out"], y, dtype=cfg.dtype)
+    h = _mlp_tail(cfg, p, h, lambda y, spec: y)  # no mesh constraints: T=1
     return h, {"k": ck, "v": cv}
 
 

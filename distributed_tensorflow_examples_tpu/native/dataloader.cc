@@ -179,16 +179,11 @@ struct Loader {
       }
       size_t n = (size_t)h.n_records;
       if (n < (size_t)batch && drop_remainder) {
-        // This chunk can never emit a batch; with repeat=true the pool
-        // would otherwise busy-spin reading/shuffling forever while the
-        // consumer times out "starved".
+        // Routine short TAIL shard: emits nothing, skip it (documented
+        // drop-remainder semantics).  The no-shard-can-ever-emit case is
+        // rejected up front in dtx_dl_new, so this cannot busy-spin.
         fclose(f);
-        std::lock_guard<std::mutex> lk(mu);
-        error = "batch_size " + std::to_string(batch) + " > " +
-                std::to_string(n) + " records in " + path +
-                " (drop_remainder): rewrite shards with more records or "
-                "shrink the batch";
-        break;
+        continue;
       }
       std::vector<uint8_t> raw(n * h.record_bytes);
       if (fread(raw.data(), 1, raw.size(), f) != raw.size()) {
@@ -258,13 +253,32 @@ void* dtx_dl_new(const char** paths, int n_paths, int batch, int n_workers,
   if (n_paths <= 0 || batch <= 0) return nullptr;
   auto* L = new Loader();
   for (int i = 0; i < n_paths; ++i) L->paths.emplace_back(paths[i]);
-  FILE* f = fopen(L->paths[0].c_str(), "rb");
-  if (!f || !read_header(f, &L->schema)) {
-    if (f) fclose(f);
+  // Validate every shard's header up front: schemas must agree, and at
+  // least one shard must be able to emit a full batch — otherwise a
+  // repeat=true worker pool would busy-spin producing nothing while the
+  // consumer times out "starved".
+  uint64_t max_records = 0;
+  for (int i = 0; i < n_paths; ++i) {
+    FILE* f = fopen(L->paths[i].c_str(), "rb");
+    Header h;
+    if (!f || !read_header(f, &h)) {
+      if (f) fclose(f);
+      delete L;
+      return nullptr;
+    }
+    fclose(f);
+    if (i == 0) {
+      L->schema = h;
+    } else if (!same_schema(h, L->schema)) {
+      delete L;
+      return nullptr;
+    }
+    if (h.n_records > max_records) max_records = h.n_records;
+  }
+  if (drop_remainder && max_records < (uint64_t)batch) {
     delete L;
     return nullptr;
   }
-  fclose(f);
   L->batch = batch;
   L->capacity = capacity > 0 ? capacity : 4;
   L->seed = seed;

@@ -80,12 +80,23 @@ def main(argv):
     if info["is_legacy_ps_process"]:
         print("job_name=ps: parameter servers are not needed on TPU; exiting 0.")
         return
-    if FLAGS.sample_tokens and 16 + FLAGS.sample_tokens > FLAGS.seq_len:
+    prompt_len = 16
+    sampling = (
+        FLAGS.sample_tokens > 0
+        and FLAGS.pipeline_stages == 1
+        and not FLAGS.moe_experts
+    )
+    if FLAGS.sample_tokens > 0 and not sampling:
+        logging.warning(
+            "--sample_tokens ignored: decoding supports the dense "
+            "non-pipelined model (pipeline_stages=1, moe_experts=0)."
+        )
+    if sampling and prompt_len + FLAGS.sample_tokens > FLAGS.seq_len:
         # Validate BEFORE training: generate() would raise after the whole
         # run completed and lose the FINAL line.
         raise app.UsageError(
-            f"--sample_tokens={FLAGS.sample_tokens} + 16 prompt tokens "
-            f"exceeds --seq_len={FLAGS.seq_len}"
+            f"--sample_tokens={FLAGS.sample_tokens} + {prompt_len} prompt "
+            f"tokens exceeds --seq_len={FLAGS.seq_len}"
         )
 
     ids, vocab, source = data.datasets.text_corpus(
@@ -137,15 +148,17 @@ def main(argv):
     )
     exp.run(it)
 
-    if FLAGS.sample_tokens > 0 and FLAGS.pipeline_stages == 1 and not FLAGS.moe_experts:
+    if sampling:
         # Inference surface: KV-cache greedy decode from a corpus prompt.
         import numpy as np
 
-        prompt = np.asarray(ids[:16], dtype=np.int32)[None]
+        prompt = np.asarray(ids[:prompt_len], dtype=np.int32)[None]
         out = models.transformer.generate(
             cfg, exp.state.params, prompt, max_new_tokens=FLAGS.sample_tokens
         )
-        logging.info("sampled token ids: %s", np.asarray(out)[0, 16:].tolist())
+        logging.info(
+            "sampled token ids: %s", np.asarray(out)[0, prompt_len:].tolist()
+        )
     m = exp.session.last_metrics
     exp.finish(final_perplexity=float(m.get("perplexity", 0.0)))
 

@@ -135,3 +135,51 @@ print("RESUMED_AT", sess.records.get("resumed_at", 0), "FINAL", int(final.step))
     # Second run restores step 3 and StopAtStepHook(3) stops immediately.
     assert "FINAL 3" in logs2[0], logs2[0]
     assert "RESUMED_AT 3" in logs2[0], logs2[0]
+
+
+def test_pipeline_parallel_across_processes():
+    """GPipe over a 2-process 'pipe' mesh (1 CPU device per process, gloo):
+    the stage-handoff ppermute crosses a REAL process boundary — the
+    multi-host shape of parallel/pipeline.py (SURVEY.md section 5.8)."""
+    src = """
+import numpy as np, optax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from distributed_tensorflow_examples_tpu import models, train, data
+
+# All five named axes (size-1 except pipe): the model's sharding specs
+# reference data/seq/model by name.
+mesh = Mesh(
+    np.asarray(jax.devices()).reshape(1, 2, 1, 1, 1),
+    ("data", "pipe", "expert", "seq", "model"),
+)
+cfg = models.transformer.Config(
+    vocab_size=64, dim=32, n_layers=2, n_heads=2, max_seq_len=16,
+    attention="xla", compute_dtype="float32",
+    pipeline_stages=2, microbatches=2,
+)
+opt = optax.adam(1e-2)
+state, sh = train.create_sharded_state(
+    lambda r: models.transformer.init(cfg, r), opt, jax.random.key(0),
+    mesh=mesh, rules=models.transformer.sharding_rules(cfg))
+step = train.build_train_step(
+    models.transformer.loss_fn(cfg, mesh=mesh), opt, mesh=mesh,
+    state_shardings=sh)
+rng = np.random.default_rng(0)  # same stream on both hosts: replicated batch
+losses = []
+for _ in range(3):
+    xy = rng.integers(0, 64, size=(4, 17)).astype(np.int32)
+    b = data.pipeline.as_global({"x": xy[:, :-1], "y": xy[:, 1:]}, mesh)
+    state, m = step(state, b)
+    losses.append(round(float(m["loss"]), 5))
+print("PP_LOSSES", losses)
+"""
+    logs = MultiProcessRunner(2, src, timeout=240).run()
+    l0 = [l for l in logs[0].splitlines() if l.startswith("PP_LOSSES")]
+    l1 = [l for l in logs[1].splitlines() if l.startswith("PP_LOSSES")]
+    assert l0 and l0 == l1, (l0, l1)
+    import math
+
+    vals = eval(l0[0].split(" ", 1)[1])
+    assert all(math.isfinite(v) for v in vals), vals
+    assert vals[-1] < vals[0], vals

@@ -77,7 +77,7 @@ def test_repeat_streams_multiple_epochs(tmp_path):
 def test_bad_shard_raises(tmp_path):
     p = tmp_path / "shard-00000.dtxr"
     p.write_bytes(b"NOTDTXRAW" * 4)
-    with pytest.raises(ValueError, match="cannot open"):
+    with pytest.raises(ValueError, match="not a DTXRAW1 shard"):
         nl.NativeFileStream([str(p)], batch_size=4)
 
 
@@ -119,12 +119,21 @@ def test_trains_resnet_shapes_from_native_stream(tmp_path, mesh8):
     pipe.close()
 
 
-def test_batch_larger_than_shard_errors_clearly(tmp_path):
-    """batch > per-shard records must fail fast with a clear message, not
-    busy-spin the worker pool into a consumer timeout."""
+def test_batch_larger_than_every_shard_errors_clearly(tmp_path):
+    """batch > records of EVERY shard must fail fast at construction with a
+    clear message, not busy-spin the worker pool into a consumer timeout."""
     data = _dataset(n=64)
     paths = nl.write_raw_shards(str(tmp_path), data, shard_records=64)
-    pipe = nl.NativeFileStream(paths, batch_size=128, seed=0, repeat=True, timeout_s=30)
-    with pytest.raises(RuntimeError, match="batch_size 128 > 64"):
-        next(iter(pipe))
+    with pytest.raises(ValueError, match="batch_size 128 > 64"):
+        nl.NativeFileStream(paths, batch_size=128, seed=0, repeat=True)
+
+
+def test_short_tail_shard_is_skipped_not_fatal(tmp_path):
+    """A routine short TAIL shard (n % shard_records != 0) must not error —
+    it just emits nothing (drop-remainder semantics)."""
+    data = _dataset(n=300)  # shards of 128/128/44; batch 100 > 44
+    paths = nl.write_raw_shards(str(tmp_path), data, shard_records=128)
+    pipe = nl.NativeFileStream(paths, batch_size=100, seed=0, repeat=False)
+    seen = [b["label"].shape[0] for b in pipe]
+    assert seen == [100, 100]  # one batch per full shard, tail skipped
     pipe.close()

@@ -1,0 +1,91 @@
+"""Input-pipeline throughput: native C++ loader vs the Python pipeline.
+
+Writes a synthetic multi-shard image dataset to disk in BOTH formats
+(DTXRAW1 raw records / npz chunks), then measures sustained batches/sec and
+MB/sec through each streaming path — the evidence that the C++ worker-pool
+loader (native/dataloader.cc) actually buys infeed headroom over the
+GIL-bound Python path (SURVEY.md §2c T7).
+
+Usage: python tools/loader_bench.py [--records 32768] [--batch 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def make_dataset(n: int, hw: int = 32):
+    rng = np.random.default_rng(0)
+    return {
+        "image": rng.integers(0, 256, size=(n, hw, hw, 3)).astype(np.uint8),
+        "label": rng.integers(0, 1000, size=(n,)).astype(np.int32),
+    }
+
+
+def drain(it, n_batches: int, record_bytes: int, batch: int):
+    # Warm (fills rings / starts workers), then timed drain.
+    for _ in range(4):
+        next(it)
+    t0 = time.perf_counter()
+    for _ in range(n_batches):
+        b = next(it)
+    dt = time.perf_counter() - t0
+    mb = n_batches * batch * record_bytes / 1e6
+    return n_batches / dt, mb / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--records", type=int, default=32768)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--shard-records", type=int, default=2048)
+    ap.add_argument("--batches", type=int, default=200)
+    ap.add_argument("--workers", type=int, default=2)
+    args = ap.parse_args()
+
+    from distributed_tensorflow_examples_tpu.data import filestream, native_loader
+
+    data = make_dataset(args.records)
+    record_bytes = data["image"][0].nbytes + 4
+
+    tmp = tempfile.mkdtemp(prefix="dtx_loaderbench_")
+    try:
+        raw_dir, npz_dir = os.path.join(tmp, "raw"), os.path.join(tmp, "npz")
+        raw_paths = native_loader.write_raw_shards(
+            raw_dir, data, shard_records=args.shard_records
+        )
+        os.makedirs(npz_dir)
+        npz_paths = filestream.write_array_shards(
+            npz_dir, data, rows_per_shard=args.shard_records
+        )
+
+        native = native_loader.NativeFileStream(
+            raw_paths, batch_size=args.batch, n_workers=args.workers, seed=0,
+            repeat=True,
+        )
+        bps, mbs = drain(iter(native), args.batches, record_bytes, args.batch)
+        print(f"native C++ loader : {bps:8.1f} batches/s  {mbs:8.1f} MB/s")
+        native.close()
+
+        py = filestream.FileStreamPipeline(
+            npz_paths, batch_size=args.batch, seed=0,
+            num_decode_workers=args.workers,
+        )
+        bps2, mbs2 = drain(iter(py), args.batches, record_bytes, args.batch)
+        print(f"python pipeline   : {bps2:8.1f} batches/s  {mbs2:8.1f} MB/s")
+        print(f"native/python     : {bps / bps2:8.2f}x")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
