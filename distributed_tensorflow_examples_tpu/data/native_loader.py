@@ -83,20 +83,27 @@ def list_raw_shards(directory: str, pattern: str = "shard-*.dtxr") -> list[str]:
 
 
 def _read_header(f) -> tuple[list, int]:
+    def take(n: int) -> bytes:
+        b = f.read(n)
+        if len(b) != n:
+            raise ValueError(f"truncated DTXRAW1 header: {f.name}")
+        return b
+
     if f.read(8) != MAGIC:
         raise ValueError(f"not a DTXRAW1 shard: {f.name}")
-    n_fields = int(np.frombuffer(f.read(4), np.uint32)[0])
+    n_fields = int(np.frombuffer(take(4), np.uint32)[0])
     fields = []
     for _ in range(n_fields):
-        name_len = f.read(1)[0]
-        name = f.read(name_len).decode()
-        dtype = np.dtype([np.uint8, np.int32, np.float32][f.read(1)[0]])
-        ndim = f.read(1)[0]
-        shape = tuple(
-            int(np.frombuffer(f.read(4), np.uint32)[0]) for _ in range(ndim)
-        )
+        name_len = take(1)[0]
+        name = take(name_len).decode()
+        code = take(1)[0]
+        if code > 2:
+            raise ValueError(f"bad dtype code {code} in {f.name}")
+        dtype = np.dtype([np.uint8, np.int32, np.float32][code])
+        ndim = take(1)[0]
+        shape = tuple(int(np.frombuffer(take(4), np.uint32)[0]) for _ in range(ndim))
         fields.append((name, dtype, shape))
-    n = int(np.frombuffer(f.read(8), np.uint64)[0])
+    n = int(np.frombuffer(take(8), np.uint64)[0])
     return fields, n
 
 
@@ -182,6 +189,29 @@ class NativeFileStream:
     ):
         if not paths:
             raise ValueError("no shard paths")
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
+        # All user-facing construction validation happens HERE (one source of
+        # truth; the C++ guards in dtx_dl_new are an internal backstop whose
+        # NULL return then genuinely means "unreadable"): headers parse,
+        # schemas agree, and at least one shard can emit a full batch.
+        ref_fields, max_n = None, 0
+        for p in paths:
+            fields, n = peek_shard(p)  # ValueError on bad/truncated header
+            if ref_fields is None:
+                ref_fields = fields
+            elif fields != ref_fields:
+                raise ValueError(
+                    f"shard schema mismatch: {paths[0]} has {ref_fields}, "
+                    f"{p} has {fields}"
+                )
+            max_n = max(max_n, n)
+        if batch_size > max_n:
+            raise ValueError(
+                f"batch_size {batch_size} > {max_n} records in the largest "
+                "shard (drop_remainder): rewrite shards with more records "
+                "or shrink the batch"
+            )
         self._lib = _load()
         arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
         self._h = self._lib.dtx_dl_new(
@@ -189,25 +219,6 @@ class NativeFileStream:
             int(repeat), 1,
         )
         if not self._h:
-            # Diagnose precisely (the C ABI only reports failure): bad
-            # header, mismatched schemas, or batch > every shard.
-            ref_fields, max_n = None, 0
-            for p in paths:
-                fields, n = peek_shard(p)  # raises on a bad header
-                if ref_fields is None:
-                    ref_fields = fields
-                elif fields != ref_fields:
-                    raise ValueError(
-                        f"shard schema mismatch: {paths[0]} has {ref_fields}, "
-                        f"{p} has {fields}"
-                    )
-                max_n = max(max_n, n)
-            if batch_size > max_n:
-                raise ValueError(
-                    f"batch_size {batch_size} > {max_n} records in the "
-                    "largest shard (drop_remainder): rewrite shards with "
-                    "more records or shrink the batch"
-                )
             raise ValueError(f"cannot open DTXRAW1 shards: {paths[0]}")
         self.batch_size = batch_size
         self.timeout_s = timeout_s

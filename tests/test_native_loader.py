@@ -79,6 +79,13 @@ def test_bad_shard_raises(tmp_path):
     p.write_bytes(b"NOTDTXRAW" * 4)
     with pytest.raises(ValueError, match="not a DTXRAW1 shard"):
         nl.NativeFileStream([str(p)], batch_size=4)
+    # Truncated header (crash mid-write): clear error, not an IndexError.
+    t = tmp_path / "shard-00001.dtxr"
+    t.write_bytes(nl.MAGIC + np.uint32(1).tobytes())
+    with pytest.raises(ValueError, match="truncated DTXRAW1 header"):
+        nl.NativeFileStream([str(t)], batch_size=4)
+    with pytest.raises(ValueError, match="batch_size must be positive"):
+        nl.NativeFileStream([str(p)], batch_size=0)
 
 
 def test_trains_resnet_shapes_from_native_stream(tmp_path, mesh8):
