@@ -63,6 +63,12 @@ def run_ps_emulation(
 
     n_workers = worker_count(FLAGS)
     r2a = getattr(FLAGS, "replicas_to_aggregate", 0) or n_workers
+    if getattr(FLAGS, "grad_accum", 1) > 1:
+        log.warning(
+            "--grad_accum=%d is ignored in PS-emulation mode (per-worker "
+            "gradients apply individually; accumulation is a mesh-trainer "
+            "feature)", FLAGS.grad_accum,
+        )
     log.info(
         "PS emulation mode=%s: %d workers%s (native accumulator/token "
         "services; semantics notes in parallel.async_ps)",

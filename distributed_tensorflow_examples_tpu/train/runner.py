@@ -95,6 +95,7 @@ class Experiment:
             state_shardings=self.shardings,
             unroll=flags.unroll,
             batch_spec=batch_spec,
+            grad_accum=getattr(flags, "grad_accum", 1),
         )
         self._loss_fn = loss_fn
         self.log_dir = flags.log_dir or None

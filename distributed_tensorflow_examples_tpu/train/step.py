@@ -41,6 +41,7 @@ def build_train_step(
     donate: bool = True,
     unroll: int = 1,
     batch_spec: P | None = None,
+    grad_accum: int = 1,
 ):
     """Returns ``step(state, batch) -> (state, metrics)``, fully jitted.
 
@@ -48,13 +49,52 @@ def build_train_step(
     over the data axis) so the compiled executable is the same SPMD program on
     1 chip or a pod.  ``donate`` releases the input state's buffers to the
     output (halves peak HBM — the in-place variable update analog).
+
+    ``grad_accum=k``: the batch is split into k microbatches inside the step
+    (``lax.scan``), gradients averaged, ONE optimizer update — activation
+    memory of a k-times-smaller batch at the numerics of the full batch
+    (exact for global-mean losses; running statistics like BatchNorm see
+    microbatches, so their momentum updates differ — same caveat as every
+    accumulating trainer).  Requires batch % k == 0; composes with unroll.
     """
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
 
     def one_step(state: TrainState, batch) -> tuple[TrainState, dict]:
         step_rng = jax.random.fold_in(state.rng, state.step)
-        (loss, (new_model_state, metrics)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params, state.model_state, batch, step_rng)
+        if grad_accum == 1:
+            (loss, (new_model_state, metrics)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params, state.model_state, batch, step_rng)
+        else:
+            def _split(x):
+                if x.shape[0] % grad_accum:
+                    raise ValueError(
+                        f"batch dim {x.shape[0]} not divisible by "
+                        f"grad_accum={grad_accum}"
+                    )
+                return x.reshape(grad_accum, x.shape[0] // grad_accum, *x.shape[1:])
+
+            micro = jax.tree.map(_split, batch)
+
+            def accum(carry, mb):
+                # Running-sum in the carry: stacking k gradient pytrees as
+                # scan outputs would cost k param-sized HBM buffers — the
+                # exact memory accumulation exists to avoid.
+                mstate, rng, gsum = carry
+                rng, sub = jax.random.split(rng)
+                (l, (mstate, m)), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                    state.params, mstate, mb, sub
+                )
+                gsum = jax.tree.map(jnp.add, gsum, g)
+                return (mstate, rng, gsum), m
+
+            gzero = jax.tree.map(jnp.zeros_like, state.params)
+            (new_model_state, _, gsum), ms = jax.lax.scan(
+                accum, (state.model_state, step_rng, gzero), micro
+            )
+            grads = jax.tree.map(lambda g: g / grad_accum, gsum)
+            metrics = jax.tree.map(lambda m: jnp.mean(m, axis=0), ms)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(

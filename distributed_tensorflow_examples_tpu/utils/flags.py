@@ -51,6 +51,13 @@ def define_training_flags(default_batch_size: int = 128, default_steps: int = 10
         "integer", "unroll", 1, "Steps fused per dispatch (lax.scan multi-step trains)."
     )
     _define(
+        "integer",
+        "grad_accum",
+        1,
+        "Gradient-accumulation microbatches per step: activation memory of "
+        "batch/k at full-batch numerics (one optimizer update).",
+    )
+    _define(
         "string",
         "mesh",
         "",

@@ -191,6 +191,7 @@ def test_resnet50_tiny(tmp_path):
         "--batch_size=16",
         "--train_steps=4",
         "--synthetic_examples=64",
+        "--grad_accum=2",  # accumulation path through the CLI
         f"--log_dir={tmp_path}",
     )
     f = _final(out)

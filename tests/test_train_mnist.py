@@ -140,3 +140,51 @@ def test_checkpoint_save_restore_roundtrip(mesh8, tmp_path):
     for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     mgr.close()
+
+
+def test_grad_accum_matches_full_batch(mesh8):
+    """grad_accum=k on batch B must equal one full-batch step exactly (the
+    loss is a global mean, so mean-of-microbatch-grads == full-batch grad)."""
+    cfg = models.mlp.Config(hidden=(32,), compute_dtype="float32")
+    opt = optax.sgd(0.1)
+
+    def make(accum):
+        state, sh = train.create_sharded_state(
+            lambda r: models.mlp.init(cfg, r), opt, jax.random.key(0),
+            mesh=mesh8, rules=(),
+        )
+        step = train.build_train_step(
+            models.mlp.loss_fn(cfg), opt, mesh=mesh8, state_shardings=sh,
+            grad_accum=accum,
+        )
+        return state, step
+
+    s1, step1 = make(1)
+    s4, step4 = make(4)
+    rng = np.random.default_rng(0)
+    for _ in range(4):
+        x = rng.normal(size=(64, 784)).astype(np.float32)
+        y = rng.integers(0, 10, size=(64,)).astype(np.int32)
+        b1 = as_global({"image": x, "label": y}, mesh8)
+        b4 = as_global({"image": x, "label": y}, mesh8)
+        s1, m1 = step1(s1, b1)
+        s4, m4 = step4(s4, b4)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s4.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(m1["loss"]), float(m4["loss"]), rtol=1e-5)
+
+
+def test_grad_accum_rejects_indivisible(mesh8):
+    cfg = models.mlp.Config(hidden=(16,), compute_dtype="float32")
+    opt = optax.sgd(0.1)
+    state, sh = train.create_sharded_state(
+        lambda r: models.mlp.init(cfg, r), opt, jax.random.key(0),
+        mesh=mesh8, rules=(),
+    )
+    step = train.build_train_step(
+        models.mlp.loss_fn(cfg), opt, mesh=mesh8, state_shardings=sh, grad_accum=3
+    )
+    x = np.zeros((64, 784), np.float32)  # 64 % 3 != 0
+    y = np.zeros((64,), np.int32)
+    with pytest.raises(ValueError, match="not divisible by"):
+        step(state, as_global({"image": x, "label": y}, mesh8))

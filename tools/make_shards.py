@@ -62,11 +62,11 @@ def main():
         )
     img, lab = ds.train["image"], ds.train["label"]
     if args.format == "dtxr":
-        # Raw records carry u8 images (4x smaller on disk; the decode_fn
-        # normalizes on read).  Float sources quantize to u8 via min-max.
-        if img.dtype != np.uint8:
-            lo, hi = float(img.min()), float(img.max())
-            img = ((img - lo) / max(hi - lo, 1e-9) * 255).astype(np.uint8)
+        # u8 sources stay u8 (4x smaller on disk; decode_fn normalizes on
+        # read).  Float sources are stored AS f32 records — min-max
+        # quantizing them would irreversibly reshape the input distribution
+        # (the decode path has no way to undo a per-dataset lo/hi), so
+        # shard-trained and in-memory-trained runs would not be comparable.
         paths = native_loader.write_raw_shards(
             args.out,
             {"image": img, "label": lab.astype(np.int32)},
