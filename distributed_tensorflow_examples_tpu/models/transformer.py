@@ -172,22 +172,19 @@ def init(cfg: Config, rng: jax.Array):
     return params
 
 
-def _attention(cfg: Config, mesh, q, k, v, *, allow_custom: bool, warn: bool):
+def _attention(cfg: Config, mesh, q, k, v, *, allow_custom: bool):
     """Attention dispatch: seq-ring / flash / XLA mha (see apply)."""
     T = q.shape[2]
     if allow_custom and mesh is not None and mesh.shape.get("seq", 1) > 1:
-        # Sequence sharded: ring attention over the seq axis.  (Per-chip
-        # block compute is the ring's own online-softmax; an explicit
-        # --attention=flash does not apply here.)
-        if cfg.attention == "flash" and warn:
-            import warnings
-
-            warnings.warn(
-                "attention='flash' is overridden by sequence parallelism "
-                "(seq axis > 1 routes attention through the ppermute "
-                "ring); per-chip compute uses the ring's online softmax."
-            )
-        return attn_ops.sequence_parallel_attention(mesh, q, k, v, causal=cfg.causal)
+        # Sequence sharded: ring attention over the seq axis; per-hop block
+        # compute is the Pallas flash kernel when requested (or on TPU by
+        # default) — ring SP and the flash kernel COMPOSE (ops/attention.py
+        # ring_flash_attention).
+        # cfg.attention values map 1:1 onto ring impls — an explicit "xla"
+        # must NOT silently upgrade to the flash ring.
+        return attn_ops.sequence_parallel_attention(
+            mesh, q, k, v, causal=cfg.causal, impl=cfg.attention
+        )
     if allow_custom and _use_flash(cfg, T):
         if mesh is not None:
             return _flash_sharded(mesh, q, k, v, causal=cfg.causal)
@@ -197,7 +194,7 @@ def _attention(cfg: Config, mesh, q, k, v, *, allow_custom: bool, warn: bool):
     return attn_ops.mha(q, k, v, causal=cfg.causal)
 
 
-def _block(cfg: Config, p, h, *, mesh, constrain, allow_custom_attn=True, warn=False):
+def _block(cfg: Config, p, h, *, mesh, constrain, allow_custom_attn=True):
     """One pre-norm decoder block: attention + (dense | MoE) FFN.
 
     Returns ``(h, aux)``; ``aux`` is the MoE load-balance loss contribution
@@ -218,7 +215,7 @@ def _block(cfg: Config, p, h, *, mesh, constrain, allow_custom_attn=True, warn=F
     q = constrain(q, P("data", "model", "seq", None))
     k = constrain(k, P("data", "model", "seq", None))
     v = constrain(v, P("data", "model", "seq", None))
-    o = _attention(cfg, mesh, q, k, v, allow_custom=allow_custom_attn, warn=warn)
+    o = _attention(cfg, mesh, q, k, v, allow_custom=allow_custom_attn)
     o = jnp.moveaxis(o, 1, 2).reshape(B, T, cfg.dim)
     h = h + layers.dense(p["proj"], o, dtype=cfg.dtype)
     h = constrain(h, P("data", "seq", None))
@@ -317,13 +314,13 @@ def apply(cfg: Config, params, x, *, mesh: Mesh | None = None, return_aux=False)
     else:
         aux_total = jnp.float32(0.0)
 
-        def block_fn(p, h, warn=False):
-            return _block(cfg, p, h, mesh=mesh, constrain=constrain, warn=warn)
+        def block_fn(p, h):
+            return _block(cfg, p, h, mesh=mesh, constrain=constrain)
 
         if cfg.remat:
-            block_fn = jax.checkpoint(block_fn, static_argnums=(2,))
+            block_fn = jax.checkpoint(block_fn)
         for i in range(cfg.n_layers):
-            h, aux = block_fn(params[f"block_{i}"], h, i == 0)
+            h, aux = block_fn(params[f"block_{i}"], h)
             aux_total = aux_total + aux
 
     h = _layernorm(params["ln_f"], h)

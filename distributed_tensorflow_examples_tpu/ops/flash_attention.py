@@ -114,7 +114,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *, scal
         lse_ref[0] = m_sc[:] + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, *, causal, block_q, block_k):
+def _fwd(q, k, v, *, causal, block_q, block_k, out_dtype=None):
     bh, t, d = q.shape
     scale = 1.0 / math.sqrt(d)
     bq, bk = min(block_q, t), min(block_k, t)
@@ -131,7 +131,7 @@ def _fwd(q, k, v, *, causal, block_q, block_k):
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(q.shape, out_dtype or q.dtype),
             jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
         ],
         scratch_shapes=[
@@ -205,16 +205,34 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
 def _bwd(causal, block_q, block_k, res, do):
     q, k, v, o, lse = res
-    bh, t, d = q.shape
-    scale = 1.0 / math.sqrt(d)
-    bq, bk = min(block_q, t), min(block_k, t)
-    delta = jnp.sum(
-        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
-    )  # [bh, t, 1]
+    delta = compute_delta(do, o)
+    bq = _pick_block(q.shape[1], block_q)
+    bk = _pick_block(k.shape[1], block_k)
+    dq = dq_call(q, k, v, do, lse, delta, causal=causal, block_q=bq, block_k=bk)
+    dk, dv = dkv_call(q, k, v, do, lse, delta, causal=causal, block_q=bq, block_k=bk)
+    return dq, dk, dv
 
-    dq = pl.pallas_call(
+
+def compute_delta(do, o):
+    """FA2's D = rowsum(do * o), f32 — shared by the plain and ring paths."""
+    return jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+
+def dq_call(q, k, v, do, lse, delta, *, causal, block_q, block_k, out_dtype=None):
+    """dq for one (q-block x k/v-block) pairing — exposed so ring attention
+    can run the SAME Pallas backward per hop (q local, k/v visiting).
+    q/do/lse/delta: [bh, tq, ...]; k/v: [bh, tk, d].  ``out_dtype``: f32 for
+    ring partials (see fwd_call)."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    bq, bk = min(block_q, tq), min(block_k, tk)
+
+    return pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
-        grid=(bh, t // bq, t // bk),
+        grid=(bh, tq // bq, tk // bk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),  # q
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),  # k
@@ -224,15 +242,24 @@ def _bwd(causal, block_q, block_k, res, do):
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),  # delta
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(q.shape, out_dtype or q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=_params(),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
-    dk, dv = pl.pallas_call(
+
+def dkv_call(q, k, v, do, lse, delta, *, causal, block_q, block_k, out_dtype=None):
+    """dk/dv for one (q-block x k/v-block) pairing (ring-reusable, see
+    dq_call)."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    bq, bk = min(block_q, tq), min(block_k, tk)
+
+    return pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
-        grid=(bh, t // bk, t // bq),
+        grid=(bh, tk // bk, tq // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # q
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # k
@@ -246,8 +273,8 @@ def _bwd(causal, block_q, block_k, res, do):
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct(k.shape, out_dtype or k.dtype),
+            jax.ShapeDtypeStruct(v.shape, out_dtype or v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -256,7 +283,19 @@ def _bwd(causal, block_q, block_k, res, do):
         compiler_params=_params(),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+
+
+def fwd_call(q, k, v, *, causal, block_q, block_k, out_dtype=None):
+    """(o, lse) forward for one block pairing — ring attention's per-hop
+    compute (lse enables exact cross-hop online-softmax merging).
+
+    ``out_dtype``: set f32 when the result is a PARTIAL to be merged — the
+    kernel's accumulator is f32 already, and rounding each hop's partial to
+    bf16 before merging accumulates O(n_hops) quantization error."""
+    return _fwd(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        out_dtype=out_dtype,
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
