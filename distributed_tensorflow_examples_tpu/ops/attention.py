@@ -184,19 +184,22 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, block_q, block_k):
             lambda x: collectives.ring_permute(x, axis_name, shift=-1), (kr, vr)
         )
         src = (my + i) % n
+
         # Never the diagonal for i in 1..n-1 — statically non-causal kernel;
         # under causal masking the whole block is visible iff src < my.
-        o_h, lse_h = fa.fwd_call(
-            qf, kr, vr, causal=False, block_q=bq, block_k=bk,
-            out_dtype=jnp.float32,
-        )
-        o_m, lse_m = _merge(o, lse, o_h, lse_h)
+        # lax.cond skips the kernel entirely on masked hops (no wasted
+        # compute, and nothing numerically suspect ever materialises).
+        def visit(o, lse):
+            o_h, lse_h = fa.fwd_call(
+                qf, kr, vr, causal=False, block_q=bq, block_k=bk,
+                out_dtype=jnp.float32,
+            )
+            return _merge(o, lse, o_h, lse_h)
+
         if causal:
-            vis = (src < my).astype(jnp.float32)
-            o = o * (1 - vis) + o_m * vis
-            lse = lse * (1 - vis) + lse_m * vis
+            o, lse = lax.cond(src < my, visit, lambda o, lse: (o, lse), o, lse)
         else:
-            o, lse = o_m, lse_m
+            o, lse = visit(o, lse)
         return (o, lse, kr, vr), None
 
     if n > 1:
@@ -242,18 +245,30 @@ def _ring_flash_bwd_rule(axis_name, causal, block_q, block_k, res, do):
             (kr, vr, dk, dv),
         )
         src = (my + i) % n
-        dq_h = fa.dq_call(
-            qf, kr, vr, dof, lse, delta, causal=False, block_q=bq, block_k=bk,
-            out_dtype=f32,
-        )
-        dk_h, dv_h = fa.dkv_call(
-            qf, kr, vr, dof, lse, delta, causal=False, block_q=bq, block_k=bk,
-            out_dtype=f32,
-        )
-        vis = (src < my).astype(f32) if causal else f32(1.0)
-        dq = dq + dq_h * vis
-        dk = dk + dk_h * vis
-        dv = dv + dv_h * vis
+
+        # lax.cond, NOT a multiply-by-zero mask: on a fully-masked hop the
+        # non-causal kernel computes exp(s - lse) where lse covers only
+        # VISIBLE keys — a masked score exceeding lse by ~88 overflows f32
+        # exp, and 0 * inf would poison the gradients with NaN.  The cond
+        # never runs the kernel there (and skips ~half the off-diagonal
+        # backward FLOPs under causal masking).
+        def visit(dq, dk, dv):
+            dq_h = fa.dq_call(
+                qf, kr, vr, dof, lse, delta, causal=False, block_q=bq,
+                block_k=bk, out_dtype=f32,
+            )
+            dk_h, dv_h = fa.dkv_call(
+                qf, kr, vr, dof, lse, delta, causal=False, block_q=bq,
+                block_k=bk, out_dtype=f32,
+            )
+            return dq + dq_h, dk + dk_h, dv + dv_h
+
+        if causal:
+            dq, dk, dv = lax.cond(
+                src < my, visit, lambda dq, dk, dv: (dq, dk, dv), dq, dk, dv
+            )
+        else:
+            dq, dk, dv = visit(dq, dk, dv)
         return (dq, kr, vr, dk, dv), None
 
     if n > 1:
@@ -297,13 +312,24 @@ def sequence_parallel_attention(
     (Pallas kernels fwd+bwd), or "auto" (flash on TPU, xla elsewhere —
     interpret-mode Pallas inside a scan is prohibitively slow on CPU).
     """
+    if impl not in ("auto", "xla", "flash"):
+        raise ValueError(f"impl must be auto|xla|flash, got {impl!r}")
     if mesh.shape.get(seq_axis, 1) == 1:
         return mha(q, k, v, causal=causal)
     h_entry = head_axis if mesh.shape.get(head_axis, 1) > 1 else None
     spec = P(batch_axis, h_entry, seq_axis, None)
 
     if impl == "auto":
-        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+        # Same gate as the non-ring auto path (_use_flash): flash only on
+        # TPU AND when the per-shard length tiles cleanly — an awkward
+        # T_local would degrade to tiny Pallas blocks, slower than the
+        # XLA ring.
+        t_local = q.shape[2] // mesh.shape[seq_axis]
+        impl = (
+            "flash"
+            if jax.default_backend() == "tpu" and t_local % 512 == 0
+            else "xla"
+        )
     if impl == "flash":
         fn = functools.partial(
             ring_flash_attention, axis_name=seq_axis, causal=causal
