@@ -94,9 +94,9 @@ def _use_flash(cfg: Config, seq_len: int) -> bool:
     if cfg.attention == "flash":
         return True
     if cfg.attention == "auto":
-        # Flash needs block-divisible T; on TPU it wins from moderate T up
-        # (BASELINE.md kernel table) and is mandatory at long context.
-        return jax.default_backend() == "tpu" and seq_len % 512 == 0
+        from ..ops.flash_attention import flash_viable
+
+        return flash_viable(seq_len)
     return False
 
 

@@ -320,16 +320,9 @@ def sequence_parallel_attention(
     spec = P(batch_axis, h_entry, seq_axis, None)
 
     if impl == "auto":
-        # Same gate as the non-ring auto path (_use_flash): flash only on
-        # TPU AND when the per-shard length tiles cleanly — an awkward
-        # T_local would degrade to tiny Pallas blocks, slower than the
-        # XLA ring.
-        t_local = q.shape[2] // mesh.shape[seq_axis]
-        impl = (
-            "flash"
-            if jax.default_backend() == "tpu" and t_local % 512 == 0
-            else "xla"
-        )
+        from .flash_attention import flash_viable
+
+        impl = "flash" if flash_viable(q.shape[2] // mesh.shape[seq_axis]) else "xla"
     if impl == "flash":
         fn = functools.partial(
             ring_flash_attention, axis_name=seq_axis, causal=causal

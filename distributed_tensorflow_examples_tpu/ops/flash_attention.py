@@ -331,6 +331,18 @@ def _pick_block(t: int, want: int) -> int:
     return b
 
 
+def flash_viable(t: int) -> bool:
+    """Shared auto-dispatch gate: flash pays off on TPU when the (per-shard)
+    sequence tiles cleanly; awkward lengths degrade to tiny Pallas blocks,
+    slower than XLA attention.  Used by both the non-ring auto path
+    (models/transformer._use_flash) and the ring auto path
+    (ops/attention.sequence_parallel_attention) so the two policies cannot
+    drift."""
+    import jax as _jax
+
+    return _jax.default_backend() == "tpu" and t % 512 == 0
+
+
 def flash_attention(
     q, k, v, *, causal: bool = False, block_q: int = 1024, block_k: int = 1024
 ):

@@ -128,3 +128,27 @@ def test_ring_flash_grads_match_full(causal):
         np.testing.assert_allclose(
             np.asarray(b), np.asarray(a), rtol=2e-4, atol=2e-4
         )
+
+
+def test_ring_flash_causal_grads_finite_at_large_scores():
+    """Regression pin for the masked-hop NaN hazard: with large attention
+    logits, a masked (future) hop's exp(s - lse) overflows f32; the lax.cond
+    skip must keep causal ring-flash gradients finite (the mask-multiply
+    formulation it replaced produced 0 * inf = NaN here)."""
+    mesh = local_mesh_for_testing({"data": 2, "seq": 4})
+    q, k, v = _qkv(t=16, d=8, seed=9)
+    q, k = q * 30.0, k * 30.0  # scores ~ O(thousands) >> visible-key lse
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("data", None, "seq", None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+
+    def loss(q, k, v):
+        return jnp.sum(
+            A.sequence_parallel_attention(mesh, q, k, v, causal=True, impl="flash")
+        )
+
+    grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(qs, ks, vs)
+    for g in grads:
+        assert np.isfinite(np.asarray(g)).all()

@@ -218,6 +218,27 @@ def test_transformer_unroll(tmp_path):
     assert 0 < f["final_perplexity"] < 2 * 512, f
 
 
+def test_transformer_sequence_parallel(tmp_path):
+    """Flagship on a data=2,seq=2,model=2 mesh: ring attention (SP x TP x DP)
+    from the CLI."""
+    out = _run(
+        "transformer_lm.py",
+        "--mesh=data=2,seq=2,model=2",
+        "--train_steps=8",
+        "--batch_size=8",
+        "--dim=64",
+        "--n_layers=2",
+        "--n_heads=4",
+        "--seq_len=64",
+        "--vocab_size=512",
+        "--attention=xla",  # interpret-mode Pallas in the ring is CPU-slow
+        f"--log_dir={tmp_path}",
+    )
+    f = _final(out)
+    assert f["step"] == 8
+    assert 0 < f["final_perplexity"] < 2 * 512, f
+
+
 def test_transformer_pipeline_parallel(tmp_path):
     """Flagship on a data=2,pipe=2,model=2 mesh: GPipe pipeline from the CLI."""
     out = _run(
