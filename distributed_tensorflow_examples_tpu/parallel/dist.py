@@ -184,6 +184,9 @@ def start_watchdog(
     grace_s: float = 10.0,
     startup_grace_s: float = 120.0,
     on_failure=None,
+    _client=None,
+    _idx=None,
+    _count=None,
 ):
     """Detect dead peers and fail FAST instead of hanging in a collective.
 
@@ -215,6 +218,7 @@ def start_watchdog(
 
     ``on_failure(dead: list[int])`` overrides the default ``os._exit``.
     Returns True if started (multi-process with a live client), else False.
+    ``_client``/``_idx``/``_count`` are test seams (fake KV client).
     """
     global _watchdog_thread, _watchdog_stop
     import threading
@@ -222,8 +226,16 @@ def start_watchdog(
 
     if _watchdog_thread is not None:
         return True
-    client = getattr(jax._src.distributed.global_state, "client", None)
-    if client is None or jax.process_count() < 2:
+    client = (
+        _client
+        if _client is not None
+        else getattr(jax._src.distributed.global_state, "client", None)
+    )
+    if client is None:
+        return False
+    idx = jax.process_index() if _idx is None else _idx
+    count = jax.process_count() if _count is None else _count
+    if count < 2:
         return False
     if grace_s < 3 * interval_s:
         # A grace below ~3 beats would declare live peers dead whenever two
@@ -233,7 +245,6 @@ def start_watchdog(
             grace_s, interval_s, 3 * interval_s,
         )
         grace_s = 3 * interval_s
-    idx, count = jax.process_index(), jax.process_count()
     stop = threading.Event()
 
     def _beat():
@@ -321,18 +332,22 @@ def start_watchdog(
     return True
 
 
-def stop_watchdog() -> None:
+def stop_watchdog(*, _client=None, _idx=None) -> None:
     """Stop heartbeating and announce a CLEAN departure to the peers (they
-    must not treat this process's silence as a crash)."""
+    must not treat this process's silence as a crash).  ``_client``/``_idx``
+    are the same test seams as start_watchdog's."""
     global _watchdog_thread, _watchdog_stop
     if _watchdog_stop is not None:
         _watchdog_stop.set()
-        client = getattr(jax._src.distributed.global_state, "client", None)
+        client = (
+            _client
+            if _client is not None
+            else getattr(jax._src.distributed.global_state, "client", None)
+        )
         if client is not None:
             try:
-                client.key_value_set(
-                    f"dtx/hb/{jax.process_index()}", "done", allow_overwrite=True
-                )
+                idx = jax.process_index() if _idx is None else _idx
+                client.key_value_set(f"dtx/hb/{idx}", "done", allow_overwrite=True)
             except Exception:
                 pass  # service already torn down
     _watchdog_thread = None

@@ -1,0 +1,161 @@
+"""Watchdog monitor logic unit tests (parallel/dist.py start_watchdog) with
+a fake coordination-service KV client — the fast-path pins for branches the
+slow 2-process elastic e2e (test_elastic.py) can't isolate: clean 'done'
+departures, startup-silence detection, grace clamping, transient-KV retry."""
+
+import threading
+import time
+
+import pytest
+
+from distributed_tensorflow_examples_tpu.parallel import dist
+
+
+class FakeClient:
+    """dict-backed stand-in for the coordination-service KV client."""
+
+    def __init__(self):
+        self.kv = {}
+        self.fail_next = 0
+        self.lock = threading.Lock()
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        with self.lock:
+            if self.fail_next > 0:
+                self.fail_next -= 1
+                raise RuntimeError("transient KV error")
+            self.kv[key] = value
+
+    def key_value_dir_get(self, prefix):
+        with self.lock:
+            if self.fail_next > 0:
+                self.fail_next -= 1
+                raise RuntimeError("transient KV error")
+            return [(k, v) for k, v in self.kv.items() if k.startswith(prefix)]
+
+
+@pytest.fixture(autouse=True)
+def _clean_watchdog():
+    dist.stop_watchdog()
+    yield
+    dist.stop_watchdog()
+
+
+def _start(client, fired, **kw):
+    kw.setdefault("interval_s", 0.05)
+    kw.setdefault("grace_s", 0.2)
+    assert dist.start_watchdog(
+        on_failure=lambda dead: fired.append(sorted(dead)),
+        _client=client,
+        _idx=0,
+        _count=2,
+        **kw,
+    )
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_dead_peer_detected():
+    c, fired = FakeClient(), []
+    c.kv["dtx/hb/1"] = "7"  # peer beat once, then froze
+    _start(c, fired)
+    assert _wait(lambda: fired), "frozen peer never declared dead"
+    assert fired[0] == [1]
+
+
+def test_live_peer_not_declared_dead():
+    c, fired = FakeClient(), []
+    stop = threading.Event()
+
+    def peer_beats():
+        s = 0
+        while not stop.is_set():
+            s += 1
+            c.key_value_set("dtx/hb/1", str(s), allow_overwrite=True)
+            time.sleep(0.01)
+
+    t = threading.Thread(target=peer_beats, daemon=True)
+    t.start()
+    _start(c, fired, grace_s=0.5)
+    time.sleep(1.2)
+    stop.set()
+    assert not fired, fired
+
+
+def test_done_peer_is_clean_departure():
+    c, fired = FakeClient(), []
+    c.kv["dtx/hb/1"] = "done"  # peer exited cleanly via stop_watchdog()
+    _start(c, fired)
+    time.sleep(1.0)
+    assert not fired, fired
+
+
+def test_startup_silence_declared_dead():
+    """A peer that NEVER publishes a first beat (died during model init) is
+    detected once startup_grace_s elapses."""
+    c, fired = FakeClient(), []
+    _start(c, fired, startup_grace_s=0.3)
+    assert _wait(lambda: fired), "silent-from-birth peer never declared dead"
+    assert fired[0] == [1]
+
+
+def test_transient_kv_errors_survive():
+    """A few KV failures neither stop the heartbeat nor fire false alarms."""
+    c, fired = FakeClient(), []
+    stop = threading.Event()
+
+    def peer_beats():
+        s = 0
+        while not stop.is_set():
+            s += 1
+            with c.lock:
+                c.kv["dtx/hb/1"] = str(s)
+            time.sleep(0.03)
+
+    threading.Thread(target=peer_beats, daemon=True).start()
+    _start(c, fired)
+    time.sleep(0.3)
+    c.fail_next = 4  # burst of transient errors across beat + monitor
+    time.sleep(1.0)
+    stop.set()
+    assert not fired, fired
+    assert c.kv.get("dtx/hb/0") not in (None, "done")  # our beat recovered
+
+
+def test_grace_clamped_below_three_beats():
+    """grace < 3x interval would false-positive on a live peer; the clamp
+    must keep a continuously-beating peer alive."""
+    c, fired = FakeClient(), []
+    stop = threading.Event()
+
+    def peer_beats():
+        s = 0
+        while not stop.is_set():
+            s += 1
+            c.key_value_set("dtx/hb/1", str(s), allow_overwrite=True)
+            time.sleep(0.02)
+
+    threading.Thread(target=peer_beats, daemon=True).start()
+    # interval 0.1 with grace 0.01 clamps to 0.3 = 3 beats; the peer beats
+    # every 0.02s (15x margin) so only a pathological stall could trip it.
+    _start(c, fired, interval_s=0.1, grace_s=0.01)
+    time.sleep(1.2)
+    stop.set()
+    assert not fired, fired
+
+
+def test_stop_watchdog_publishes_done():
+    """The real stop_watchdog must write the 'done' sentinel peers rely on
+    for clean-departure detection (driven via its _client seam, not by
+    pre-seeding the fake KV)."""
+    c, fired = FakeClient(), []
+    _start(c, fired)
+    dist.stop_watchdog(_client=c, _idx=0)
+    assert c.kv.get("dtx/hb/0") == "done"
