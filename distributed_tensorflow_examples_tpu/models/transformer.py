@@ -77,6 +77,17 @@ class Config:
     def head_dim(self):
         return self.dim // self.n_heads
 
+    @property
+    def data_axes(self):
+        """Mesh axes the batch dim shards over.  MoE mode shards the batch
+        over ``('data','expert')`` JOINTLY — experts live on the 'expert'
+        axis, so tokens must physically leave their home rank to reach
+        their expert: that redistribution is the GShard ``all_to_all``.
+        (With the batch on 'data' alone, activations replicate over the
+        expert axis and GSPMD serves dispatch with all-gathers instead —
+        the round-2 HLO tables' finding.)"""
+        return ("data", "expert") if self.moe_experts > 0 else ("data",)
+
 
 def _layernorm_init(d):
     return {"scale": jnp.ones((d,), jnp.float32), "bias": jnp.zeros((d,), jnp.float32)}
@@ -212,21 +223,22 @@ def _block(cfg: Config, p, h, *, mesh, constrain, allow_custom_attn=True):
     q, k, v = [
         jnp.moveaxis(qkv[:, :, :, j], 2, 1) for j in range(3)
     ]  # [B,H,T,hd], heads shardable over 'model'
-    q = constrain(q, P("data", "model", "seq", None))
-    k = constrain(k, P("data", "model", "seq", None))
-    v = constrain(v, P("data", "model", "seq", None))
+    da = cfg.data_axes
+    q = constrain(q, P(da, "model", "seq", None))
+    k = constrain(k, P(da, "model", "seq", None))
+    v = constrain(v, P(da, "model", "seq", None))
     o = _attention(cfg, mesh, q, k, v, allow_custom=allow_custom_attn)
     o = jnp.moveaxis(o, 1, 2).reshape(B, T, cfg.dim)
     h = h + layers.dense(p["proj"], o, dtype=cfg.dtype)
-    h = constrain(h, P("data", "seq", None))
+    h = constrain(h, P(da, "seq", None))
 
     aux = jnp.float32(0.0)
     if "moe" in p:
         from ..ops import moe as moe_ops
 
         y = _layernorm(p["ln2"], h)
-        y, aux = moe_ops.apply(p["moe"], y, _moe_cfg(cfg), dtype=cfg.dtype)
-        h = constrain(h + y, P("data", "seq", None))
+        y, aux = moe_ops.apply(p["moe"], y, _moe_cfg(cfg), dtype=cfg.dtype, mesh=mesh)
+        h = constrain(h + y, P(da, "seq", None))
     else:
         h = _mlp_tail(cfg, p, h, constrain)
     return h, aux
@@ -238,10 +250,10 @@ def _mlp_tail(cfg: Config, p, h, constrain):
     paths cannot drift."""
     y = _layernorm(p["ln2"], h)
     y = layers.dense(p["mlp_in"], y, dtype=cfg.dtype)
-    y = constrain(y, P("data", "seq", "model"))
+    y = constrain(y, P(cfg.data_axes, "seq", "model"))
     y = jax.nn.gelu(y)
     h = h + layers.dense(p["mlp_out"], y, dtype=cfg.dtype)
-    return constrain(h, P("data", "seq", None))
+    return constrain(h, P(cfg.data_axes, "seq", None))
 
 
 def apply(cfg: Config, params, x, *, mesh: Mesh | None = None, return_aux=False):
@@ -265,7 +277,7 @@ def apply(cfg: Config, params, x, *, mesh: Mesh | None = None, return_aux=False)
 
     h = layers.embedding_lookup(params["emb"], x, dtype=cfg.dtype)
     h = h + params["pos"]["table"][:T].astype(cfg.dtype)[None]
-    h = constrain(h, P("data", "seq", None))
+    h = constrain(h, P(cfg.data_axes, "seq", None))
 
     if cfg.pipeline_stages > 1:
         from ..parallel import pipeline as pipeline_lib
@@ -449,9 +461,10 @@ def loss_fn(cfg: Config, *, mesh: Mesh | None = None):
     return f
 
 
-def batch_spec() -> P:
-    """[B, T] batches shard batch over 'data' AND sequence over 'seq'."""
-    return P("data", "seq")
+def batch_spec(cfg: Config | None = None) -> P:
+    """[B, T] batches shard batch over 'data' AND sequence over 'seq' —
+    plus 'expert' on the batch dim in MoE mode (see Config.data_axes)."""
+    return P(cfg.data_axes if cfg is not None else "data", "seq")
 
 
 #: Megatron-style TP rules for ONE block: qkv/mlp_in column-sharded (output

@@ -70,29 +70,59 @@ def capacity(group_tokens: int, moe: MoEConfig) -> int:
     return max(4, c)
 
 
-def _group(n: int, want: int) -> int:
-    """Largest divisor of ``n`` that is <= ``want`` (the routing-group size)."""
+def _group(n: int, want: int, shards: int = 1) -> int:
+    """Largest divisor of ``n`` that is <= ``want`` (the routing-group size).
+
+    ``shards``: number of mesh shards the flattened token dim arrives
+    distributed over (data x expert).  The group count N/g must be a
+    multiple of it, so groups never straddle a shard boundary — routing
+    then stays shard-local and only the dispatched [E, G, C, D] buffers
+    cross the mesh (as all_to_all).  Falls back to plain divisor-of-N when
+    no such g exists (e.g. tiny unit-test shapes)."""
+    g = min(want, n)
+    while g > 1 and not (n % g == 0 and (n // g) % shards == 0):
+        g -= 1
+    if g > 1 or n % shards == 0:
+        return g
     g = min(want, n)
     while n % g:
         g -= 1
     return g
 
 
-def apply(p, x, moe: MoEConfig, *, dtype=None):
+def apply(p, x, moe: MoEConfig, *, dtype=None, mesh=None):
     """x: [B, T, D] -> (y [B, T, D], aux_loss scalar f32).
 
     Routing runs in f32 (softmax/top-k numerics); expert matmuls in
     ``dtype`` (bf16 on TPU) like every other dense layer.  Tokens route
     within groups of ``moe.group_size`` (capacity is per group), the GShard
     construction that keeps the dispatch tensors linear in total tokens.
+
+    With ``mesh`` (carrying an ``expert`` axis): tokens arrive sharded over
+    ``('data','expert')`` (the caller shards its batch over BOTH axes —
+    models/transformer.py ``data_axes``), expert_in/out are pinned to
+    ``P('expert','data',...)``, and the group->expert redistribution on each
+    side of the expert FFN lowers to a genuine ``all_to_all`` over the
+    expert axis (asserted at the HLO level by tests/test_hlo_sharding.py).
+    Without a mesh the einsums run locally (unit tests, single chip).
     """
     B, T, D = x.shape
     E, k = moe.n_experts, moe.top_k
     N = B * T
-    g = _group(N, moe.group_size)
+    shards = 1
+    if mesh is not None:
+        shards = mesh.shape.get("data", 1) * mesh.shape.get("expert", 1)
+    g = _group(N, moe.group_size, shards)
     G = N // g
     C = capacity(g, moe)
     tok = x.reshape(G, g, D)
+    if mesh is not None and G % shards == 0:
+        # Keep the group dim on the token shards across the reshape: groups
+        # are whole-shard slices (see _group), so this is a no-move pin.
+        tok = jax.lax.with_sharding_constraint(
+            tok,
+            jax.sharding.NamedSharding(mesh, P(("data", "expert"), None, None)),
+        )
 
     logits = jnp.einsum("gnd,de->gne", tok.astype(jnp.float32), p["router"]["kernel"])
     probs = jax.nn.softmax(logits, axis=-1)  # [G, g, E]
@@ -126,14 +156,14 @@ def apply(p, x, moe: MoEConfig, *, dtype=None):
     cd = jnp.float32 if dtype is None else dtype
     expert_in = jnp.einsum(
         "gnec,gnd->egcd", dispatch.astype(cd), tok.astype(cd)
-    )  # [E, G, C, D] — expert x group: the all_to_all boundary (expert
-    # sharded over 'expert', groups follow the batch's 'data' sharding)
-    expert_in = _constrain_expert(expert_in)
+    )  # [E, G, C, D] — expert x group: the all_to_all boundary (tokens
+    # leave their home ('data','expert') shard for their expert's rank)
+    expert_in = _constrain_expert(expert_in, mesh)
     h = jnp.einsum("egcd,edh->egch", expert_in, p["w1"].astype(cd))
     h = jax.nn.gelu(h + p["b1"].astype(cd)[:, None, None, :])
     out = jnp.einsum("egch,ehd->egcd", h, p["w2"].astype(cd))
     out = out + p["b2"].astype(cd)[:, None, None, :]
-    out = _constrain_expert(out)
+    out = _constrain_expert(out, mesh)
     y = jnp.einsum("gnec,egcd->gnd", combine.astype(cd), out)
 
     # Switch load-balance loss: E * sum_e (tokens routed to e / N) * mean_e
@@ -146,14 +176,26 @@ def apply(p, x, moe: MoEConfig, *, dtype=None):
     return y.reshape(B, T, D).astype(x.dtype), aux
 
 
-def _constrain_expert(t):
-    """Pin the expert dim's sharding when a mesh context is live (group/
-    capacity dims are left to propagation — the group count can be 1, which
-    must not be forced onto the 'data' axis)."""
-    try:
-        return jax.lax.with_sharding_constraint(t, P("expert", None, None, None))
-    except Exception:
-        return t  # no mesh context (pure CPU unit tests)
+def _constrain_expert(t, mesh):
+    """Pin [E, G, C, D] to ``P('expert','data',...)`` between the dispatch/
+    combine einsums and the expert FFN: E on the expert ranks (each holds its
+    experts' capacity buffers), G back on the data axis.  Because the input
+    tokens are sharded over ``('data','expert')`` on G's flattened source,
+    this reshard is exactly the GShard all_to_all.
+
+    Explicit-mesh (round-3 fix): the previous bare-``PartitionSpec`` +
+    ``except Exception`` form silently no-op'd under the jitted train step
+    (which establishes no global mesh context) — per ADVICE.md, failures
+    must propagate.  Skips only the two legitimate cases: no mesh given
+    (unit tests / single chip) or a mesh without an ``expert`` axis; G is
+    left unconstrained when it doesn't divide the data axis (a 1-group
+    input must not be forced onto 'data')."""
+    if mesh is None or mesh.shape.get("expert", 1) <= 1:
+        return t
+    g_entry = "data" if t.shape[1] % mesh.shape.get("data", 1) == 0 else None
+    return jax.lax.with_sharding_constraint(
+        t, jax.sharding.NamedSharding(mesh, P("expert", g_entry, None, None))
+    )
 
 
 #: Rule fragment for a block containing one MoE layer under prefix `moe/`.

@@ -74,12 +74,24 @@ def parse_collectives(hlo_text: str) -> list[Collective]:
             continue
         sizes = [_shape_bytes(dt, dims) for dt, dims in shapes]
         if suffix == "-start":
-            # Async form returns (operands..., results..., context...): the
-            # operand and result halves mirror each other, so half the tuple
-            # total is the payload (context scalars are ~0 bytes).  max()
-            # would count only the largest tensor of a variadic fused
-            # collective and undercount multi-tensor all-reduces badly.
-            total = sum(sizes) // 2 if len(sizes) > 1 else sizes[0]
+            # Async form returns (operands..., results..., context...).  For
+            # all-reduce/collective-permute the operand and result halves
+            # mirror each other, so half the tuple total is the payload
+            # (context scalars are ~0 bytes); max() would count only the
+            # largest tensor of a variadic fused collective and undercount
+            # multi-tensor all-reduces badly.  For all-gather/reduce-scatter
+            # the result is N x (or 1/N of) the operand, so the halves do
+            # NOT mirror: the transfer-relevant payload is the LARGER side
+            # (gathered result / pre-scatter operand), whose ring transfer
+            # moves (N-1)/N of those bytes.
+            if op in ("all-gather", "reduce-scatter") and len(sizes) > 1:
+                # (operands..., results...): each result pairs with one
+                # operand and the larger of each pair is transfer-relevant;
+                # with k pairs that is exactly the k largest tuple entries.
+                k = max(1, len(sizes) // 2)
+                total = sum(sorted(sizes, reverse=True)[:k])
+            else:
+                total = sum(sizes) // 2 if len(sizes) > 1 else sizes[0]
         else:
             total = sum(sizes)  # sync variadic tuple = genuinely N payloads
         out.append(

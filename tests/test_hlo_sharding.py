@@ -151,3 +151,47 @@ def test_parser_sees_known_collectives():
     assert s["all-reduce"]["count"] == 2
     assert s["all-reduce"]["bytes"] == 1024 * 128 * 4 + 2 * 16 * 4
     assert s["all-gather"]["bytes"] == 64 * 64 * 2
+
+
+def test_moe_dispatch_lowers_to_all_to_all():
+    """SURVEY.md section 2b D11 lists all_to_all as a native collective role;
+    ops/moe.py claims the GShard dispatch lowers to it over the expert axis.
+    Round 2 found the compiled step emitted zero all-to-alls (the expert
+    constraint was silently swallowed and the batch never sharded over
+    'expert').  This test is the guard: compile the REAL MoE train step on a
+    data=2 x expert=4 mesh with the batch sharded over ('data','expert')
+    (models.transformer.batch_spec(cfg)) and assert (a) all-to-all is
+    present, (b) no expert-weight-sized all-gather serves dispatch instead.
+    """
+    from distributed_tensorflow_examples_tpu.parallel import local_mesh_for_testing
+
+    mesh = local_mesh_for_testing({"data": 2, "expert": 4})
+    cfg = models.transformer.Config(
+        vocab_size=512, dim=64, n_layers=2, n_heads=4, max_seq_len=64,
+        compute_dtype="float32", attention="xla", moe_experts=8,
+    )
+    opt = optax.sgd(0.1)
+    B, T = 16, 64
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, size=(B, T + 1)).astype(np.int32)
+    batch = {"x": toks[:, :-1], "y": toks[:, 1:]}
+    hlo = _compile_step(
+        models.transformer.loss_fn(cfg, mesh=mesh),
+        opt,
+        mesh,
+        models.transformer.sharding_rules(cfg),
+        lambda r: models.transformer.init(cfg, r),
+        batch,
+        batch_spec=models.transformer.batch_spec(cfg),
+    )
+    s = hlo_analysis.summarize(hlo_analysis.parse_collectives(hlo))
+    assert "all-to-all" in s, f"no all-to-all in MoE step; saw {sorted(s)}"
+    # Dispatch must not be served by gathering expert FFN weights instead:
+    # each expert's w1 is [dim, 4*dim] f32; an all-gather at full-weight
+    # scale (all experts' w1 = E * dim * 4dim * 4B) means GSPMD replicated
+    # the expert weights rather than moving tokens.
+    full_w1_bytes = cfg.moe_experts * cfg.dim * 4 * cfg.dim * 4
+    ag = hlo_analysis.max_tensor_bytes(hlo, "all-gather")
+    assert ag < full_w1_bytes, (
+        f"all-gather of {ag} B >= stacked expert weights ({full_w1_bytes} B)"
+    )
