@@ -182,10 +182,11 @@ def bench_resnet50(steps: int, batch_per_chip: int, image_size: int = 224):
 
     from distributed_tensorflow_examples_tpu import models
 
+    cfg = models.resnet.Config()
     return _bench(
         "resnet50",
         models.resnet,
-        models.resnet.Config(),
+        cfg,
         optax.sgd(0.1, momentum=0.9),
         lambda rng, n: {
             "image": rng.normal(size=(n, image_size, image_size, 3)).astype("float32"),
@@ -194,21 +195,36 @@ def bench_resnet50(steps: int, batch_per_chip: int, image_size: int = 224):
         steps=steps,
         batch_per_chip=batch_per_chip,
         warmup=5,
+        # NOTE deliberately NOT mesh-aware: the fused-BN experiments (ops/
+        # bn.py) measured SLOWER than XLA's own reduce emitter end-to-end —
+        # Pallas stats forced layout-conversion copies (+39 ms/step) and
+        # broke conv fusion chains; MXU-matmul stats got algebraically
+        # simplified back into the same reduces plus loop overhead.  Full
+        # account: BASELINE.md r3 ResNet section.
     )
 
 
 def bench_transformer(
-    steps: int, batch_per_chip: int, seq_len: int = 2048, remat: bool = False
+    steps: int, batch_per_chip: int, seq_len: int = 2048, remat: bool = False,
+    loss_chunks: int = 8, n_heads: int = 8,
 ):
-    """Transformer LM tokens/sec/chip + MFU (flash attention on TPU)."""
+    """Transformer LM tokens/sec/chip + MFU (flash attention on TPU).
+
+    ``loss_chunks=8`` (default): the chunked head+CE path — the [B, T, 32k]
+    logits never materialise, which is what lets batch 16 fit in 16 GB
+    without remat (BASELINE.md r3 flagship account).
+    """
     import numpy as np
     import optax
 
     from distributed_tensorflow_examples_tpu import models
 
+    # n_heads=8 -> head_dim 128: the MXU-native head width (128-wide
+    # contraction/output lanes; head_dim 64 runs the attention matmuls at
+    # half the MXU issue rate and doubles the per-head softmax VPU area).
     cfg = models.transformer.Config(
-        vocab_size=32000, dim=1024, n_layers=12, n_heads=16, max_seq_len=seq_len,
-        remat=remat,
+        vocab_size=32000, dim=1024, n_layers=12, n_heads=n_heads,
+        max_seq_len=seq_len, remat=remat, loss_chunks=loss_chunks,
     )
 
     def make_batch(rng: np.random.Generator, n: int):
@@ -325,6 +341,8 @@ def main():
     ap.add_argument("--batch-per-chip", type=int, default=None)
     ap.add_argument("--seq-len", type=int, default=None)
     ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--loss-chunks", type=int, default=8)
+    ap.add_argument("--n-heads", type=int, default=8)
     args = ap.parse_args()
 
     if args.model == "resnet50":
@@ -332,8 +350,8 @@ def main():
         r = bench_resnet50(args.steps or 30, args.batch_per_chip or 256)
     elif args.model == "transformer":
         r = bench_transformer(
-            args.steps or 10, args.batch_per_chip or 8, args.seq_len or 2048,
-            remat=args.remat,
+            args.steps or 10, args.batch_per_chip or 16, args.seq_len or 2048,
+            remat=args.remat, loss_chunks=args.loss_chunks, n_heads=args.n_heads,
         )
     elif args.model == "lstm":
         r = bench_lstm(args.steps or 50, args.batch_per_chip or 256, args.seq_len or 20)

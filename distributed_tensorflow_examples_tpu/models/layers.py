@@ -114,13 +114,43 @@ def batchnorm_init(c: int):
     return params, stats
 
 
-def batchnorm(params, stats, x, *, train: bool, momentum=0.9, eps=1e-5):
+def batchnorm(
+    params, stats, x, *, train: bool, momentum=0.9, eps=1e-5, mesh=None,
+    relu: bool = False,
+):
     """Returns (y, new_stats).  In train mode the batch statistics are
     computed over the *global* batch: under jit with the batch sharded on the
     data axis, the mean/var reductions become cross-replica (XLA inserts the
     all-reduce) — matching SyncBatchNorm semantics, which is what mirrored
-    data-parallel training wants."""
+    data-parallel training wants.
+
+    ``mesh`` (TPU): opts into the EXPERIMENTAL fused statistics path
+    (ops/bn.py — Pallas kernels or MXU-matmul forms, gradient-exact vs this
+    path).  Measured end-to-end on the current XLA/axon stack it is SLOWER
+    than the XLA path (layout-conversion copies / algebraic re-simplification
+    — BASELINE.md r3 table), so no shipped model threads a mesh in by
+    default; the code is retained as measured evidence and for stacks where
+    those compiler behaviors change.  Callers without a mesh always get the
+    XLA path (a pallas_call on an implicitly-sharded array would force a
+    gather).
+
+    ``relu``: apply ReLU to the output INSIDE this layer.  On the fused
+    path the backward then recomputes the mask in-kernel instead of
+    materialising the masked gradient (the r3 profile's +29 ms trap);
+    semantically identical to relu(batchnorm(x))."""
     if train:
+        from ..ops import bn as bn_ops
+
+        if mesh is not None and bn_ops._use_pallas():
+            y, mean, var = bn_ops.batchnorm_train(
+                params["scale"], params["bias"], x, eps, mesh, relu
+            )
+            mean, var = jax.lax.stop_gradient((mean, var))
+            new_stats = {
+                "mean": momentum * stats["mean"] + (1 - momentum) * mean,
+                "var": momentum * stats["var"] + (1 - momentum) * var,
+            }
+            return y, new_stats
         axes = tuple(range(x.ndim - 1))
         # One-pass stats: E[x] and E[x^2] share a single read of the
         # activation (XLA fuses sibling reductions), where mean+var is two
@@ -140,6 +170,8 @@ def batchnorm(params, stats, x, *, train: bool, momentum=0.9, eps=1e-5):
         new_stats = stats
     inv = lax.rsqrt(var + eps) * params["scale"]
     y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype) + params["bias"].astype(x.dtype)
+    if relu:
+        y = jax.nn.relu(y)
     return y, new_stats
 
 

@@ -59,29 +59,23 @@ def _bottleneck_init(rng, cin: int, mid: int, *, downsample: bool):
     return p, s
 
 
-def _bottleneck_apply(cfg, p, s, x, *, stride: int, train: bool):
+def _bottleneck_apply(cfg, p, s, x, *, stride: int, train: bool, mesh=None):
     new_s = {}
     shortcut = x
-    y = layers.conv2d(p["conv1"], x, stride=1, dtype=cfg.dtype)
-    y, new_s["bn1"] = layers.batchnorm(
-        p["bn1"], s["bn1"], y, train=train, momentum=cfg.bn_momentum
+    bn = lambda name, t, relu=False: layers.batchnorm(
+        p[name], s[name], t, train=train, momentum=cfg.bn_momentum, mesh=mesh,
+        relu=relu,
     )
-    y = jax.nn.relu(y)
+    y = layers.conv2d(p["conv1"], x, stride=1, dtype=cfg.dtype)
+    y, new_s["bn1"] = bn("bn1", y, relu=True)
     # v1.5: the stride lives on the 3x3, not the 1x1.
     y = layers.conv2d(p["conv2"], y, stride=stride, dtype=cfg.dtype)
-    y, new_s["bn2"] = layers.batchnorm(
-        p["bn2"], s["bn2"], y, train=train, momentum=cfg.bn_momentum
-    )
-    y = jax.nn.relu(y)
+    y, new_s["bn2"] = bn("bn2", y, relu=True)
     y = layers.conv2d(p["conv3"], y, stride=1, dtype=cfg.dtype)
-    y, new_s["bn3"] = layers.batchnorm(
-        p["bn3"], s["bn3"], y, train=train, momentum=cfg.bn_momentum
-    )
+    y, new_s["bn3"] = bn("bn3", y)
     if "proj" in p:
         shortcut = layers.conv2d(p["proj"], x, stride=stride, dtype=cfg.dtype)
-        shortcut, new_s["bn_proj"] = layers.batchnorm(
-            p["bn_proj"], s["bn_proj"], shortcut, train=train, momentum=cfg.bn_momentum
-        )
+        shortcut, new_s["bn_proj"] = bn("bn_proj", shortcut)
     return jax.nn.relu(y + shortcut), new_s
 
 
@@ -144,14 +138,17 @@ def _stem_conv(cfg: Config, kernel, x):
     )
 
 
-def apply(cfg: Config, params, model_state, x, *, train: bool):
-    """x: [B, H, W, 3] -> (logits [B, num_classes], new_model_state)."""
+def apply(cfg: Config, params, model_state, x, *, train: bool, mesh=None):
+    """x: [B, H, W, 3] -> (logits [B, num_classes], new_model_state).
+
+    ``mesh`` opts the BatchNorms into the fused Pallas statistics path
+    (layers.batchnorm / ops/bn.py) with explicit SyncBN psums."""
     new_state: dict = {}
     y = _stem_conv(cfg, params["stem"]["kernel"], x)
     y, new_state["bn_stem"] = layers.batchnorm(
-        params["bn_stem"], model_state["bn_stem"], y, train=train, momentum=cfg.bn_momentum
+        params["bn_stem"], model_state["bn_stem"], y, train=train,
+        momentum=cfg.bn_momentum, mesh=mesh, relu=True,
     )
-    y = jax.nn.relu(y)
     # Explicit (1,1) pad + VALID, NOT "SAME": for even H (112), SAME pads
     # (lo=0, hi=1), which shifts every pooling window by one pixel.
     y = jax.lax.reduce_window(
@@ -167,18 +164,21 @@ def apply(cfg: Config, params, model_state, x, *, train: bool):
             key = f"stage{stage}/block{block}"
             stride = 2 if (stage > 0 and block == 0) else 1
             y, new_state[key] = _bottleneck_apply(
-                cfg, params[key], model_state[key], y, stride=stride, train=train
+                cfg, params[key], model_state[key], y, stride=stride,
+                train=train, mesh=mesh,
             )
     y = jnp.mean(y.astype(jnp.float32), axis=(1, 2))  # global average pool
     return layers.dense(params["head"], y, dtype=cfg.dtype), new_state
 
 
-def loss_fn(cfg: Config, *, l2: float = 1e-4):
+def loss_fn(cfg: Config, *, l2: float = 1e-4, mesh=None):
     """Softmax CE + L2 weight decay on conv/dense kernels (the tutorial-
-    standard ResNet objective)."""
+    standard ResNet objective).  ``mesh`` -> fused-Pallas BN (see apply)."""
 
     def f(params, model_state, batch, rng):
-        logits, new_state = apply(cfg, params, model_state, batch["image"], train=True)
+        logits, new_state = apply(
+            cfg, params, model_state, batch["image"], train=True, mesh=mesh
+        )
         ce = layers.softmax_cross_entropy(logits, batch["label"])
         reg = 0.0
         if l2:

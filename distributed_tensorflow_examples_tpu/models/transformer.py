@@ -68,6 +68,15 @@ class Config:
     #: that fits bigger batches / longer context in HBM.  (Pipeline mode
     #: always remats its stages — parallel/pipeline.py.)
     remat: bool = False
+    #: >1 chunks the LM head + cross-entropy over the sequence dim inside
+    #: ``loss_fn`` (lax.scan of jax.checkpoint'd chunks): the [B, T, V]
+    #: logits tensor — the single largest activation (batch 8 x 2048 x 32k
+    #: = 2 GB f32, with backward copies on top) — is never materialised;
+    #: each chunk's logits are recomputed in the backward pass.  Identical
+    #: math (same bf16 matmul -> f32 logsumexp, different summation
+    #: grouping); requires T % loss_chunks == 0, falls back to the dense
+    #: path under seq sharding (chunking T would fight the 'seq' axis).
+    loss_chunks: int = 0
 
     @property
     def dtype(self):
@@ -111,13 +120,15 @@ def _use_flash(cfg: Config, seq_len: int) -> bool:
     return False
 
 
-def _flash_sharded(mesh: Mesh, q, k, v, *, causal: bool):
+def _flash_sharded(mesh: Mesh, q, k, v, *, causal: bool, batch_axes=("data",)):
     """Flash attention under a mesh: a Mosaic custom call cannot be
-    partitioned by XLA SPMD, so shard_map it — batch over ``data``, heads
-    over ``model``, sequence local (the seq>1 case routes to the ring
+    partitioned by XLA SPMD, so shard_map it — batch over ``batch_axes``
+    (('data','expert') in MoE mode, matching Config.data_axes so the
+    constraint established upstream isn't resharded away), heads over
+    ``model``, sequence local (the seq>1 case routes to the ring
     instead)."""
     h_entry = "model" if mesh.shape.get("model", 1) > 1 else None
-    spec = P("data", h_entry, None, None)
+    spec = P(batch_axes, h_entry, None, None)
 
     from ..ops.flash_attention import flash_attention
     from ..parallel import collectives
@@ -194,11 +205,14 @@ def _attention(cfg: Config, mesh, q, k, v, *, allow_custom: bool):
         # cfg.attention values map 1:1 onto ring impls — an explicit "xla"
         # must NOT silently upgrade to the flash ring.
         return attn_ops.sequence_parallel_attention(
-            mesh, q, k, v, causal=cfg.causal, impl=cfg.attention
+            mesh, q, k, v, causal=cfg.causal, impl=cfg.attention,
+            batch_axis=cfg.data_axes,
         )
     if allow_custom and _use_flash(cfg, T):
         if mesh is not None:
-            return _flash_sharded(mesh, q, k, v, causal=cfg.causal)
+            return _flash_sharded(
+                mesh, q, k, v, causal=cfg.causal, batch_axes=cfg.data_axes
+            )
         from ..ops.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=cfg.causal)
@@ -266,6 +280,18 @@ def apply(cfg: Config, params, x, *, mesh: Mesh | None = None, return_aux=False)
     With ``cfg.pipeline_stages > 1``: the block stack runs under the GPipe
     schedule of ``parallel.pipeline`` over the mesh 'pipe' axis.
     """
+    h, aux_total = _trunk(cfg, params, x, mesh=mesh)
+    logits = layers.dense(params["head"], h, dtype=cfg.dtype)
+    if return_aux:
+        return logits, aux_total
+    return logits
+
+
+def _trunk(cfg: Config, params, x, *, mesh: Mesh | None):
+    """Everything up to and including ln_f: x [B, T] -> (h [B, T, D], aux).
+    Split from ``apply`` so ``loss_fn``'s chunked head+CE path (see
+    ``Config.loss_chunks``) can consume hidden states without the [B, T, V]
+    logits ever existing."""
     B, T = x.shape
 
     def constrain(y, spec):
@@ -336,10 +362,7 @@ def apply(cfg: Config, params, x, *, mesh: Mesh | None = None, return_aux=False)
             aux_total = aux_total + aux
 
     h = _layernorm(params["ln_f"], h)
-    logits = layers.dense(params["head"], h, dtype=cfg.dtype)
-    if return_aux:
-        return logits, aux_total
-    return logits
+    return h, aux_total
 
 
 # ----------------------------------------------------------------------------
@@ -445,12 +468,47 @@ def generate(
     return out
 
 
+def _chunked_ce(cfg: Config, head_p, h, y):
+    """Mean CE from hidden states WITHOUT materialising [B, T, V] logits:
+    lax.scan over ``cfg.loss_chunks`` sequence chunks, each chunk's
+    (bf16 head matmul -> f32 logsumexp - gold) under jax.checkpoint so the
+    backward recomputes chunk logits instead of storing them.  Same math as
+    dense softmax_cross_entropy (the global mean is just regrouped); peak
+    logits memory drops by the chunk count."""
+    B, T, D = h.shape
+    c = cfg.loss_chunks
+    hc = jnp.moveaxis(h.reshape(B, c, T // c, D), 1, 0)  # [c, B, Tc, D]
+    yc = jnp.moveaxis(y.reshape(B, c, T // c), 1, 0)  # [c, B, Tc]
+
+    def one(tot, hy):
+        hcb, ycb = hy
+        logits = layers.dense(head_p, hcb, dtype=cfg.dtype).astype(jnp.float32)
+        lz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(
+            logits, ycb[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+        return tot + jnp.sum(lz - gold), None
+
+    tot, _ = jax.lax.scan(jax.checkpoint(one), jnp.float32(0.0), (hc, yc))
+    return tot / (B * T)
+
+
 def loss_fn(cfg: Config, *, mesh: Mesh | None = None):
     def f(params, model_state, batch, rng):
-        logits, aux = apply(cfg, params, batch["x"], mesh=mesh, return_aux=True)
-        ce = layers.softmax_cross_entropy(
-            logits.reshape(-1, cfg.vocab_size), batch["y"].reshape(-1)
+        T = batch["x"].shape[1]
+        chunked = (
+            cfg.loss_chunks > 1
+            and T % cfg.loss_chunks == 0
+            and (mesh is None or mesh.shape.get("seq", 1) == 1)
         )
+        if chunked:
+            h, aux = _trunk(cfg, params, batch["x"], mesh=mesh)
+            ce = _chunked_ce(cfg, params["head"], h, batch["y"])
+        else:
+            logits, aux = apply(cfg, params, batch["x"], mesh=mesh, return_aux=True)
+            ce = layers.softmax_cross_entropy(
+                logits.reshape(-1, cfg.vocab_size), batch["y"].reshape(-1)
+            )
         metrics = {"loss": ce, "perplexity": jnp.exp(ce)}
         loss = ce
         if cfg.moe_experts > 0:

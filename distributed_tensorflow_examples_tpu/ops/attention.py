@@ -298,7 +298,7 @@ def sequence_parallel_attention(
     *,
     causal: bool = False,
     seq_axis: str = "seq",
-    batch_axis: str = "data",
+    batch_axis="data",
     head_axis: str = "model",
     impl: str = "auto",
 ):
@@ -311,6 +311,9 @@ def sequence_parallel_attention(
     ``impl``: per-hop block compute — "xla" (the reference ring), "flash"
     (Pallas kernels fwd+bwd), or "auto" (flash on TPU, xla elsewhere —
     interpret-mode Pallas inside a scan is prohibitively slow on CPU).
+
+    ``batch_axis`` may be a tuple of axes (('data','expert') for MoE
+    models whose batches shard over both — models/transformer.data_axes).
     """
     if impl not in ("auto", "xla", "flash"):
         raise ValueError(f"impl must be auto|xla|flash, got {impl!r}")

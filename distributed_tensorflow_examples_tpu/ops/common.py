@@ -1,0 +1,13 @@
+"""Small shared helpers for the custom-op modules."""
+
+from __future__ import annotations
+
+
+def largest_divisor(n: int, want: int) -> int:
+    """Largest divisor of ``n`` that is <= ``want`` (>= 1).  The common core
+    of every block/tile/group-size pick in ops/ — kernels layer their own
+    policy (MXU-alignment warnings, shard-multiple constraints) on top."""
+    b = max(1, min(n, want))
+    while n % b:
+        b -= 1
+    return b

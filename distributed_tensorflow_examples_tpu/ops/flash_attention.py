@@ -39,8 +39,19 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+#: log2(e): the kernels run the online softmax in BASE 2 — ``exp2`` is the
+#: hardware primitive (``exp`` lowers to exp2 plus a multiply per element,
+#: and the [bq, bk] score tile is exactly where per-element VPU work
+#: competes with the MXU at head_dim 64).  The 1/sqrt(d) scale is folded
+#: into the same constant and applied ONCE to q (O(T*d)) instead of to
+#: every score tile (O(T^2)).
+LOG2E = 1.4426950408889634
+LN2 = 0.6931471805599453
+
 #: q/k.T with K-dim contraction (dim 1 of both operands).
 _TRANS_B = (((1,), (1,)), ((), ()))
+#: Contract dim 0 of both operands: a.T @ b without materialising a.T.
+_TRANS_A = (((0,), (0,)), ((), ()))
 
 
 def _dot_nt(a, b):
@@ -55,6 +66,13 @@ def _dot(a, b):
     """a @ b, f32 accumulation; ``a`` is cast to ``b``'s dtype first (the
     softmax weights are f32 — feed the MXU its native input width)."""
     return jax.lax.dot(a.astype(b.dtype), b, preferred_element_type=jnp.float32)
+
+
+def _dot_tn(a, b):
+    """a.T @ b via dot_general (no explicit transpose of the score tile)."""
+    return jax.lax.dot_general(
+        a.astype(b.dtype), b, _TRANS_A, preferred_element_type=jnp.float32
+    )
 
 
 def _interpret() -> bool:
@@ -78,12 +96,37 @@ def _visible(qi, kj, bq, bk):
     return kj * bk <= (qi + 1) * bq - 1
 
 
+def _fully_visible(qi, kj, bq, bk):
+    """True iff no element of the (qi, kj) block is masked (block entirely
+    on/below the diagonal) — such blocks skip the iota/where mask and the
+    masked-row guard entirely.  With bq == bk tiles only the diagonal
+    blocks take the masked branch."""
+    return kj * bk + bk - 1 <= qi * bq
+
+
+def _causal_dispatch(step, causal, qi, kj, bq, bk):
+    """Shared three-way block dispatch for every kernel: mask-free compute
+    on fully-visible blocks, masked compute on diagonal-straddling blocks,
+    nothing above the diagonal.  ``step(masked)`` returns the traced block
+    body (the per-kernel compute closure)."""
+    if causal:
+        full = _fully_visible(qi, kj, bq, bk)
+        pl.when(full)(step(masked=False))
+        pl.when(
+            jnp.logical_and(_visible(qi, kj, bq, bk), jnp.logical_not(full))
+        )(step(masked=True))
+    else:
+        step(masked=False)()
+
+
 # ----------------------------------------------------------------------------
 # Forward
 # ----------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *, scale, causal, bq, bk):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *, causal, bq, bk):
+    """q arrives PRE-SCALED by scale*log2(e); softmax state is base-2 (m/l
+    in exp2 units), converted to the natural-log lse contract at the end."""
     qi, kj = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -93,33 +136,40 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *, scal
         l_sc[:] = jnp.zeros_like(l_sc)
         acc_sc[:] = jnp.zeros_like(acc_sc)
 
-    @pl.when(jnp.logical_or(not causal, _visible(qi, kj, bq, bk)))
-    def _compute():
-        q, k, v = q_ref[0], k_ref[0], v_ref[0]  # native dtype into the MXU
-        s = _dot_nt(q, k) * scale  # [bq, bk] f32
-        if causal:
-            s = _mask(s, qi, kj, bq, bk)
-        m_prev, l_prev = m_sc[:], l_sc[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new) * (s > NEG_INF / 2)
-        alpha = jnp.exp(m_prev - m_new)
-        acc_sc[:] = acc_sc[:] * alpha + _dot(p, v)
-        l_sc[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        m_sc[:] = m_new
+    def _step(masked: bool):
+        def _compute():
+            q, k, v = q_ref[0], k_ref[0], v_ref[0]  # native dtype into the MXU
+            s = _dot_nt(q, k)  # [bq, bk] f32, base-2 logits
+            if masked:
+                s = _mask(s, qi, kj, bq, bk)
+            m_prev, l_prev = m_sc[:], l_sc[:]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp2(s - m_new)
+            if masked:
+                p = p * (s > NEG_INF / 2)  # fully-masked rows contribute 0
+            alpha = jnp.exp2(m_prev - m_new)
+            acc_sc[:] = acc_sc[:] * alpha + _dot(p, v)
+            l_sc[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            m_sc[:] = m_new
+
+        return _compute
+
+    _causal_dispatch(_step, causal, qi, kj, bq, bk)
 
     @pl.when(kj == nk - 1)
     def _finish():
         l_safe = jnp.maximum(l_sc[:], 1e-30)
         o_ref[0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = m_sc[:] + jnp.log(l_safe)
+        lse_ref[0] = m_sc[:] * LN2 + jnp.log(l_safe)
 
 
 def _fwd(q, k, v, *, causal, block_q, block_k, out_dtype=None):
     bh, t, d = q.shape
     scale = 1.0 / math.sqrt(d)
     bq, bk = min(block_q, t), min(block_k, t)
+    q = q * jnp.asarray(scale * LOG2E, q.dtype)  # fold scale+base-2 into q
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
+        functools.partial(_fwd_kernel, causal=causal, bq=bq, bk=bk),
         grid=(bh, t // bq, t // bk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -151,6 +201,8 @@ def _fwd(q, k, v, *, causal, block_q, block_k, out_dtype=None):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc, *, scale, causal, bq, bk):
+    """q arrives PRE-SCALED by scale*log2(e) (the forward's fold); the saved
+    natural-log lse is converted to base 2 once per [bq, 1] block."""
     qi, kj = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -158,17 +210,23 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc, *
     def _init():
         dq_sc[:] = jnp.zeros_like(dq_sc)
 
-    @pl.when(jnp.logical_or(not causal, _visible(qi, kj, bq, bk)))
-    def _compute():
-        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        lse = lse_ref[0]  # [bq, 1]
-        delta = delta_ref[0]
-        s = _dot_nt(q, k) * scale
-        if causal:
-            s = _mask(s, qi, kj, bq, bk)
-        p = jnp.exp(s - lse) * (s > NEG_INF / 2)
-        ds = p * (_dot_nt(do, v) - delta)
-        dq_sc[:] = dq_sc[:] + _dot(ds, k)
+    def _step(masked: bool):
+        def _compute():
+            q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+            lse2 = lse_ref[0] * LOG2E  # [bq, 1] natural -> base-2
+            delta = delta_ref[0]
+            s = _dot_nt(q, k)  # base-2 logits
+            if masked:
+                s = _mask(s, qi, kj, bq, bk)
+            p = jnp.exp2(s - lse2)
+            if masked:
+                p = p * (s > NEG_INF / 2)
+            ds = p * (_dot_nt(do, v) - delta)
+            dq_sc[:] = dq_sc[:] + _dot(ds, k)
+
+        return _compute
+
+    _causal_dispatch(_step, causal, qi, kj, bq, bk)
 
     @pl.when(kj == nk - 1)
     def _finish():
@@ -176,6 +234,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc, *
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_sc, dv_sc, *, scale, causal, bq, bk):
+    """q PRE-SCALED as in _dq_kernel; dk's pending 1/sqrt(d)*base-2 factors
+    are unwound once at the final write, not per block."""
     kj, qi = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
 
@@ -184,22 +244,30 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dk_sc[:] = jnp.zeros_like(dk_sc)
         dv_sc[:] = jnp.zeros_like(dv_sc)
 
-    @pl.when(jnp.logical_or(not causal, _visible(qi, kj, bq, bk)))
-    def _compute():
-        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        lse = lse_ref[0]
-        delta = delta_ref[0]
-        s = _dot_nt(q, k) * scale
-        if causal:
-            s = _mask(s, qi, kj, bq, bk)
-        p = jnp.exp(s - lse) * (s > NEG_INF / 2)
-        dv_sc[:] = dv_sc[:] + _dot(p.T, do)
-        ds = p * (_dot_nt(do, v) - delta)
-        dk_sc[:] = dk_sc[:] + _dot(ds.T, q) * scale
+    def _step(masked: bool):
+        def _compute():
+            q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+            lse2 = lse_ref[0] * LOG2E
+            delta = delta_ref[0]
+            s = _dot_nt(q, k)
+            if masked:
+                s = _mask(s, qi, kj, bq, bk)
+            p = jnp.exp2(s - lse2)
+            if masked:
+                p = p * (s > NEG_INF / 2)
+            dv_sc[:] = dv_sc[:] + _dot_tn(p, do)
+            ds = p * (_dot_nt(do, v) - delta)
+            # ds.T @ q with q still carrying the scale*log2(e) fold: the
+            # extra LOG2E is divided back out in _finish.
+            dk_sc[:] = dk_sc[:] + _dot_tn(ds, q)
+
+        return _compute
+
+    _causal_dispatch(_step, causal, qi, kj, bq, bk)
 
     @pl.when(qi == nq - 1)
     def _finish():
-        dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+        dk_ref[0] = (dk_sc[:] * (1.0 / LOG2E)).astype(dk_ref.dtype)
         dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
 
 
@@ -229,6 +297,7 @@ def dq_call(q, k, v, do, lse, delta, *, causal, block_q, block_k, out_dtype=None
     tk = k.shape[1]
     scale = 1.0 / math.sqrt(d)
     bq, bk = min(block_q, tq), min(block_k, tk)
+    q = q * jnp.asarray(scale * LOG2E, q.dtype)  # base-2 fold (see _fwd)
 
     return pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
@@ -256,6 +325,7 @@ def dkv_call(q, k, v, do, lse, delta, *, causal, block_q, block_k, out_dtype=Non
     tk = k.shape[1]
     scale = 1.0 / math.sqrt(d)
     bq, bk = min(block_q, tq), min(block_k, tk)
+    q = q * jnp.asarray(scale * LOG2E, q.dtype)  # base-2 fold (see _fwd)
 
     return pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
@@ -317,9 +387,9 @@ def _pick_block(t: int, want: int) -> int:
     128 with the default 512), degrading to smaller tiles rather than raising
     at trace time.  Degenerate divisors (prime-ish T -> tiny tiles) get a
     warning: pad T to a multiple of 128 for MXU-shaped blocks."""
-    b = min(want, t)
-    while t % b:
-        b -= 1
+    from .common import largest_divisor
+
+    b = largest_divisor(t, want)
     if b < 128 <= t:
         import warnings
 

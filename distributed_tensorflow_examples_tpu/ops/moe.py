@@ -79,15 +79,14 @@ def _group(n: int, want: int, shards: int = 1) -> int:
     then stays shard-local and only the dispatched [E, G, C, D] buffers
     cross the mesh (as all_to_all).  Falls back to plain divisor-of-N when
     no such g exists (e.g. tiny unit-test shapes)."""
+    from .common import largest_divisor
+
     g = min(want, n)
     while g > 1 and not (n % g == 0 and (n // g) % shards == 0):
         g -= 1
     if g > 1 or n % shards == 0:
         return g
-    g = min(want, n)
-    while n % g:
-        g -= 1
-    return g
+    return largest_divisor(n, want)
 
 
 def apply(p, x, moe: MoEConfig, *, dtype=None, mesh=None):

@@ -130,7 +130,7 @@ def main(argv):
         rules=models.transformer.sharding_rules(cfg),
         flags=FLAGS,
         loss_fn_factory=lambda mesh: models.transformer.loss_fn(cfg, mesh=mesh),
-        batch_spec=models.transformer.batch_spec(),
+        batch_spec=models.transformer.batch_spec(cfg),
     )
 
     # Per-host data shard: each host owns a disjoint block of the token

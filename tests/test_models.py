@@ -285,3 +285,72 @@ def test_batchnorm_one_pass_stats_match_two_pass():
     np.testing.assert_allclose(
         np.asarray(new_s["var"]), np.asarray(jnp.var(x, axis=(0, 1, 2))), rtol=1e-4, atol=1e-4
     )
+
+
+import pytest
+
+
+@pytest.mark.parametrize("impl", ["pallas", "matmul"])
+def test_fused_bn_parity_with_xla_path(mesh8, impl):
+    """ops/bn.py (BOTH stats implementations: Pallas kernels + custom VJP
+    with SyncBN psum via shard_map, and the MXU-matmul forms) must match
+    the XLA batchnorm path — y, running stats, and gradients — on a
+    sharded multi-device mesh.  FORCE_PALLAS runs the same code
+    interpreted on CPU."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_examples_tpu.models import layers
+    from distributed_tensorflow_examples_tpu.ops import bn as bn_ops
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(16, 4, 4, 24)).astype(np.float32))
+    params = {"scale": jnp.linspace(0.5, 1.5, 24), "bias": jnp.linspace(-1, 1, 24)}
+    stats = {"mean": jnp.zeros((24,)), "var": jnp.ones((24,))}
+    xs = jax.device_put(x, NamedSharding(mesh8, P("data")))
+
+    def run(use_mesh, relu=False):
+        def f(params, x):
+            y, new_stats = layers.batchnorm(
+                params, stats, x, train=True,
+                mesh=mesh8 if use_mesh else None, relu=relu,
+            )
+            return jnp.sum(y * y), (y, new_stats)
+
+        (loss, (y, ns)), grads = jax.jit(
+            jax.value_and_grad(f, has_aux=True)
+        )(params, xs)
+        return loss, y, ns, grads
+
+    bn_ops.FORCE_PALLAS = True
+    old_impl = bn_ops.IMPL
+    bn_ops.IMPL = impl
+    try:
+        l_fast, y_fast, ns_fast, g_fast = run(True)
+        l_fr, y_fr, ns_fr, g_fr = run(True, relu=True)
+    finally:
+        bn_ops.FORCE_PALLAS = False
+        bn_ops.IMPL = old_impl
+    l_ref, y_ref, ns_ref, g_ref = run(False)
+    l_rr, y_rr, ns_rr, g_rr = run(False, relu=True)
+
+    # relu-fused path (in-kernel mask recompute) vs XLA relu(batchnorm(x)).
+    np.testing.assert_allclose(float(l_fr), float(l_rr), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(y_fr), np.asarray(y_rr), atol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4),
+        g_fr, g_rr,
+    )
+
+    np.testing.assert_allclose(float(l_fast), float(l_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(y_fast), np.asarray(y_ref), atol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+        ns_fast, ns_ref,
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4),
+        g_fast, g_ref,
+    )

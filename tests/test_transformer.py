@@ -160,3 +160,36 @@ def test_remat_matches_no_remat():
     np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
     for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_chunked_loss_matches_dense():
+    """Config.loss_chunks must not change the loss value or the gradients —
+    it only regroups the head matmul + CE into scanned chunks (f32 sums are
+    reassociated, so allow float tolerance)."""
+    import dataclasses
+
+    transformer = models.transformer
+    cfg_d = transformer.Config(
+        vocab_size=211, dim=32, n_layers=2, n_heads=4, max_seq_len=32,
+        compute_dtype="float32",
+    )
+    cfg_c = dataclasses.replace(cfg_d, loss_chunks=4)
+    params = transformer.init(cfg_d, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg_d.vocab_size, size=(4, 33)).astype(np.int32)
+    batch = {"x": toks[:, :-1], "y": toks[:, 1:]}
+
+    def loss_of(cfg):
+        f = transformer.loss_fn(cfg)
+        def scalar(p):
+            l, _ = f(p, {}, batch, jax.random.key(1))
+            return l
+        return scalar
+
+    ld, gd = jax.value_and_grad(loss_of(cfg_d))(params)
+    lc, gc = jax.value_and_grad(loss_of(cfg_c))(params)
+    np.testing.assert_allclose(float(ld), float(lc), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6),
+        gd, gc,
+    )

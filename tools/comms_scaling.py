@@ -198,7 +198,7 @@ def worker(n: int) -> dict:
             lambda r: model_mod.init(cfg, r, **ikw), w["opt"], jax.random.key(0),
             mesh=mesh, rules=rules,
         )
-        spec = model_mod.batch_spec() if w.get("batch_spec") else None
+        spec = model_mod.batch_spec(cfg) if w.get("batch_spec") else None
         loss = (
             model_mod.loss_fn(cfg, mesh=mesh)
             if w.get("batch_spec")
