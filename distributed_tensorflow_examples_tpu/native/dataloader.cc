@@ -34,6 +34,14 @@
 
 namespace {
 
+// Hard caps on untrusted header values (ADVICE.md r2): a corrupt or hostile
+// shard must fail cleanly in read_header, not drive record_bytes/n_records
+// arithmetic into overflow or a near-SIZE_MAX resize that std::bad_alloc-
+// terminates the noexcept worker thread.
+constexpr uint64_t kMaxRecordBytes = 1ull << 30;  // 1 GiB per record
+constexpr uint64_t kMaxRecords = 1ull << 40;      // per shard
+constexpr uint64_t kMaxShardBytes = 1ull << 40;   // 1 TiB mapped per shard
+
 struct Field {
   std::string name;
   uint8_t dtype = 0;  // 0=u8, 1=i32, 2=f32
@@ -86,14 +94,34 @@ bool read_header(FILE* f, Header* h) {
     for (uint8_t d = 0; d < ndim; ++d) {
       uint32_t dim = 0;
       if (fread(&dim, 4, 1, f) != 1) return false;
+      // Overflow-checked product; cap keeps record_bytes arithmetic sane.
+      if (dim != 0 && fd.record_elems > kMaxRecordBytes / dim) return false;
       fd.dims.push_back(dim);
       fd.record_elems *= dim;
     }
+    if (fd.record_bytes() > kMaxRecordBytes ||
+        h->record_bytes > kMaxRecordBytes - fd.record_bytes())
+      return false;
     h->record_bytes += fd.record_bytes();
     h->fields.push_back(std::move(fd));
   }
   if (fread(&h->n_records, 8, 1, f) != 1) return false;
+  if (h->n_records > kMaxRecords ||
+      (h->record_bytes != 0 &&
+       h->n_records > kMaxShardBytes / h->record_bytes))
+    return false;
   h->data_offset = static_cast<size_t>(ftell(f));
+  // The caps alone still admit process-killing allocations (a header may
+  // CLAIM up to kMaxShardBytes): the claimed payload must actually exist
+  // in the file before anyone sizes a buffer from it.
+  if (fseek(f, 0, SEEK_END) != 0) return false;
+  long end = ftell(f);
+  if (end < 0) return false;
+  uint64_t avail = (uint64_t)end - (uint64_t)h->data_offset;
+  if ((uint64_t)end < (uint64_t)h->data_offset ||
+      h->n_records * (uint64_t)h->record_bytes > avail)
+    return false;
+  if (fseek(f, (long)h->data_offset, SEEK_SET) != 0) return false;
   return true;
 }
 

@@ -152,9 +152,13 @@ def main(argv):
         # Inference surface: KV-cache greedy decode from a corpus prompt.
         import numpy as np
 
-        prompt = np.asarray(ids[:prompt_len], dtype=np.int32)[None]
+        # Batch dim must cover the 'data' axis; decode runs TP-sharded on
+        # the same mesh the model trained on (KV cache heads on 'model').
+        dp = exp.mesh.shape.get("data", 1)
+        prompt = np.tile(np.asarray(ids[:prompt_len], dtype=np.int32)[None], (dp, 1))
         out = models.transformer.generate(
-            cfg, exp.state.params, prompt, max_new_tokens=FLAGS.sample_tokens
+            cfg, exp.state.params, prompt, max_new_tokens=FLAGS.sample_tokens,
+            mesh=exp.mesh,
         )
         logging.info(
             "sampled token ids: %s", np.asarray(out)[0, prompt_len:].tolist()

@@ -1,0 +1,172 @@
+"""Real-format dataset-loading tests (r2 verdict "real-data gate").
+
+Two tiers:
+1. ALWAYS-RUN parser tests against the committed real-format miniatures in
+   tests/fixtures/realdata (regenerate: tests/fixtures/
+   make_realdata_fixtures.py) — the keras npz layouts, the CIFAR-10 python
+   pickle batch dir, PTB text, text8 — plus one example-CLI subprocess run
+   that trains FROM the fixture files (the --data_dir file path end-to-end).
+2. ENV-GATED full-dataset tests: set ``REAL_DATA_DIR`` to a directory
+   holding the real downloads (mnist.npz, cifar-10-batches-py/,
+   ptb.train.txt, text8) on a data-equipped host and the same loaders/CLIs
+   run with accuracy assertions; skipped cleanly here (zero egress).
+   The accuracy-parity protocol for such a host is documented in PARITY.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_examples_tpu.data import datasets
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "realdata")
+REAL = os.environ.get("REAL_DATA_DIR")
+
+
+def test_mnist_npz_parser():
+    ds = datasets.mnist(FIXTURES)
+    assert ds.source == f"file:{os.path.join(FIXTURES, 'mnist.npz')}"
+    assert ds.train["image"].shape == (64, 28, 28, 1)
+    assert ds.train["image"].dtype == np.float32
+    assert float(ds.train["image"].max()) <= 1.0
+    assert ds.test["label"].shape == (16,) and ds.test["label"].dtype == np.int32
+
+
+def test_cifar10_npz_parser():
+    ds = datasets.cifar10(FIXTURES)
+    assert ds.source.startswith("file:") and ds.source.endswith("cifar10.npz")
+    assert ds.train["image"].shape == (64, 32, 32, 3)
+    assert ds.train["label"].shape == (64,)  # [N,1] keras labels flattened
+
+
+def test_cifar10_pickle_batches_parser(tmp_path):
+    # Only the pickle dir present: loader must take the batches path.
+    link = tmp_path / "data"
+    link.mkdir()
+    os.symlink(
+        os.path.join(FIXTURES, "cifar-10-batches-py"),
+        link / "cifar-10-batches-py",
+    )
+    ds = datasets.cifar10(str(link))
+    assert ds.source.endswith("cifar-10-batches-py")
+    assert ds.train["image"].shape == (40, 32, 32, 3)  # 5 batches x 8
+    assert ds.test["image"].shape == (8, 32, 32, 3)
+    # CHW plane order must have been transposed to NHWC: spot-check one
+    # pixel against a direct re-read of the pickle.
+    import pickle
+
+    with open(
+        os.path.join(FIXTURES, "cifar-10-batches-py", "data_batch_1"), "rb"
+    ) as f:
+        raw = pickle.load(f, encoding="bytes")
+    want = raw[b"data"][0].reshape(3, 32, 32).transpose(1, 2, 0) / 255.0
+    np.testing.assert_allclose(ds.train["image"][0], want.astype(np.float32))
+
+
+def test_ptb_text_parser():
+    ids, vids, vocab, source = datasets.ptb(FIXTURES, vocab_size=40)
+    assert source.endswith("ptb.train.txt")
+    assert ids.dtype == np.int32 and len(ids) > 400
+    assert len(vids) > 80
+    assert "<eos>" in vocab  # newline mapping
+    assert max(vocab.values()) < 40
+
+
+def test_text8_parser():
+    ids, vocab, source = datasets.text_corpus(FIXTURES, vocab_size=40)
+    assert source.endswith("text8")
+    assert ids.dtype == np.int32 and len(ids) == 2000
+    assert vocab["<unk>"] == 0
+
+
+def _run_cli(example, *args, timeout=900):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, os.path.join(root, "examples", example), *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=root,
+    )
+    assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+    return p.stdout + p.stderr
+
+
+def test_mnist_cli_trains_from_real_format_files(tmp_path):
+    """The one path a data-equipped machine would exercise — CLI reads
+    mnist.npz via --data_dir — runs end-to-end on the fixture file."""
+    out = _run_cli(
+        "mnist_mlp.py",
+        f"--data_dir={FIXTURES}",
+        "--batch_size=16",
+        "--train_steps=10",
+        f"--log_dir={tmp_path}",
+    )
+    assert "mnist.npz" in out  # source reported, not synthetic
+    assert "FINAL step=10" in out
+
+
+# ----------------------------------------------------------------------------
+# Env-gated full-dataset runs (data-equipped hosts; see PARITY.md protocol)
+# ----------------------------------------------------------------------------
+
+needs_real = pytest.mark.skipif(
+    not REAL, reason="REAL_DATA_DIR not set (no real datasets on this host)"
+)
+
+
+@needs_real
+def test_real_mnist_accuracy(tmp_path):
+    out = _run_cli(
+        "mnist_mlp.py",
+        f"--data_dir={REAL}",
+        "--batch_size=256",
+        "--train_steps=1500",
+        f"--log_dir={tmp_path}",
+        timeout=3600,
+    )
+    final = [l for l in out.splitlines() if l.startswith("FINAL")][-1]
+    acc = float(dict(kv.split("=") for kv in final.split()[1:])["test_accuracy"])
+    assert acc >= 0.97, final  # the MLP reference target (PARITY.md)
+
+
+@needs_real
+def test_real_cifar10_accuracy(tmp_path):
+    out = _run_cli(
+        "cifar10_cnn.py",
+        f"--data_dir={REAL}",
+        "--batch_size=256",
+        "--train_steps=3000",
+        f"--log_dir={tmp_path}",
+        timeout=7200,
+    )
+    final = [l for l in out.splitlines() if l.startswith("FINAL")][-1]
+    acc = float(dict(kv.split("=") for kv in final.split()[1:])["test_accuracy"])
+    assert acc >= 0.60, final  # tutorial-CNN scale target (PARITY.md)
+
+
+@needs_real
+def test_real_ptb_perplexity(tmp_path):
+    out = _run_cli(
+        "ptb_lstm.py",
+        f"--data_dir={REAL}",
+        "--batch_size=20",
+        "--train_steps=2000",
+        f"--log_dir={tmp_path}",
+        timeout=7200,
+    )
+    final = [l for l in out.splitlines() if l.startswith("FINAL")][-1]
+    ppl = float(dict(kv.split("=") for kv in final.split()[1:])["valid_perplexity"])
+    assert ppl <= 300, final  # early-training sanity bound (PARITY.md)

@@ -183,20 +183,34 @@ def test_ptb_lstm(tmp_path):
 
 
 def test_resnet50_tiny(tmp_path):
-    """W3 at toy resolution: the full ResNet-50 v1.5 graph end-to-end."""
+    """W3 at toy resolution: the full ResNet-50 v1.5 graph end-to-end —
+    WITH a learning signal (r2 verdict: step-count-only was the weakest
+    e2e in the suite): 30 steps on learnable synthetic blobs must drive
+    the logged loss down, not just execute."""
     out = _run(
         "resnet50.py",
         "--image_size=32",
         "--num_classes=10",
         "--batch_size=16",
-        "--train_steps=4",
+        "--train_steps=60",
+        "--log_every_steps=5",
         "--synthetic_examples=64",
         "--grad_accum=2",  # accumulation path through the CLI
         f"--log_dir={tmp_path}",
     )
     f = _final(out)
-    assert f["step"] == 4
+    assert f["step"] == 60
     assert "test_accuracy" in f
+    # Learning signal on the CE term ("loss" includes the L2 penalty, ~20
+    # at init for 25M params — it swamps the ~2.3 CE scale); batch 16 on a
+    # 50-layer BN net is noisy, so compare min-of-late to the early value
+    # and require train accuracy to clear chance (0.1) decisively.
+    ms = [m for m in _metrics_jsonl(str(tmp_path)) if "ce" in m]
+    assert len(ms) >= 6, ms
+    early = ms[0]["ce"]
+    late = min(m["ce"] for m in ms[len(ms) // 2 :])
+    assert late < 0.75 * early, f"ce did not fall: {early} -> {late}"
+    assert max(m.get("accuracy", 0.0) for m in ms) >= 0.25
 
 
 def test_transformer_unroll(tmp_path):
@@ -304,3 +318,26 @@ def test_legacy_ps_process_exits_zero():
     )
     assert "exiting 0" in out
     assert "FINAL" not in out  # a PS process trains nothing
+
+
+def test_transformer_tp_sharded_sampling(tmp_path):
+    """--sample_tokens on a data=4,model=2 mesh (8 fake devices): the
+    KV-cache decode path
+    runs TP-SHARDED end-to-end from the CLI (r2 verdict missing #6 — a
+    model that needs TP to fit must decode, not just train)."""
+    out = _run(
+        "transformer_lm.py",
+        "--mesh=data=4,model=2",
+        "--train_steps=8",
+        "--batch_size=8",
+        "--dim=64",
+        "--n_layers=2",
+        "--n_heads=4",
+        "--seq_len=64",
+        "--vocab_size=256",
+        "--sample_tokens=8",
+        f"--log_dir={tmp_path}",
+    )
+    f = _final(out)
+    assert f["step"] == 8
+    assert "sampled token ids:" in out

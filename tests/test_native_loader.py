@@ -144,3 +144,48 @@ def test_short_tail_shard_is_skipped_not_fatal(tmp_path):
     seen = [b["label"].shape[0] for b in pipe]
     assert seen == [100, 100]  # one batch per full shard, tail skipped
     pipe.close()
+
+
+def test_hostile_header_rejected_cleanly(tmp_path):
+    """ADVICE.md r2: a corrupt/hostile shard header must fail cleanly at
+    construction.  The Python peek is the user-facing validator (caps +
+    claimed-payload-vs-file-size); the C++ read_header repeats the same
+    checks as the backstop for direct C-ABI users — never sizing a buffer
+    from a lying header."""
+    import struct
+
+    # Valid magic, one u8 field named "x" whose dims multiply to ~2^62, and
+    # a huge n_records: every cap in read_header is exercised.
+    hdr = nl.MAGIC + struct.pack("<I", 1)
+    hdr += struct.pack("<B", 1) + b"x"          # name_len, name
+    hdr += struct.pack("<B", 0)                  # dtype u8
+    hdr += struct.pack("<B", 2)                  # ndim
+    hdr += struct.pack("<II", 1 << 31, 1 << 31)  # dims: product 2^62
+    hdr += struct.pack("<Q", 1 << 50)            # n_records
+    p = tmp_path / "evil.dtx"
+    p.write_bytes(hdr)
+    with pytest.raises(ValueError):
+        nl.NativeFileStream([str(p)], batch_size=1, seed=0)
+
+    # And the C ABI directly (the path ADVICE flagged): dtx_dl_new must
+    # return NULL, not crash.
+    import ctypes
+
+    lib = nl._load()
+    arr = (ctypes.c_char_p * 1)(str(p).encode())
+    h = lib.dtx_dl_new(arr, 1, 1, 1, 2, 0, 1, 1)
+    assert not h
+
+    # BELOW-cap lying header: claims pass every cap but the payload isn't
+    # in the file — must still be rejected (python AND C ABI) before any
+    # allocation is sized from the claim.
+    hdr2 = nl.MAGIC + struct.pack("<I", 1)
+    hdr2 += struct.pack("<B", 1) + b"x" + struct.pack("<B", 0)
+    hdr2 += struct.pack("<B", 1) + struct.pack("<I", 4096)
+    hdr2 += struct.pack("<Q", 1 << 20)  # claims 4 GiB; file has none
+    p2 = tmp_path / "liar.dtx"
+    p2.write_bytes(hdr2)
+    with pytest.raises(ValueError, match="payload"):
+        nl.peek_shard(str(p2))
+    arr2 = (ctypes.c_char_p * 1)(str(p2).encode())
+    assert not lib.dtx_dl_new(arr2, 1, 1, 1, 2, 0, 1, 1)
