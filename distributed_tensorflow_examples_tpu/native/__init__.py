@@ -28,7 +28,7 @@ def _load() -> ctypes.CDLL:
     with _build_lock:
         if _lib is not None:
             return _lib
-        sources = ("accumulator.cc", "dataloader.cc")
+        sources = ("accumulator.cc", "dataloader.cc", "ps_server.cc")
         if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < max(
             os.path.getmtime(os.path.join(_DIR, s)) for s in sources
         ):
@@ -153,13 +153,16 @@ class GradientQueue:
             raise MemoryError(f"gq_new({num_elems}, {capacity}) failed")
         self.num_elems = int(num_elems)
 
-    def push(self, local_step: int, grad: np.ndarray) -> bool:
-        """Blocks while the queue is full (backpressure); returns False when
-        the grad was dropped as stale or the queue was cancelled."""
+    def push(self, local_step: int, grad: np.ndarray) -> bool | None:
+        """Blocks while the queue is full (backpressure).  Tri-state result:
+        True = enqueued, False = dropped as stale, None = CANCELLED — the
+        termination signal (collapsing it into False made workers busy-spin
+        after a chief-side cancel)."""
         g = np.ascontiguousarray(grad, dtype=np.float32).reshape(-1)
         if g.size != self.num_elems:
             raise ValueError(f"grad size {g.size} != {self.num_elems}")
-        return self._lib.gq_push(self._h, int(local_step), _as_float_ptr(g)) == 1
+        r = self._lib.gq_push(self._h, int(local_step), _as_float_ptr(g))
+        return None if r < 0 else r == 1
 
     def pop(self) -> tuple[int, np.ndarray] | None:
         """Blocking; returns (local_step, grad) or None when cancelled+drained."""

@@ -239,6 +239,10 @@ int64_t gq_dropped(void* h) {
   return q->dropped;
 }
 
+int64_t gq_num_elems(void* h) {
+  return static_cast<int64_t>(static_cast<GradQueue*>(h)->n_elems);
+}
+
 int64_t gq_size(void* h) {
   auto* q = static_cast<GradQueue*>(h);
   std::lock_guard<std::mutex> lock(q->mu);
@@ -251,6 +255,55 @@ void gq_cancel(void* h) {
   q->cancelled = true;
   q->cv.notify_all();
   q->cv_space.notify_all();
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Parameter store (cross-process PS role): chief publishes (step, params),
+// workers fetch the latest snapshot — the variable-hosting half of the
+// reference's PS task (SURVEY.md D3), serving reads the way worker->PS
+// variable fetches did (section 3.1 hot path).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct ParamStore {
+  std::mutex mu;
+  std::vector<float> data;
+  int64_t step = -1;  // -1 = never published
+
+  explicit ParamStore(int64_t n) : data(static_cast<size_t>(n), 0.0f) {}
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pstore_new(int64_t num_elems) {
+  if (num_elems <= 0) return nullptr;
+  return new (std::nothrow) ParamStore(num_elems);
+}
+
+void pstore_free(void* h) { delete static_cast<ParamStore*>(h); }
+
+int64_t pstore_num_elems(void* h) {
+  return static_cast<int64_t>(static_cast<ParamStore*>(h)->data.size());
+}
+
+void pstore_set(void* h, int64_t step, const float* data) {
+  auto* p = static_cast<ParamStore*>(h);
+  std::lock_guard<std::mutex> lock(p->mu);
+  std::memcpy(p->data.data(), data, p->data.size() * sizeof(float));
+  p->step = step;
+}
+
+// Copies the latest snapshot into `out`; returns its step (-1 if never set).
+int64_t pstore_get(void* h, float* out) {
+  auto* p = static_cast<ParamStore*>(h);
+  std::lock_guard<std::mutex> lock(p->mu);
+  std::memcpy(out, p->data.data(), p->data.size() * sizeof(float));
+  return p->step;
 }
 
 }  // extern "C"

@@ -1,0 +1,347 @@
+// Cross-process PS service: the accumulator/token/gradient-queue/param-store
+// C ABI (accumulator.cc) behind a localhost TCP socket.
+//
+// Reference role (SURVEY.md sections 2b D2/D10, 3.1): `tf.train.Server`
+// starts an in-process gRPC service every PS/worker process talks to; the
+// per-step hot path crosses it for gradient pushes and variable fetches.
+// Here the SPMD compute never crosses a process boundary (it is one XLA
+// program per worker); what crosses is the COORDINATION state — gradients
+// to aggregate/apply, tokens, the published parameter snapshot — exactly
+// the state the reference hosted on PS tasks.  Thread mode (same service
+// structs, direct ctypes calls) remains the CI default; this server is the
+// multi-process transport (parallel/ps_service.py client, W1/W2 emulations
+// across real processes incl. worker-kill — tests/test_ps_remote.py).
+//
+// Protocol (little-endian, one request -> one response per frame):
+//   request : u8 op | u8 name_len | name | i64 a | i64 b | u32 plen |
+//             f32 payload[plen]
+//   response: i64 status | u32 plen | f32 payload[plen]
+// Blocking ops (ACC_TAKE, TQ_POP, GQ_POP) block only their connection's
+// thread; CANCEL_ALL unblocks every waiter (shutdown / fail-fast path).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+#include <string>
+#include <thread>
+#include <vector>
+
+// C ABI from accumulator.cc.
+extern "C" {
+void* acc_new(int64_t);
+int acc_apply(void*, int64_t, const float*);
+int64_t acc_take(void*, int64_t, float*);
+void acc_set_global_step(void*, int64_t);
+int64_t acc_dropped(void*);
+int64_t acc_num_elems(void*);
+void acc_cancel(void*);
+void* tq_new();
+void tq_push(void*, int64_t, int64_t);
+int64_t tq_pop(void*);
+int64_t tq_size(void*);
+void tq_cancel(void*);
+void* gq_new(int64_t, int64_t);
+int gq_push(void*, int64_t, const float*);
+int64_t gq_pop(void*, float*);
+int64_t gq_num_elems(void*);
+void gq_set_min_step(void*, int64_t);
+int64_t gq_dropped(void*);
+void gq_cancel(void*);
+void* pstore_new(int64_t);
+void pstore_set(void*, int64_t, const float*);
+int64_t pstore_get(void*, float*);
+int64_t pstore_num_elems(void*);
+}
+
+namespace {
+
+enum Op : uint8_t {
+  ACC_GET = 1,
+  ACC_APPLY = 2,
+  ACC_TAKE = 3,
+  ACC_SET_STEP = 4,
+  ACC_DROPPED = 5,
+  TQ_GET = 6,
+  TQ_PUSH = 7,
+  TQ_POP = 8,
+  GQ_GET = 9,
+  GQ_PUSH = 10,
+  GQ_POP = 11,
+  GQ_SET_MIN = 12,
+  GQ_DROPPED = 13,
+  CANCEL_ALL = 14,
+  PING = 15,
+  PSTORE_GET_OBJ = 16,
+  PSTORE_SET = 17,
+  PSTORE_GET = 18,
+};
+
+struct Object {
+  uint8_t kind;  // 'a' acc, 't' tq, 'g' gq, 'p' pstore
+  void* handle;
+};
+
+struct Server {
+  std::mutex mu;
+  std::map<std::string, Object> objects;
+  int listen_fd = -1;
+  std::atomic<bool> stopping{false};
+  std::thread accept_thread;
+};
+
+Server* g_server = nullptr;
+std::mutex g_server_mu;
+
+bool read_n(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_n(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+//: Payload cap (f32 count) — a lying/hostile client must not drive an
+//: allocation beyond ~1 GiB (matches the dataloader's header discipline).
+constexpr uint32_t kMaxPayload = 256u << 20;
+
+Object* get_or_create(Server* s, const std::string& name, uint8_t kind,
+                      int64_t a, int64_t b) {
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto it = s->objects.find(name);
+  if (it != s->objects.end())
+    return it->second.kind == kind ? &it->second : nullptr;
+  void* h = nullptr;
+  switch (kind) {
+    case 'a': h = acc_new(a); break;
+    case 't': h = tq_new(); break;
+    case 'g': h = gq_new(a, b); break;
+    case 'p': h = pstore_new(a); break;
+  }
+  if (!h) return nullptr;
+  auto res = s->objects.emplace(name, Object{kind, h});
+  return &res.first->second;
+}
+
+Object* find(Server* s, const std::string& name, uint8_t kind) {
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto it = s->objects.find(name);
+  if (it == s->objects.end() || it->second.kind != kind) return nullptr;
+  return &it->second;
+}
+
+void cancel_all(Server* s) {
+  std::lock_guard<std::mutex> lock(s->mu);
+  for (auto& kv : s->objects) {
+    switch (kv.second.kind) {
+      case 'a': acc_cancel(kv.second.handle); break;
+      case 't': tq_cancel(kv.second.handle); break;
+      case 'g': gq_cancel(kv.second.handle); break;
+    }
+  }
+}
+
+void serve_conn(Server* s, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::vector<float> payload, out;
+  for (;;) {
+    uint8_t op = 0, name_len = 0;
+    if (!read_n(fd, &op, 1) || !read_n(fd, &name_len, 1)) break;
+    std::string name(name_len, '\0');
+    if (name_len && !read_n(fd, name.data(), name_len)) break;
+    int64_t a = 0, b = 0;
+    uint32_t plen = 0;
+    if (!read_n(fd, &a, 8) || !read_n(fd, &b, 8) || !read_n(fd, &plen, 4))
+      break;
+    if (plen > kMaxPayload) break;
+    payload.resize(plen);
+    if (plen && !read_n(fd, payload.data(), plen * sizeof(float))) break;
+
+    int64_t status = -2;  // -2 = bad request/object
+    out.clear();
+    Object* o = nullptr;
+    switch (op) {
+      case PING:
+        status = 0;
+        break;
+      case CANCEL_ALL:
+        cancel_all(s);
+        status = 0;
+        break;
+      case ACC_GET:
+        status = get_or_create(s, name, 'a', a, 0) ? 0 : -2;
+        break;
+      case TQ_GET:
+        status = get_or_create(s, name, 't', 0, 0) ? 0 : -2;
+        break;
+      case GQ_GET:
+        status = get_or_create(s, name, 'g', a, b) ? 0 : -2;
+        break;
+      case PSTORE_GET_OBJ:
+        status = get_or_create(s, name, 'p', a, 0) ? 0 : -2;
+        break;
+      case ACC_APPLY:
+        if ((o = find(s, name, 'a')) &&
+            plen == (uint32_t)acc_num_elems(o->handle))
+          status = acc_apply(o->handle, a, payload.data());
+        break;
+      case ACC_TAKE:
+        if ((o = find(s, name, 'a'))) {
+          out.resize((size_t)acc_num_elems(o->handle));
+          status = acc_take(o->handle, a, out.data());
+          if (status < 0) out.clear();
+        }
+        break;
+      case ACC_SET_STEP:
+        if ((o = find(s, name, 'a'))) {
+          acc_set_global_step(o->handle, a);
+          status = 0;
+        }
+        break;
+      case ACC_DROPPED:
+        if ((o = find(s, name, 'a'))) status = acc_dropped(o->handle);
+        break;
+      case TQ_PUSH:
+        if ((o = find(s, name, 't'))) {
+          tq_push(o->handle, a, b);
+          status = 0;
+        }
+        break;
+      case TQ_POP:
+        if ((o = find(s, name, 't'))) status = tq_pop(o->handle);
+        break;
+      case GQ_PUSH:
+        // Payload length is validated against the QUEUE's element count —
+        // a lying client must neither under-feed gq_push's memcpy nor
+        // bypass kMaxPayload.
+        if ((o = find(s, name, 'g')) &&
+            plen == (uint32_t)gq_num_elems(o->handle))
+          status = gq_push(o->handle, a, payload.data());
+        break;
+      case GQ_POP:
+        if ((o = find(s, name, 'g'))) {
+          // Output sized from the server-side queue, NEVER from client
+          // input (a client-controlled size here was a heap overflow).
+          out.resize((size_t)gq_num_elems(o->handle));
+          status = gq_pop(o->handle, out.data());
+          if (status < 0) out.clear();
+        }
+        break;
+      case GQ_SET_MIN:
+        if ((o = find(s, name, 'g'))) {
+          gq_set_min_step(o->handle, a);
+          status = 0;
+        }
+        break;
+      case GQ_DROPPED:
+        if ((o = find(s, name, 'g'))) status = gq_dropped(o->handle);
+        break;
+      case PSTORE_SET:
+        if ((o = find(s, name, 'p')) &&
+            plen == (uint32_t)pstore_num_elems(o->handle)) {
+          pstore_set(o->handle, a, payload.data());
+          status = 0;
+        }
+        break;
+      case PSTORE_GET:
+        if ((o = find(s, name, 'p'))) {
+          out.resize((size_t)pstore_num_elems(o->handle));
+          status = pstore_get(o->handle, out.data());
+        }
+        break;
+      default:
+        break;
+    }
+    uint32_t olen = static_cast<uint32_t>(out.size());
+    if (!write_n(fd, &status, 8) || !write_n(fd, &olen, 4)) break;
+    if (olen && !write_n(fd, out.data(), olen * sizeof(float))) break;
+  }
+  ::close(fd);
+}
+
+void accept_loop(Server* s) {
+  for (;;) {
+    int fd = ::accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (s->stopping.load()) return;
+      continue;
+    }
+    std::thread(serve_conn, s, fd).detach();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Starts the server on 127.0.0.1:<port> (0 = ephemeral); returns the bound
+// port, or -1 on failure.  One server per process.
+int ps_server_start(int port) {
+  std::lock_guard<std::mutex> lock(g_server_mu);
+  if (g_server) return -1;
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 64) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t len = sizeof(addr);
+  if (::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  auto* s = new (std::nothrow) Server();
+  if (!s) {
+    ::close(fd);
+    return -1;
+  }
+  s->listen_fd = fd;
+  s->accept_thread = std::thread(accept_loop, s);
+  g_server = s;
+  return static_cast<int>(ntohs(addr.sin_port));
+}
+
+// Cancels all blocking waiters and stops accepting.  (Object memory is
+// reclaimed at process exit — the server lives for the training run.)
+void ps_server_stop() {
+  std::lock_guard<std::mutex> lock(g_server_mu);
+  if (!g_server) return;
+  g_server->stopping.store(true);
+  cancel_all(g_server);
+  ::shutdown(g_server->listen_fd, SHUT_RDWR);
+  ::close(g_server->listen_fd);
+  g_server->accept_thread.join();
+  g_server = nullptr;
+}
+
+}  // extern "C"

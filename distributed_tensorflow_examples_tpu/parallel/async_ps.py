@@ -318,3 +318,187 @@ class AsyncPSTrainer:
             self.total_dropped,
         )
         return self.params
+
+
+# ----------------------------------------------------------------------------
+# Cross-process mode (r3): the same emulation over native/ps_server.cc
+# ----------------------------------------------------------------------------
+
+
+class RemotePSChief(AsyncPSTrainer):
+    """Chief PROCESS: hosts the C++ PS service in-process (the PS-task role
+    — ``tf.train.Server`` started by every task, SURVEY.md section 3.1),
+    publishes parameter snapshots to the param store after every applied
+    update, and runs the chief loop.  Workers are SEPARATE PROCESSES running
+    :func:`remote_worker_loop`; thread mode (AsyncPSTrainer) stays the CI
+    default."""
+
+    def __init__(self, cfg, loss_fn, optimizer, init_params, *, port: int = 0, **kw):
+        from . import ps_service
+
+        self.port = ps_service.start_server(port)
+        self._client = ps_service.PSClient("127.0.0.1", self.port)
+        super().__init__(cfg, loss_fn, optimizer, init_params, **kw)
+        total = sum(self._leaf_sizes)
+        # Replace the in-process services with their socket proxies, so the
+        # chief exercises the same transport the workers do.
+        if cfg.mode == "sync_replicas":
+            self._accs = [ps_service.RemoteAccumulator(self._client, "acc", total)]
+        else:
+            self._gq = ps_service.RemoteGradientQueue(
+                self._client, "gq", total, capacity=max(4, 2 * cfg.num_workers)
+            )
+        self._tq = ps_service.RemoteTokenQueue(self._client, "tokens")
+        self._pstore = ps_service.RemoteParamStore(self._client, "params", total)
+        self._publish()
+
+    def _publish(self) -> None:
+        flat = np.concatenate(
+            [np.asarray(l).reshape(-1) for l in jax.tree.leaves(self.params)]
+        ).astype(np.float32)
+        self._pstore.set(self.global_step, flat)
+
+    def _apply_update(self, grads) -> None:
+        super()._apply_update(grads)
+        self._publish()
+
+    def run_chief(self):
+        """Run the chief loop against EXTERNAL worker processes; returns the
+        final params.  Cancels all blocked waiters at the end so workers'
+        pending pops return None and they exit."""
+        from . import ps_service
+
+        self.restore_latest()
+        self._publish()
+        try:
+            if self.global_step < self.cfg.train_steps:
+                if self.cfg.mode == "sync_replicas":
+                    self._chief_sync()
+                else:
+                    self._chief_async()
+        finally:
+            # Unblock the workers FIRST and unconditionally: any remote call
+            # placed before cancel_all could raise on a broken transport and
+            # strand every external worker in a blocking pop.
+            try:
+                self._publish()  # final step: async workers observe done-ness
+            except Exception:
+                log.exception("final publish failed")
+            try:
+                self._client.cancel_all()
+            except Exception:
+                log.exception("cancel_all failed (server already down?)")
+            try:
+                self.total_dropped = sum(
+                    acc.dropped for acc in self._accs
+                ) + (self._gq.dropped if self._gq is not None else 0)
+            except Exception:
+                self.total_dropped = -1  # transport gone; counter unknown
+        if self.cfg.ckpt_dir:
+            self.save_checkpoint()
+        log.info(
+            "remote async-PS chief done: %d applied steps, %d stale drops",
+            self.global_step,
+            self.total_dropped,
+        )
+        return self.params
+
+
+def remote_worker_loop(
+    host: str,
+    port: int,
+    wid: int,
+    *,
+    cfg: AsyncPSConfig,
+    loss_fn: Callable,
+    init_fn: Callable,
+    batches: Iterator,
+    model_state: Any = None,
+    rng: jax.Array | None = None,
+) -> int:
+    """Worker PROCESS body: fetch the latest published params, compute a
+    gradient on a local batch, push it (accumulator in sync mode, gradient
+    queue in async mode).  Returns the number of gradients contributed.
+
+    ``init_fn`` rebuilds the parameter STRUCTURE locally (deterministic
+    shapes/treedef); values always come from the param store.
+    """
+    from . import ps_service
+
+    client = ps_service.PSClient(host, port)
+    template = init_fn(jax.random.key(0))
+    leaves, treedef = jax.tree.flatten(template)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    offsets = np.cumsum([0] + sizes)
+    total = int(offsets[-1])
+
+    def unflatten(flat):
+        return jax.tree.unflatten(
+            treedef,
+            [
+                flat[offsets[i] : offsets[i + 1]].reshape(s)
+                for i, s in enumerate(shapes)
+            ],
+        )
+
+    pstore = ps_service.RemoteParamStore(client, "params", total)
+    tq = ps_service.RemoteTokenQueue(client, "tokens")
+    if cfg.mode == "sync_replicas":
+        acc = ps_service.RemoteAccumulator(client, "acc", total)
+    else:
+        gq = ps_service.RemoteGradientQueue(
+            client, "gq", total, capacity=max(4, 2 * cfg.num_workers)
+        )
+    model_state = model_state if model_state is not None else {}
+    rng = rng if rng is not None else jax.random.key(0)
+
+    def _grad(params, model_state, batch, rng):
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, model_state, batch, rng
+        )
+        return loss, grads
+
+    grad_fn = jax.jit(_grad)
+    contributed = 0
+    it = 0
+    while True:
+        # EVERY remote call is inside the guard: the chief exiting (socket
+        # closed mid-recv) must end the worker cleanly, not crash it.
+        try:
+            if cfg.mode == "sync_replicas":
+                token = tq.pop()
+                if token is None:
+                    break
+                local_step = token
+                step, flat = pstore.get()
+            else:
+                step, flat = pstore.get()
+                if step >= cfg.train_steps:
+                    break
+                local_step = max(step, 0)
+        except (RuntimeError, ConnectionError, OSError):
+            break
+        params = unflatten(flat)
+        try:
+            batch = next(batches)
+        except StopIteration:
+            break
+        r = jax.random.fold_in(jax.random.fold_in(rng, wid), it)
+        _, grads = grad_fn(params, model_state, batch, r)
+        flat_g = np.concatenate(
+            [np.asarray(g).reshape(-1) for g in jax.tree.leaves(grads)]
+        ).astype(np.float32)
+        try:
+            if cfg.mode == "sync_replicas":
+                acc.apply(local_step, flat_g)
+            else:
+                pushed = gq.push(local_step, flat_g)
+                if pushed is None:
+                    break  # cancelled: the chief is done or failed
+        except (RuntimeError, ConnectionError, OSError):
+            break  # chief finished and tore the service down
+        contributed += 1
+        it += 1
+    client.close()
+    return contributed

@@ -1,0 +1,200 @@
+"""Client for the cross-process PS service (native/ps_server.cc).
+
+The thread-mode async-PS emulation (parallel/async_ps.py) talks to the
+native accumulator/token/gradient-queue structs through direct ctypes calls;
+this module provides the SAME object APIs over a localhost TCP socket, so
+the W1/W2 emulations run across real processes — the reference's PS/worker
+process topology (SURVEY.md sections 3.1/3.2), with the chief process
+hosting the service (the PS task role) and each worker process connecting.
+
+One socket per client; requests are serialized on it (a worker's op
+sequence is sequential anyway, and blocking ops — token pop, accumulator
+take, gradient pop — tie up only that client's server-side thread).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+import numpy as np
+
+from .. import native
+
+# Op codes (must match native/ps_server.cc).
+_ACC_GET, _ACC_APPLY, _ACC_TAKE, _ACC_SET_STEP, _ACC_DROPPED = 1, 2, 3, 4, 5
+_TQ_GET, _TQ_PUSH, _TQ_POP = 6, 7, 8
+_GQ_GET, _GQ_PUSH, _GQ_POP, _GQ_SET_MIN, _GQ_DROPPED = 9, 10, 11, 12, 13
+_CANCEL_ALL, _PING = 14, 15
+_PSTORE_GET_OBJ, _PSTORE_SET, _PSTORE_GET = 16, 17, 18
+
+
+def start_server(port: int = 0) -> int:
+    """Start the in-process C++ PS server; returns the bound port."""
+    lib = native._load()
+    import ctypes
+
+    lib.ps_server_start.restype = ctypes.c_int
+    lib.ps_server_start.argtypes = [ctypes.c_int]
+    p = lib.ps_server_start(port)
+    if p < 0:
+        raise RuntimeError("ps_server_start failed")
+    return p
+
+
+def stop_server() -> None:
+    lib = native._load()
+    lib.ps_server_stop()
+
+
+class PSClient:
+    """One TCP connection to the PS server; thread-safe via a lock."""
+
+    def __init__(self, host: str, port: int, *, timeout_s: float | None = None):
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _recv_n(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("PS server closed the connection")
+            buf += chunk
+        return buf
+
+    def call(
+        self, op: int, name: str = "", a: int = 0, b: int = 0,
+        payload: np.ndarray | None = None,
+    ) -> tuple[int, np.ndarray]:
+        nm = name.encode()
+        pl = (
+            np.ascontiguousarray(payload, np.float32).tobytes()
+            if payload is not None
+            else b""
+        )
+        req = (
+            struct.pack("<BB", op, len(nm)) + nm
+            + struct.pack("<qqI", a, b, len(pl) // 4) + pl
+        )
+        with self._lock:
+            self._sock.sendall(req)
+            status, plen = struct.unpack("<qI", self._recv_n(12))
+            out = (
+                np.frombuffer(self._recv_n(plen * 4), np.float32).copy()
+                if plen
+                else np.empty((0,), np.float32)
+            )
+        return status, out
+
+    def ping(self) -> None:
+        status, _ = self.call(_PING)
+        if status != 0:
+            raise RuntimeError("PS server ping failed")
+
+    def cancel_all(self) -> None:
+        self.call(_CANCEL_ALL)
+
+
+def _check(status: int, what: str) -> int:
+    if status == -2:
+        raise RuntimeError(f"PS server rejected {what} (bad object/request)")
+    return status
+
+
+class RemoteAccumulator:
+    """API-compatible with native.GradientAccumulator, over the socket."""
+
+    def __init__(self, client: PSClient, name: str, num_elems: int):
+        self._c, self._name, self._n = client, name, num_elems
+        _check(client.call(_ACC_GET, name, num_elems)[0], "acc_get")
+
+    def apply(self, local_step: int, grad: np.ndarray) -> bool:
+        s, _ = self._c.call(_ACC_APPLY, self._name, local_step, payload=grad)
+        return _check(s, "acc_apply") == 1
+
+    def take(self, num_required: int) -> np.ndarray | None:
+        s, out = self._c.call(_ACC_TAKE, self._name, num_required)
+        return out if _check(s, "acc_take") >= 0 else None
+
+    def set_global_step(self, step: int) -> None:
+        _check(self._c.call(_ACC_SET_STEP, self._name, step)[0], "acc_set_step")
+
+    @property
+    def dropped(self) -> int:
+        return _check(self._c.call(_ACC_DROPPED, self._name)[0], "acc_dropped")
+
+    def cancel(self) -> None:
+        self._c.cancel_all()
+
+
+class RemoteTokenQueue:
+    """API-compatible with native.TokenQueue."""
+
+    def __init__(self, client: PSClient, name: str):
+        self._c, self._name = client, name
+        _check(client.call(_TQ_GET, name)[0], "tq_get")
+
+    def push(self, step: int, n: int = 1) -> None:
+        _check(self._c.call(_TQ_PUSH, self._name, step, n)[0], "tq_push")
+
+    def pop(self) -> int | None:
+        s, _ = self._c.call(_TQ_POP, self._name)
+        return s if s >= 0 else None
+
+    def cancel(self) -> None:
+        self._c.cancel_all()
+
+
+class RemoteGradientQueue:
+    """API-compatible with native.GradientQueue."""
+
+    def __init__(self, client: PSClient, name: str, num_elems: int, capacity: int = 16):
+        self._c, self._name, self._n = client, name, num_elems
+        _check(client.call(_GQ_GET, name, num_elems, capacity)[0], "gq_get")
+
+    def push(self, local_step: int, grad: np.ndarray) -> bool | None:
+        """Tri-state like native.GradientQueue.push: True enqueued, False
+        stale-dropped, None cancelled (termination signal)."""
+        s, _ = self._c.call(_GQ_PUSH, self._name, local_step, payload=grad)
+        return None if _check(s, "gq_push") < 0 else s == 1
+
+    def pop(self) -> tuple[int, np.ndarray] | None:
+        s, out = self._c.call(_GQ_POP, self._name, self._n)
+        return (s, out) if s >= 0 else None
+
+    def set_min_step(self, step: int) -> None:
+        _check(self._c.call(_GQ_SET_MIN, self._name, step)[0], "gq_set_min")
+
+    @property
+    def dropped(self) -> int:
+        return _check(self._c.call(_GQ_DROPPED, self._name)[0], "gq_dropped")
+
+    def cancel(self) -> None:
+        self._c.cancel_all()
+
+
+class RemoteParamStore:
+    """Published (step, flat params) snapshot — the PS variable-hosting
+    role; chief sets after every applied update, workers get before every
+    gradient computation (SURVEY.md section 3.1 hot path)."""
+
+    def __init__(self, client: PSClient, name: str, num_elems: int):
+        self._c, self._name, self._n = client, name, num_elems
+        _check(client.call(_PSTORE_GET_OBJ, name, num_elems)[0], "pstore_get_obj")
+
+    def set(self, step: int, flat: np.ndarray) -> None:
+        _check(self._c.call(_PSTORE_SET, self._name, step, payload=flat)[0],
+               "pstore_set")
+
+    def get(self) -> tuple[int, np.ndarray]:
+        s, out = self._c.call(_PSTORE_GET, self._name)
+        return _check(s, "pstore_get"), out

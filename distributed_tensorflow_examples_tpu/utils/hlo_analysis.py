@@ -40,6 +40,48 @@ class Collective:
     shapes: list[str]  # e.g. ["f32[1024,128]"]
     bytes: int  # total result payload
     line: str  # the HLO line (trimmed), for debugging/asserts
+    groups_attr: str = ""  # replica_groups/source_target_pairs attr (FULL,
+    # extracted before the line is trimmed; "" = attr absent, which for
+    # SPMD collectives means ONE global group)
+
+    @property
+    def groups(self) -> list[list[int]] | None:
+        """Replica groups, parsed from the line: explicit ``{{0,1},{2,3}}``
+        form or the iota form ``[g,k]<=[N]`` / ``[g,k]<=[a,b]T(1,0)``.
+        None when absent or unparseable (callers must treat None as
+        'unknown', not 'global').  collective-permute carries
+        ``source_target_pairs`` instead; each pair is returned as a
+        2-element group."""
+        src = self.groups_attr or self.line
+        m = re.search(r"source_target_pairs=\{(\{[\d, ]*\}(?:\s*,\s*\{[\d, ]*\})*)\}", src)
+        if m:
+            return [
+                [int(x) for x in g.split(",") if x.strip()]
+                for g in re.findall(r"\{([\d, ]*)\}", m.group(1))
+            ]
+        m = re.search(r"replica_groups=\{(\{[\d, ]*\}(?:\s*,\s*\{[\d, ]*\})*)\}", src)
+        if m:
+            return [
+                [int(x) for x in g.split(",") if x.strip()]
+                for g in re.findall(r"\{([\d, ]*)\}", m.group(1))
+            ]
+        m = re.search(
+            r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?",
+            src,
+        )
+        if m:
+            g, k = int(m.group(1)), int(m.group(2))
+            dims = [int(x) for x in m.group(3).split(",")]
+            import numpy as _np
+
+            arr = _np.arange(_np.prod(dims)).reshape(dims)
+            if m.group(4):
+                arr = arr.transpose([int(x) for x in m.group(4).split(",")])
+            flat = arr.reshape(-1)
+            if flat.size != g * k:
+                return None
+            return [flat[i * k : (i + 1) * k].tolist() for i in range(g)]
+        return None
 
 
 def _shape_bytes(dtype: str, dims: str) -> int:
@@ -94,12 +136,18 @@ def parse_collectives(hlo_text: str) -> list[Collective]:
                 total = sum(sizes) // 2 if len(sizes) > 1 else sizes[0]
         else:
             total = sum(sizes)  # sync variadic tuple = genuinely N payloads
+        ga = re.search(
+            r"(?:replica_groups|source_target_pairs)=(?:\{[^=]*?\}\}|\{\}|"
+            r"\[[\d,]+\]<=\[[\d,]+\](?:T\([\d,]+\))?)",
+            line,
+        )
         out.append(
             Collective(
                 kind=op,
                 shapes=[f"{dt}[{dims}]" for dt, dims in shapes],
                 bytes=total,
                 line=line[:240],
+                groups_attr=ga.group(0) if ga else "",
             )
         )
     return out

@@ -45,6 +45,13 @@ flags.DEFINE_bool(
     "remat", False, "Rematerialise blocks in backward (fits bigger batches)."
 )
 flags.DEFINE_integer(
+    "loss_chunks",
+    0,
+    ">1 chunks the LM head + cross-entropy over the sequence (the [B,T,V] "
+    "logits never materialise — fits bigger batches/longer context; "
+    "identical numerics).  Requires seq_len %% loss_chunks == 0.",
+)
+flags.DEFINE_integer(
     "sample_tokens",
     0,
     ">0: after training, greedy-decode this many tokens from a corpus "
@@ -119,6 +126,7 @@ def main(argv):
         moe_experts=FLAGS.moe_experts,
         moe_capacity_factor=FLAGS.moe_capacity_factor,
         remat=FLAGS.remat,
+        loss_chunks=FLAGS.loss_chunks,
     )
     exp = train.Experiment(
         init_fn=lambda rng: models.transformer.init(cfg, rng),

@@ -47,3 +47,9 @@ def rng():
 @pytest.fixture(autouse=True)
 def _np_seed():
     np.random.seed(0)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long multi-process / fault-injection tests"
+    )

@@ -1,0 +1,164 @@
+"""Cross-process async-PS emulation (r2 verdict missing #3 / next-step 5).
+
+The W1 (sync-replicas) and W2 (async) coordination semantics run across
+REAL processes: the chief process hosts the C++ PS service
+(native/ps_server.cc) — accumulator, token queue, gradient queue, param
+store — and worker processes connect over the localhost socket
+(parallel/ps_service.py), fetch published parameter snapshots, and push
+gradients.  Includes a mid-run SIGKILL of one worker (the reference
+harness's task-kill fault injection, SURVEY.md section 4).
+
+Thread mode (tests/test_async_ps.py) remains the CI default for semantics;
+these tests prove the process-boundary transport.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+
+import pytest
+
+from distributed_tensorflow_examples_tpu.utils.multiprocess import (
+    MultiProcessRunner,
+)
+
+_SCRIPT = """
+import os, sys, time
+import numpy as np
+import jax, jax.numpy as jnp
+import optax
+
+from distributed_tensorflow_examples_tpu.parallel import async_ps
+
+idx = int(sys.argv[1])
+mode = os.environ["DTX_PS_MODE"]
+d = os.environ["DTX_PS_DIR"]
+steps = int(os.environ["DTX_PS_STEPS"])
+dim = 8
+W_TRUE = np.arange(dim, dtype=np.float32)
+
+
+def init_fn(rng):
+    return {"w": jnp.zeros((dim,), jnp.float32)}
+
+
+def loss_fn(params, model_state, batch, rng):
+    pred = batch["x"] @ params["w"]
+    l = jnp.mean((pred - batch["y"]) ** 2)
+    return l, (model_state, {"loss": l})
+
+
+def batches(seed):
+    r = np.random.default_rng(seed)
+    while True:
+        x = r.normal(size=(32, dim)).astype(np.float32)
+        yield {"x": x, "y": x @ W_TRUE}
+
+
+cfg = async_ps.AsyncPSConfig(
+    num_workers=2,
+    mode=mode,
+    train_steps=steps,
+    replicas_to_aggregate=1 if mode == "sync_replicas" else None,
+    max_staleness=8 if mode == "async" else None,
+)
+if idx == 0:
+    chief = async_ps.RemotePSChief(
+        cfg, loss_fn, optax.sgd(0.05), init_fn(jax.random.key(0))
+    )
+    with open(os.path.join(d, "port.tmp"), "w") as f:
+        f.write(str(chief.port))
+    os.rename(os.path.join(d, "port.tmp"), os.path.join(d, "port"))
+    params = chief.run_chief()
+    err = float(np.abs(np.asarray(params["w"]) - W_TRUE).max())
+    print(
+        f"CHIEF_DONE step={chief.global_step} dropped={chief.total_dropped} "
+        f"err={err:.4f}",
+        flush=True,
+    )
+else:
+    p = os.path.join(d, "port")
+    for _ in range(600):
+        if os.path.exists(p):
+            break
+        time.sleep(0.1)
+    port = int(open(p).read())
+    n = async_ps.remote_worker_loop(
+        "127.0.0.1", port, idx, cfg=cfg, loss_fn=loss_fn, init_fn=init_fn,
+        batches=batches(idx),
+    )
+    print(f"WORKER_DONE n={n}", flush=True)
+"""
+
+
+def _run(mode: str, steps: int, *, kill_after: float | None = None):
+    d = tempfile.mkdtemp(prefix="dtx_psr_")
+    r = MultiProcessRunner(
+        3,
+        _SCRIPT,
+        env={
+            "DTX_PS_MODE": mode,
+            "DTX_PS_DIR": d,
+            "DTX_PS_STEPS": str(steps),
+        },
+        timeout=300.0,
+        prelude=False,
+    )
+    r.start()
+    if kill_after is not None:
+        # Let the run get going (port published + some steps), then SIGKILL
+        # one worker mid-run.
+        port = os.path.join(d, "port")
+        deadline = time.time() + 120
+        while not os.path.exists(port) and time.time() < deadline:
+            time.sleep(0.2)
+        time.sleep(kill_after)
+        r.kill_task(2)
+    codes = r.join()
+    outs = [r.output(i) for i in range(3)]
+    r.cleanup()
+    return codes, outs
+
+
+@pytest.mark.slow
+def test_sync_replicas_across_processes():
+    codes, outs = _run("sync_replicas", steps=40)
+    assert codes[0] == 0, outs[0][-2000:]
+    assert codes[1] == 0 and codes[2] == 0, (outs[1][-800:], outs[2][-800:])
+    assert "CHIEF_DONE step=40" in outs[0], outs[0][-2000:]
+    # The quadratic must actually have been optimised via the socket path.
+    err = float(outs[0].split("err=")[1].split()[0])
+    assert err < 0.5, outs[0][-2000:]
+    # Enough gradients crossed the socket to serve every applied step
+    # (with replicas_to_aggregate=1 a single fast worker may legitimately
+    # serve them all while the other is still warming up on a loaded CI
+    # host, so the guaranteed invariant is the TOTAL, not per-worker).
+    total = sum(
+        int(o.split("WORKER_DONE n=")[1].split()[0]) for o in outs[1:]
+    )
+    assert total >= 40, (outs[1][-400:], outs[2][-400:])
+
+
+@pytest.mark.slow
+def test_async_across_processes():
+    codes, outs = _run("async", steps=60)
+    assert codes[0] == 0, outs[0][-2000:]
+    assert "CHIEF_DONE step=60" in outs[0], outs[0][-2000:]
+    err = float(outs[0].split("err=")[1].split()[0])
+    assert err < 0.5, outs[0][-2000:]
+
+
+@pytest.mark.slow
+def test_sync_replicas_survives_worker_kill():
+    """SIGKILL one of two workers mid-run: with replicas_to_aggregate=1 the
+    chief keeps aggregating from the survivor and reaches the step target
+    (the reference's crash-tolerant PS behavior — dead workers just stop
+    pushing; SURVEY.md sections 3.1/5.3)."""
+    codes, outs = _run("sync_replicas", steps=150, kill_after=3.0)
+    assert codes[0] == 0, outs[0][-2000:]
+    assert codes[2] != 0  # the killed worker died
+    assert "CHIEF_DONE step=150" in outs[0], outs[0][-2000:]
+    err = float(outs[0].split("err=")[1].split()[0])
+    assert err < 0.5, outs[0][-2000:]
