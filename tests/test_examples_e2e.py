@@ -121,7 +121,10 @@ def test_cifar10_async_ps(tmp_path):
     signals with ONE retry on a different seed — a genuinely broken
     trainer fails both attempts deterministically.  Sync quality
     thresholds live in the mnist/resnet tests; async *semantics* are
-    deterministic unit tests in test_async_ps.py.
+    deterministic unit tests in test_async_ps.py, and a DETERMINISTIC
+    async learning gate (quadratic converges to err<0.5 through the same
+    per-gradient apply path, across real processes) lives in
+    tests/test_ps_remote.py::test_async_across_processes.
     """
     last_f = None
     for attempt, seed in enumerate((0, 1)):
