@@ -18,7 +18,10 @@ optional.  Params stay f32; compute in bf16 on the MXU.
 Checkpoint-format note: the qkv kernel's output columns are interpreted
 head-major — (H, 3, head_dim) — so a TP shard owns whole heads (round-2
 change; round-1 checkpoints used (3, H, head_dim) and are incompatible:
-they restore without error but produce garbage attention).
+they restore without error but produce garbage attention).  The same
+caveat applies across ``n_heads`` changes at fixed dim (e.g. the r3
+flagship default moved 16 -> 8 heads): shapes match, column meaning does
+not — a checkpoint is only valid for the Config it was trained with.
 """
 
 from __future__ import annotations
