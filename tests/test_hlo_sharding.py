@@ -195,3 +195,28 @@ def test_moe_dispatch_lowers_to_all_to_all():
     assert ag < full_w1_bytes, (
         f"all-gather of {ag} B >= stacked expert weights ({full_w1_bytes} B)"
     )
+
+
+def test_replica_group_parsing_forms():
+    """hlo_analysis.Collective.groups must parse every group syntax the
+    hybrid ICI/DCN classifier depends on: explicit braces (with spaces),
+    iota form, transposed iota form, and collective-permute's
+    source_target_pairs; absent attr stays None (caller treats as global)."""
+    hlo = """
+  %a = f32[8]{0} all-reduce(f32[8]{0} %x), replica_groups={{0,1}, {2,3}}
+  %b = f32[8]{0} all-gather(f32[8]{0} %y), replica_groups=[2,4]<=[8], dimensions={0}
+  %c = f32[8]{0} all-reduce(f32[8]{0} %z), replica_groups=[4,2]<=[2,4]T(1,0)
+  %d = f32[8]{0} collective-permute(f32[8]{0} %w), source_target_pairs={{0,4},{1,5}}
+  %e = f32[8]{0} all-reduce(f32[8]{0} %v)
+"""
+    cs = hlo_analysis.parse_collectives(hlo)
+    assert [c.kind for c in cs] == [
+        "all-reduce", "all-gather", "all-reduce", "collective-permute",
+        "all-reduce",
+    ]
+    assert cs[0].groups == [[0, 1], [2, 3]]
+    assert cs[1].groups == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    # [2,4] iota transposed: ids arange(8).reshape(2,4).T.flatten()
+    assert cs[2].groups == [[0, 4], [1, 5], [2, 6], [3, 7]]
+    assert cs[3].groups == [[0, 4], [1, 5]]
+    assert cs[4].groups is None and cs[4].groups_attr == ""

@@ -162,3 +162,43 @@ def test_sync_replicas_survives_worker_kill():
     assert "CHIEF_DONE step=150" in outs[0], outs[0][-2000:]
     err = float(outs[0].split("err=")[1].split()[0])
     assert err < 0.5, outs[0][-2000:]
+
+
+def test_ps_protocol_rejects_bad_requests():
+    """Server-side validation (in-process, no subprocesses): wrong-size
+    accumulator/grad payloads are rejected with a clean error, object-type
+    mismatches fail get-or-create, and unknown ops return the bad-request
+    status instead of crashing the serving thread."""
+    import numpy as np
+    import pytest as _pytest
+
+    from distributed_tensorflow_examples_tpu.parallel import ps_service
+
+    port = ps_service.start_server(0)
+    try:
+        c = ps_service.PSClient("127.0.0.1", port)
+        c.ping()
+        acc = ps_service.RemoteAccumulator(c, "a1", 16)
+        # Wrong payload size -> -2 -> RuntimeError, connection still usable.
+        with _pytest.raises(RuntimeError):
+            acc.apply(0, np.zeros(8, np.float32))
+        assert acc.apply(0, np.zeros(16, np.float32))
+        # Same name, different type -> rejected.
+        with _pytest.raises(RuntimeError):
+            ps_service.RemoteTokenQueue(c, "a1")
+        # Unknown op code -> bad-request status, not a dead server.
+        status, _ = c.call(99, "whatever")
+        assert status == -2
+        c.ping()
+        # Gradient queue payload validation mirrors the accumulator's.
+        gq = ps_service.RemoteGradientQueue(c, "g1", 16, capacity=4)
+        with _pytest.raises(RuntimeError):
+            gq.push(0, np.zeros(4, np.float32))
+        assert gq.push(0, np.zeros(16, np.float32)) is True
+        step, out = ps_service.RemoteParamStore(c, "p1", 16), None
+        step.set(3, np.arange(16, dtype=np.float32))
+        got_step, vals = step.get()
+        assert got_step == 3 and vals.shape == (16,)
+        c.close()
+    finally:
+        ps_service.stop_server()
