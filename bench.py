@@ -206,7 +206,7 @@ def bench_resnet50(steps: int, batch_per_chip: int, image_size: int = 224):
 
 def bench_transformer(
     steps: int, batch_per_chip: int, seq_len: int = 2048, remat: bool = False,
-    loss_chunks: int = 8, n_heads: int = 8,
+    loss_chunks: int = 0, n_heads: int = 8,
 ):
     """Transformer LM tokens/sec/chip + MFU (flash attention on TPU).
 

@@ -26,11 +26,13 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
 #include <cstdint>
 #include <cstring>
 #include <map>
 #include <mutex>
 #include <new>
+#include <set>
 #include <string>
 #include <thread>
 #include <vector>
@@ -96,6 +98,12 @@ struct Server {
   int listen_fd = -1;
   std::atomic<bool> stopping{false};
   std::thread accept_thread;
+  // Live connection fds: stop() shuts them down so blocked readers exit
+  // promptly; a conn erases its fd (under conn_mu) BEFORE closing, so stop
+  // never touches a reused descriptor.
+  std::mutex conn_mu;
+  std::set<int> conn_fds;
+  std::atomic<int> live_conns{0};
 };
 
 Server* g_server = nullptr;
@@ -118,6 +126,18 @@ bool write_n(int fd, const void* buf, size_t n) {
     ssize_t r = ::write(fd, p, n);
     if (r <= 0) return false;
     p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// Discard n bytes from the socket (keeps request framing intact when a
+// payload is rejected without being stored).
+bool drain_n(int fd, size_t n) {
+  char buf[4096];
+  while (n) {
+    ssize_t r = ::read(fd, buf, n < sizeof(buf) ? n : sizeof(buf));
+    if (r <= 0) return false;
     n -= static_cast<size_t>(r);
   }
   return true;
@@ -163,7 +183,7 @@ void cancel_all(Server* s) {
   }
 }
 
-void serve_conn(Server* s, int fd) {
+void serve_conn_impl(Server* s, int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   std::vector<float> payload, out;
@@ -177,6 +197,28 @@ void serve_conn(Server* s, int fd) {
     if (!read_n(fd, &a, 8) || !read_n(fd, &b, 8) || !read_n(fd, &plen, 4))
       break;
     if (plen > kMaxPayload) break;
+    // Allocation is sized from SERVER-side state only: the expected element
+    // count of the named object (0 for payload-less ops or missing
+    // objects).  A lying client's u32 therefore cannot drive a resize —
+    // mismatched payloads are drained (framing intact) and answered -2.
+    // ``payload_obj`` is reused by the dispatch below (one lookup, one
+    // mutex acquisition per request on the gradient-push hot path).
+    size_t expected = 0;
+    Object* payload_obj = nullptr;
+    if (op == ACC_APPLY && (payload_obj = find(s, name, 'a')))
+      expected = static_cast<size_t>(acc_num_elems(payload_obj->handle));
+    else if (op == GQ_PUSH && (payload_obj = find(s, name, 'g')))
+      expected = static_cast<size_t>(gq_num_elems(payload_obj->handle));
+    else if (op == PSTORE_SET && (payload_obj = find(s, name, 'p')))
+      expected = static_cast<size_t>(pstore_num_elems(payload_obj->handle));
+    if (plen != expected) {
+      if (plen && !drain_n(fd, static_cast<size_t>(plen) * sizeof(float)))
+        break;
+      int64_t status = -2;
+      uint32_t olen = 0;
+      if (!write_n(fd, &status, 8) || !write_n(fd, &olen, 4)) break;
+      continue;
+    }
     payload.resize(plen);
     if (plen && !read_n(fd, payload.data(), plen * sizeof(float))) break;
 
@@ -204,9 +246,8 @@ void serve_conn(Server* s, int fd) {
         status = get_or_create(s, name, 'p', a, 0) ? 0 : -2;
         break;
       case ACC_APPLY:
-        if ((o = find(s, name, 'a')) &&
-            plen == (uint32_t)acc_num_elems(o->handle))
-          status = acc_apply(o->handle, a, payload.data());
+        // Size already validated against the pre-checked object above.
+        if ((o = payload_obj)) status = acc_apply(o->handle, a, payload.data());
         break;
       case ACC_TAKE:
         if ((o = find(s, name, 'a'))) {
@@ -234,12 +275,10 @@ void serve_conn(Server* s, int fd) {
         if ((o = find(s, name, 't'))) status = tq_pop(o->handle);
         break;
       case GQ_PUSH:
-        // Payload length is validated against the QUEUE's element count —
-        // a lying client must neither under-feed gq_push's memcpy nor
-        // bypass kMaxPayload.
-        if ((o = find(s, name, 'g')) &&
-            plen == (uint32_t)gq_num_elems(o->handle))
-          status = gq_push(o->handle, a, payload.data());
+        // Size validated against the QUEUE's element count in the
+        // pre-check — a lying client can neither under-feed gq_push's
+        // memcpy nor drive an allocation.
+        if ((o = payload_obj)) status = gq_push(o->handle, a, payload.data());
         break;
       case GQ_POP:
         if ((o = find(s, name, 'g'))) {
@@ -260,8 +299,7 @@ void serve_conn(Server* s, int fd) {
         if ((o = find(s, name, 'g'))) status = gq_dropped(o->handle);
         break;
       case PSTORE_SET:
-        if ((o = find(s, name, 'p')) &&
-            plen == (uint32_t)pstore_num_elems(o->handle)) {
+        if ((o = payload_obj)) {
           pstore_set(o->handle, a, payload.data());
           status = 0;
         }
@@ -279,7 +317,22 @@ void serve_conn(Server* s, int fd) {
     if (!write_n(fd, &status, 8) || !write_n(fd, &olen, 4)) break;
     if (olen && !write_n(fd, out.data(), olen * sizeof(float))) break;
   }
+}
+
+void serve_conn(Server* s, int fd) {
+  // A per-connection failure (std::bad_alloc included) closes THIS
+  // connection only — an uncaught exception in a detached thread would
+  // std::terminate the chief holding all training state.
+  try {
+    serve_conn_impl(s, fd);
+  } catch (...) {
+  }
+  {
+    std::lock_guard<std::mutex> lock(s->conn_mu);
+    s->conn_fds.erase(fd);
+  }
   ::close(fd);
+  s->live_conns.fetch_sub(1);
 }
 
 void accept_loop(Server* s) {
@@ -287,8 +340,16 @@ void accept_loop(Server* s) {
     int fd = ::accept(s->listen_fd, nullptr, nullptr);
     if (fd < 0) {
       if (s->stopping.load()) return;
+      // Persistent accept errors (e.g. EMFILE) must not busy-spin this
+      // thread against the chief's training work.
+      std::this_thread::sleep_for(std::chrono::milliseconds(5));
       continue;
     }
+    {
+      std::lock_guard<std::mutex> lock(s->conn_mu);
+      s->conn_fds.insert(fd);
+    }
+    s->live_conns.fetch_add(1);
     std::thread(serve_conn, s, fd).detach();
   }
 }
@@ -331,8 +392,9 @@ int ps_server_start(int port) {
   return static_cast<int>(ntohs(addr.sin_port));
 }
 
-// Cancels all blocking waiters and stops accepting.  (Object memory is
-// reclaimed at process exit — the server lives for the training run.)
+// Cancels all blocking waiters, stops accepting, shuts down live
+// connections and waits (bounded) for their threads to drain.  (Object
+// memory is reclaimed at process exit — the server lives for the run.)
 void ps_server_stop() {
   std::lock_guard<std::mutex> lock(g_server_mu);
   if (!g_server) return;
@@ -341,6 +403,12 @@ void ps_server_stop() {
   ::shutdown(g_server->listen_fd, SHUT_RDWR);
   ::close(g_server->listen_fd);
   g_server->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> clock(g_server->conn_mu);
+    for (int cfd : g_server->conn_fds) ::shutdown(cfd, SHUT_RDWR);
+  }
+  for (int i = 0; i < 2000 && g_server->live_conns.load() > 0; ++i)
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
   g_server = nullptr;
 }
 

@@ -276,9 +276,36 @@ def _bwd(causal, block_q, block_k, res, do):
     delta = compute_delta(do, o)
     bq = _pick_block(q.shape[1], block_q)
     bk = _pick_block(k.shape[1], block_k)
+    if _use_fused_bwd(q.shape[1] // bq, k.shape[1] // bk, q.shape[1], q.shape[2]):
+        return fused_bwd_call(
+            q, k, v, do, lse, delta, causal=causal, block_q=bq, block_k=bk
+        )
     dq = dq_call(q, k, v, do, lse, delta, causal=causal, block_q=bq, block_k=bk)
     dk, dv = dkv_call(q, k, v, do, lse, delta, causal=causal, block_q=bq, block_k=bk)
     return dq, dk, dv
+
+
+#: Fused-backward dispatch override: None = auto (the nq/nk >= 4 regime the
+#: r3 expected-value analysis funds — BASELINE.md), True/False = force.
+_FUSED_BWD_OVERRIDE: bool | None = None
+
+#: Upper bound on the fused kernel's [tq, d] f32 dq accumulator (VMEM
+#: scratch).  8 MB = T=16384 at head_dim 128 — beyond that the split
+#: kernels take over (VMEM is ~tens of MB and the s/p tiles need most of
+#: it).
+_FUSED_MAX_ACC_BYTES = 8 * 1024 * 1024
+
+
+def _use_fused_bwd(nq: int, nk: int, tq: int, d: int) -> bool:
+    """The fused dq+dk+dv kernel removes the split kernels' s/p recompute
+    (2 of 7 block matmuls, half the exp2) at the cost of a [tq, d] f32
+    VMEM accumulator and nk running dq flushes; it starts paying at
+    nq/nk >= 4 — exactly the long-context (T >= 4k per shard at 1024
+    tiles) regime the r3 analysis funds.  The T=2048 flagship (nk=2)
+    keeps the split kernels."""
+    if _FUSED_BWD_OVERRIDE is not None:
+        return _FUSED_BWD_OVERRIDE
+    return nq >= 4 and nk >= 4 and tq * d * 4 <= _FUSED_MAX_ACC_BYTES
 
 
 def compute_delta(do, o):
@@ -353,6 +380,123 @@ def dkv_call(q, k, v, do, lse, delta, *, causal, block_q, block_k, out_dtype=Non
         compiler_params=_params(),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
+
+
+def _fused_bwd_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dk_ref, dv_ref, dq_acc, dk_sc, dv_sc, *, scale, causal, bq, bk
+):
+    """dq+dk+dv from ONE s/p computation per (q, k) block pair (the split
+    kernels compute s and do.v^T twice each — 7 block matmuls vs 5 here,
+    and the exp2 softmax recompute twice vs once).
+
+    Layout: grid (bh, k blocks, q blocks) with q innermost — dk/dv
+    accumulate in [bk, d] VMEM scratch across the inner loop (written on
+    its last step), while dq accumulates in a FULL-LENGTH [tq, d] f32
+    scratch that persists across the whole grid.  Every step stores the
+    RUNNING dq value of its q block to the output window: Pallas flushes
+    the window once per step, earlier (incomplete) flushes are overwritten
+    sequentially, and the LAST flush of each window — at the final k
+    iteration — carries the completed sum.  No aliasing, no cross-step
+    output reads: only documented Pallas semantics, so interpret mode and
+    Mosaic agree (the r3-parked alias design did not — interpret re-reads
+    pristine input on every visit).  Net HBM traffic is BELOW the split
+    kernels' (nk bf16 dq flushes replace a full second operand pass), so
+    the 7->5 matmul saving is pure win; the full-length accumulator is
+    what gates dispatch via _FUSED_MAX_ACC_BYTES (VMEM)."""
+    kj, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+    rows = pl.ds(qi * bq, bq)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    def _step(masked: bool):
+        def _compute():
+            q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+            lse2 = lse_ref[0] * LOG2E
+            delta = delta_ref[0]
+            s = _dot_nt(q, k)  # base-2 logits (q pre-scaled)
+            if masked:
+                s = _mask(s, qi, kj, bq, bk)
+            p = jnp.exp2(s - lse2)
+            if masked:
+                p = p * (s > NEG_INF / 2)
+            dv_sc[:] = dv_sc[:] + _dot_tn(p, do)
+            ds = p * (_dot_nt(do, v) - delta)
+            dk_sc[:] = dk_sc[:] + _dot_tn(ds, q)
+            contrib = _dot(ds, k) * scale
+            # kj == 0 is visible from every q block (causal or not), so
+            # the first visit (re)initialises this b's accumulator slice
+            # (stale values from the previous b never leak).
+            dq_acc[rows, :] = jnp.where(
+                kj == 0, contrib, dq_acc[rows, :] + contrib
+            )
+
+        return _compute
+
+    _causal_dispatch(_step, causal, qi, kj, bq, bk)
+
+    # Store the RUNNING value every step (the window flushes regardless;
+    # an unwritten buffer would flush garbage).  The last flush per q
+    # block — at kj = nk-1 — is the complete sum.
+    dq_ref[0] = dq_acc[rows, :].astype(dq_ref.dtype)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = (dk_sc[:] * (1.0 / LOG2E)).astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def fused_bwd_call(q, k, v, do, lse, delta, *, causal, block_q, block_k, out_dtype=None):
+    """(dq, dk, dv) for one (q x k/v) pairing via the fused kernel (same
+    contract as dq_call + dkv_call; ``out_dtype`` = f32 for ring
+    partials).  Dispatch via ``_use_fused_bwd`` — the [tq, d] f32 dq
+    accumulator lives in VMEM."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    bq, bk = min(block_q, tq), min(block_k, tk)
+    qs = q * jnp.asarray(scale * LOG2E, q.dtype)  # base-2 fold (see _fwd)
+
+    return pl.pallas_call(
+        functools.partial(
+            _fused_bwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk
+        ),
+        grid=(bh, tk // bk, tq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # q
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # k
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # v
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # do
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),  # lse
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # dq
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # dk
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # dv
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, out_dtype or q.dtype),
+            jax.ShapeDtypeStruct(k.shape, out_dtype or k.dtype),
+            jax.ShapeDtypeStruct(v.shape, out_dtype or v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tq, d), jnp.float32),  # full-length dq accumulator
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        # Unlike the split kernels, BOTH k and q grid dims carry loop state
+        # (dq_acc accumulates across kj with kj==0 as its reinit; dk/dv
+        # scratch across qi) — only the batch*heads dim may be partitioned.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")
+        ),
+        interpret=_interpret(),
+    )(qs, k, v, do, lse, delta)
 
 
 def fwd_call(q, k, v, *, causal, block_q, block_k, out_dtype=None):

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -86,6 +87,12 @@ def _group(n: int, want: int, shards: int = 1) -> int:
         g -= 1
     if g > 1 or n % shards == 0:
         return g
+    warnings.warn(
+        f"moe: no routing-group size <= {want} splits {n} tokens into a "
+        f"multiple of {shards} shards; groups will straddle shard "
+        "boundaries and the dispatch may lower to all-gather instead of "
+        "all_to_all (pad batch*seq to a multiple of data*expert shards)."
+    )
     return largest_divisor(n, want)
 
 
@@ -121,6 +128,12 @@ def apply(p, x, moe: MoEConfig, *, dtype=None, mesh=None):
         tok = jax.lax.with_sharding_constraint(
             tok,
             jax.sharding.NamedSharding(mesh, P(("data", "expert"), None, None)),
+        )
+    elif mesh is not None and shards > 1:
+        warnings.warn(
+            f"moe: group count {G} is not a multiple of the {shards} token "
+            "shards; skipping the ('data','expert') token pin — the "
+            "dispatch may not lower to all_to_all at this shape."
         )
 
     logits = jnp.einsum("gnd,de->gne", tok.astype(jnp.float32), p["router"]["kernel"])
@@ -192,6 +205,13 @@ def _constrain_expert(t, mesh):
     if mesh is None or mesh.shape.get("expert", 1) <= 1:
         return t
     g_entry = "data" if t.shape[1] % mesh.shape.get("data", 1) == 0 else None
+    if g_entry is None and mesh.shape.get("data", 1) > 1:
+        warnings.warn(
+            f"moe: group dim {t.shape[1]} does not divide the data axis "
+            f"({mesh.shape.get('data', 1)}); dropping the group entry from "
+            "the expert buffers' sharding — capacity buffers replicate over "
+            "'data' at this shape."
+        )
     return jax.lax.with_sharding_constraint(
         t, jax.sharding.NamedSharding(mesh, P("expert", g_entry, None, None))
     )

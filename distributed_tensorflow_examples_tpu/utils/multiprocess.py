@@ -73,6 +73,13 @@ class MultiProcessRunner:
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         )
         header = _WORKER_PRELUDE.format(repo_root=repo_root) if prelude else (
+            # Even without the dist.initialize() prelude, tasks must pin the
+            # CPU platform via jax.config — under the axon TPU tunnel the
+            # JAX_PLATFORMS env var alone is overridden by the plugin's
+            # registration hook, and a fake-cluster task that touches the
+            # single real TPU serializes (or hangs) on the tunnel.
+            "import jax\n"
+            'jax.config.update("jax_platforms", "cpu")\n'
             f"import sys\nsys.path.insert(0, {repo_root!r})\n"
         )
         script = header + worker_src

@@ -55,3 +55,67 @@ def test_flash_jits():
     f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, block_q=16, block_k=16))
     out = f(q, k, v)
     assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_bwd_matches_split_kernels_and_reference(causal, monkeypatch):
+    """r4 fused dq+dk+dv kernel (one s/p compute per block pair, dq
+    accumulated in a full-length VMEM scratch with running flushes): grads
+    must match BOTH the split dq/dkv kernels and the dense mha reference,
+    at a shape in its nq/nk >= 4 dispatch regime."""
+    from distributed_tensorflow_examples_tpu.ops import flash_attention as F
+
+    q, k, v = _qkv(b=1, h=2, t=128, d=8, seed=3)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(
+            fn(q, k, v, causal=causal, block_q=16, block_k=16) ** 2
+        )
+
+    monkeypatch.setattr(F, "_FUSED_BWD_OVERRIDE", True)
+    g_fused = jax.grad(loss(F.flash_attention), argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setattr(F, "_FUSED_BWD_OVERRIDE", False)
+    g_split = jax.grad(loss(F.flash_attention), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(A.mha(q, k, v, causal=causal) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for gf, gs, gr in zip(g_fused, g_split, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gs), rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr), rtol=2e-4, atol=2e-4)
+
+
+def test_fused_bwd_deterministic(monkeypatch):
+    """Two identical fused-backward runs must agree BITWISE.  Off-TPU this
+    exercises interpret mode (sequential, so it cannot catch hardware
+    races); ON TPU — where the benches run it — run-to-run jitter here
+    would expose a Mosaic pipelining/ordering bug in the running-flush dq
+    scheme.  The hardware-meaningful run is the bench-day TPU pass
+    (BASELINE.md records it)."""
+    from distributed_tensorflow_examples_tpu.ops import flash_attention as F
+
+    monkeypatch.setattr(F, "_FUSED_BWD_OVERRIDE", True)
+    q, k, v = _qkv(b=1, h=4, t=256, d=16, seed=7)
+    grad = jax.jit(
+        jax.grad(
+            lambda q, k, v: jnp.sum(
+                F.flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+            ),
+            argnums=(0, 1, 2),
+        )
+    )
+    a = grad(q, k, v)
+    b = grad(q, k, v)
+    for x, y in zip(a, b):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_fused_bwd_dispatch_gate():
+    from distributed_tensorflow_examples_tpu.ops.flash_attention import _use_fused_bwd
+
+    assert _use_fused_bwd(4, 4, 4096, 128)
+    assert _use_fused_bwd(16, 16, 16384, 128)
+    assert not _use_fused_bwd(2, 2, 2048, 128)   # T=2048 flagship @1024 tiles
+    assert not _use_fused_bwd(8, 2, 8192, 128)
+    # VMEM cap on the [tq, d] accumulator: T=32768 @ d=128 stays split.
+    assert not _use_fused_bwd(32, 32, 32768, 128)

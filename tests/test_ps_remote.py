@@ -52,7 +52,19 @@ def loss_fn(params, model_state, batch, rng):
 
 def batches(seed):
     r = np.random.default_rng(seed)
+    # Optional pacing so a kill-mid-run test stays mid-run on ANY host
+    # speed (a fast box otherwise finishes every step before the signal);
+    # the 5th batch drops a progress marker so the test can wait until
+    # this worker has demonstrably pushed gradients before killing it.
+    delay = float(os.environ.get("DTX_PS_STEP_DELAY", "0"))
+    n = 0
     while True:
+        if delay:
+            time.sleep(delay)
+        n += 1
+        if n == 5:
+            with open(os.path.join(d, "progress_%d" % seed), "w") as f:
+                f.write("x")
         x = r.normal(size=(32, dim)).astype(np.float32)
         yield {"x": x, "y": x @ W_TRUE}
 
@@ -93,7 +105,13 @@ else:
 """
 
 
-def _run(mode: str, steps: int, *, kill_after: float | None = None):
+def _run(
+    mode: str,
+    steps: int,
+    *,
+    kill_after: float | None = None,
+    step_delay: float = 0.0,
+):
     d = tempfile.mkdtemp(prefix="dtx_psr_")
     r = MultiProcessRunner(
         3,
@@ -102,18 +120,23 @@ def _run(mode: str, steps: int, *, kill_after: float | None = None):
             "DTX_PS_MODE": mode,
             "DTX_PS_DIR": d,
             "DTX_PS_STEPS": str(steps),
+            "DTX_PS_STEP_DELAY": str(step_delay),
         },
         timeout=300.0,
         prelude=False,
     )
     r.start()
     if kill_after is not None:
-        # Let the run get going (port published + some steps), then SIGKILL
-        # one worker mid-run.
-        port = os.path.join(d, "port")
+        # Kill only after task 2 has DEMONSTRABLY pushed gradients (its
+        # 5th batch drops a progress marker) — a fixed post-port sleep
+        # could land before the worker's first push on a loaded host,
+        # silently degrading the "chief survives a mid-run death" guard
+        # to a pre-first-push kill.
+        marker = os.path.join(d, "progress_2")
         deadline = time.time() + 120
-        while not os.path.exists(port) and time.time() < deadline:
+        while not os.path.exists(marker) and time.time() < deadline:
             time.sleep(0.2)
+        assert os.path.exists(marker), "worker 2 never reached step 5"
         time.sleep(kill_after)
         r.kill_task(2)
     codes = r.join()
@@ -155,8 +178,13 @@ def test_sync_replicas_survives_worker_kill():
     """SIGKILL one of two workers mid-run: with replicas_to_aggregate=1 the
     chief keeps aggregating from the survivor and reaches the step target
     (the reference's crash-tolerant PS behavior — dead workers just stop
-    pushing; SURVEY.md sections 3.1/5.3)."""
-    codes, outs = _run("sync_replicas", steps=150, kill_after=3.0)
+    pushing; SURVEY.md sections 3.1/5.3).  Workers are paced at 20 ms/step
+    so 150 steps take >= 3 s on any host and the kill at 1 s is
+    deterministically mid-run (an unpaced fast box finished all steps
+    before the signal, and the 'killed worker died' assertion saw rc=0)."""
+    codes, outs = _run(
+        "sync_replicas", steps=150, kill_after=1.0, step_delay=0.02
+    )
     assert codes[0] == 0, outs[0][-2000:]
     assert codes[2] != 0  # the killed worker died
     assert "CHIEF_DONE step=150" in outs[0], outs[0][-2000:]
