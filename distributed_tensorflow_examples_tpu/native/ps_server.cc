@@ -358,9 +358,12 @@ void accept_loop(Server* s) {
 
 extern "C" {
 
-// Starts the server on 127.0.0.1:<port> (0 = ephemeral); returns the bound
-// port, or -1 on failure.  One server per process.
-int ps_server_start(int port) {
+// Starts the server on <port> (0 = ephemeral); returns the bound port, or
+// -1 on failure.  One server per process.  ``loopback_only`` != 0 binds
+// 127.0.0.1 (the default, and the only safe choice on shared hosts — the
+// protocol is unauthenticated, like the reference's in-cluster gRPC);
+// 0 binds all interfaces for a multi-host PS cluster on a trusted network.
+int ps_server_start(int port, int loopback_only) {
   std::lock_guard<std::mutex> lock(g_server_mu);
   if (g_server) return -1;
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
@@ -369,7 +372,7 @@ int ps_server_start(int port) {
   setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_addr.s_addr = htonl(loopback_only ? INADDR_LOOPBACK : INADDR_ANY);
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
       ::listen(fd, 64) != 0) {

@@ -331,13 +331,27 @@ class RemotePSChief(AsyncPSTrainer):
     publishes parameter snapshots to the param store after every applied
     update, and runs the chief loop.  Workers are SEPARATE PROCESSES running
     :func:`remote_worker_loop`; thread mode (AsyncPSTrainer) stays the CI
-    default."""
+    default.
 
-    def __init__(self, cfg, loss_fn, optimizer, init_params, *, port: int = 0, **kw):
+    ``ps_addr``: connect to an EXTERNAL PS service (a ``--job_name=ps``
+    process running :func:`host_ps_task`) instead of hosting in-process —
+    the reference's dedicated-PS-task topology; the chief then signals
+    ``ps_shutdown`` when training ends so the PS process exits 0."""
+
+    def __init__(
+        self, cfg, loss_fn, optimizer, init_params, *,
+        port: int = 0, ps_addr: tuple[str, int] | None = None, **kw,
+    ):
         from . import ps_service
 
-        self.port = ps_service.start_server(port)
-        self._client = ps_service.PSClient("127.0.0.1", self.port)
+        if ps_addr is not None:
+            self.port = ps_addr[1]
+            self._client = ps_service.PSClient(ps_addr[0], ps_addr[1])
+            self._owns_server = False
+        else:
+            self.port = ps_service.start_server(port)
+            self._client = ps_service.PSClient("127.0.0.1", self.port)
+            self._owns_server = True
         super().__init__(cfg, loss_fn, optimizer, init_params, **kw)
         total = sum(self._leaf_sizes)
         # Replace the in-process services with their socket proxies, so the
@@ -396,12 +410,53 @@ class RemotePSChief(AsyncPSTrainer):
                 self.total_dropped = -1  # transport gone; counter unknown
         if self.cfg.ckpt_dir:
             self.save_checkpoint()
+        if not self._owns_server:
+            # Dedicated-PS topology: release the external PS task LAST —
+            # after the dropped-counter reads above — so host_ps_task only
+            # tears the service down once nothing will dial it again.
+            try:
+                ps_service.RemoteTokenQueue(self._client, "ps_shutdown").push(0)
+            except Exception:
+                log.exception("ps_shutdown signal failed (ps already down?)")
         log.info(
             "remote async-PS chief done: %d applied steps, %d stale drops",
             self.global_step,
             self.total_dropped,
         )
         return self.params
+
+
+def host_ps_task(port: int, *, loopback_only: bool = True) -> int:
+    """Dedicated PS-task body (``--job_name=ps`` under cross-process PS
+    emulation): host the C++ state service on ``port`` and block until the
+    chief signals ``ps_shutdown`` (the analog of ``server.join()``, except
+    it RETURNS when training ends instead of blocking forever).  Returns
+    the bound port.  ``loopback_only=False`` serves other hosts (trusted
+    networks only — see ps_service.start_server)."""
+    import time as _time
+
+    from . import ps_service
+
+    bound = ps_service.start_server(port, loopback_only=loopback_only)
+    log.info("PS task serving on port %d (blocking until chief shutdown)", bound)
+    client = ps_service.PSClient("127.0.0.1", bound)
+    tq = ps_service.RemoteTokenQueue(client, "ps_shutdown")
+    cancelled = 0
+    while True:
+        token = tq.pop()  # blocks; None = a cancel_all broadcast
+        if token is not None:
+            break
+        # cancel_all reaches this queue too (the chief cancels before its
+        # final counter reads); give the real shutdown push a grace window
+        # rather than tearing the service down under the chief.
+        cancelled += 1
+        if cancelled >= 10:
+            log.warning("PS task: repeated cancels without shutdown; exiting")
+            break
+        _time.sleep(0.5)
+    client.close()
+    ps_service.stop_server()
+    return bound
 
 
 def remote_worker_loop(

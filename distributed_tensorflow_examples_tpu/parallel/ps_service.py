@@ -30,14 +30,18 @@ _CANCEL_ALL, _PING = 14, 15
 _PSTORE_GET_OBJ, _PSTORE_SET, _PSTORE_GET = 16, 17, 18
 
 
-def start_server(port: int = 0) -> int:
-    """Start the in-process C++ PS server; returns the bound port."""
+def start_server(port: int = 0, *, loopback_only: bool = True) -> int:
+    """Start the in-process C++ PS server; returns the bound port.
+
+    ``loopback_only=False`` binds all interfaces — required when workers on
+    OTHER hosts dial this PS task (the protocol is unauthenticated, so only
+    do this on a trusted cluster network, as with the reference's gRPC)."""
     lib = native._load()
     import ctypes
 
     lib.ps_server_start.restype = ctypes.c_int
-    lib.ps_server_start.argtypes = [ctypes.c_int]
-    p = lib.ps_server_start(port)
+    lib.ps_server_start.argtypes = [ctypes.c_int, ctypes.c_int]
+    p = lib.ps_server_start(port, 1 if loopback_only else 0)
     if p < 0:
         raise RuntimeError("ps_server_start failed")
     return p

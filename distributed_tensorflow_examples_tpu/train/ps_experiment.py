@@ -56,10 +56,28 @@ def run_ps_emulation(
     that worker's local batches (its data shard; the count is passed so data
     sharding can never diverge from the thread count); ``eval_fn(params)``
     computes final metrics for the FINAL line.
+
+    With ``--job_name=ps|chief|worker`` and ``--ps_hosts`` (the reference's
+    one-process-per-task launch, SURVEY.md sections 3.1/3.2) this process
+    runs ONLY its task's role over the native socket service instead of the
+    in-process thread emulation — see :func:`run_ps_cluster_task`.
     """
     import jax
 
     from ..parallel.async_ps import AsyncPSConfig, AsyncPSTrainer
+    from ..utils.flags import is_cross_process_ps
+
+    if is_cross_process_ps(FLAGS):
+        return run_ps_cluster_task(
+            init_fn=init_fn,
+            loss_fn=loss_fn,
+            optimizer=optimizer,
+            batches_for_worker=batches_for_worker,
+            FLAGS=FLAGS,
+            mode=mode,
+            eval_fn=eval_fn,
+            model_state=model_state,
+        )
 
     n_workers = worker_count(FLAGS)
     r2a = getattr(FLAGS, "replicas_to_aggregate", 0) or n_workers
@@ -76,15 +94,7 @@ def run_ps_emulation(
         n_workers,
         f", replicas_to_aggregate={r2a}" if mode == "sync_replicas" else "",
     )
-    acfg = AsyncPSConfig(
-        num_workers=n_workers,
-        mode=mode,
-        replicas_to_aggregate=r2a,
-        max_staleness=getattr(FLAGS, "max_staleness", None) or None,
-        train_steps=FLAGS.train_steps,
-        ckpt_dir=os.path.join(FLAGS.log_dir, "ps_ckpt") if FLAGS.log_dir else None,
-        checkpoint_every=FLAGS.checkpoint_every_steps,
-    )
+    acfg = _ps_cfg(FLAGS, mode, n_workers)
     params = init_fn(jax.random.key(FLAGS.seed))
     if isinstance(params, tuple):  # init_fn returning (params, model_state)
         params, model_state = params
@@ -108,21 +118,177 @@ def run_ps_emulation(
 
     metrics = eval_fn(final_params) if eval_fn is not None else {}
     sps = trainer.global_step / dt if dt > 0 else 0.0
-    eps_per_chip = sps * local_bs / max(1, len(jax.devices()))
     losses = [l for (_, _, l) in trainer.history] or [float("nan")]
+    _print_final(
+        step=trainer.global_step, dt=dt, local_bs=local_bs, mode=mode,
+        metrics=metrics,
+        eps_per_chip=sps * local_bs / max(1, len(jax.devices())),
+        extra={
+            "stale_dropped": trainer.total_dropped,
+            "first_loss": f"{losses[0]:.4f}",
+            "last_loss": f"{losses[-1]:.4f}",
+        },
+    )
+    return final_params
+
+
+def _print_final(
+    *, step: int, dt: float, local_bs: int, mode: str,
+    metrics: dict, extra: dict, eps_per_chip: float | None = None,
+):
+    """The ONE scrapable FINAL line both PS paths (thread emulation and
+    cross-process cluster) print — same fields, same order."""
+    sps = step / dt if dt > 0 else 0.0
+    if eps_per_chip is None:
+        eps_per_chip = sps * local_bs
     parts = [
-        f"FINAL step={trainer.global_step}",
+        f"FINAL step={step}",
         f"steps_per_sec={sps:.1f}",
         f"examples_per_sec_per_chip={eps_per_chip:.0f}",
         f"mode={mode}",
-        f"stale_dropped={trainer.total_dropped}",
-        f"first_loss={losses[0]:.4f}",
-        f"last_loss={losses[-1]:.4f}",
     ]
+    for k, v in extra.items():
+        parts.append(f"{k}={v}")
     for k, v in metrics.items():
         parts.append(f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}")
     print(" ".join(parts))
-    return final_params
+
+
+def _ps_cfg(FLAGS, mode: str, n_workers: int):
+    from ..parallel.async_ps import AsyncPSConfig
+
+    r2a = getattr(FLAGS, "replicas_to_aggregate", 0) or n_workers
+    return AsyncPSConfig(
+        num_workers=n_workers,
+        mode=mode,
+        replicas_to_aggregate=r2a if mode == "sync_replicas" else None,
+        max_staleness=getattr(FLAGS, "max_staleness", None) or None,
+        train_steps=FLAGS.train_steps,
+        ckpt_dir=os.path.join(FLAGS.log_dir, "ps_ckpt") if FLAGS.log_dir else None,
+        checkpoint_every=FLAGS.checkpoint_every_steps,
+    )
+
+
+def _probe_ps(host: str, port: int, deadline_s: float) -> bool:
+    """True when a PS service answers PING at host:port within the window."""
+    from ..parallel import ps_service
+
+    t_end = time.time() + deadline_s
+    while time.time() < t_end:
+        try:
+            c = ps_service.PSClient(host, port, timeout_s=2.0)
+            c.ping()
+            c.close()
+            return True
+        except OSError:
+            time.sleep(0.2)
+    return False
+
+
+def run_ps_cluster_task(
+    *, init_fn, loss_fn, optimizer, batches_for_worker, FLAGS, mode, eval_fn=None,
+    model_state=None,
+):
+    """One task of the reference's multi-process PS cluster (its defining
+    launch pattern — one process per ``--job_name``/``--task_index``,
+    SURVEY.md sections 3.1/3.2), over the native socket service:
+
+    - ``ps``:     hosts the C++ state service at ``--ps_hosts[task_index]``
+                  until the chief signals shutdown (``server.join()`` role).
+                  The coordination state lives on entry 0; further PS tasks
+                  are accepted for launch-script parity but stay idle (the
+                  MODEL variables need no PS spreading — they live in mesh
+                  HBM; only coordination state crosses processes).
+    - ``chief``:  aggregation/apply/publish loop (``RemotePSChief``).
+                  Topology is DETERMINISTIC, not probed: with
+                  ``--ps_tasks=0`` the chief hosts the service in-process
+                  (3-process minimum launch); otherwise a dedicated PS task
+                  is expected at ``ps_hosts[0]`` and waited for (120 s).
+    - ``worker``: gradient computation against the published snapshots
+                  (``remote_worker_loop``), data-sharded by ``task_index``.
+
+    Launch recipe: RUNBOOK.md "Cross-process PS".
+    """
+    import jax
+
+    from ..parallel import async_ps
+
+    entries = FLAGS.ps_hosts.split(",")
+    host, port_s = entries[0].rsplit(":", 1)
+    port = int(port_s)
+    n_workers = worker_count(FLAGS)
+    local_bs = max(1, FLAGS.batch_size // n_workers)
+    acfg = _ps_cfg(FLAGS, mode, n_workers)
+    job = FLAGS.job_name
+    chief_hosts_service = FLAGS.ps_tasks == 0
+
+    if job == "ps":
+        if chief_hosts_service:
+            raise ValueError(
+                "--job_name=ps contradicts --ps_tasks=0 (chief hosts the "
+                "service); launch without the PS task or drop --ps_tasks=0"
+            )
+        my_host, my_port = entries[
+            min(FLAGS.task_index, len(entries) - 1)
+        ].rsplit(":", 1)
+        bound = async_ps.host_ps_task(
+            int(my_port), loopback_only=my_host in ("127.0.0.1", "localhost")
+        )
+        print(f"PS_DONE port={bound}")
+        return None
+
+    if job == "chief":
+        params = init_fn(jax.random.key(FLAGS.seed))
+        if isinstance(params, tuple):
+            params, model_state = params
+        if not chief_hosts_service and not _probe_ps(host, port, 120.0):
+            raise ConnectionError(
+                f"no PS task answered at {host}:{port} after 120 s "
+                "(launch the --job_name=ps process first, or pass "
+                "--ps_tasks=0 to host the service in the chief)"
+            )
+        log.info(
+            "PS cluster chief: mode=%s %d workers, service %s:%d (%s)",
+            mode, n_workers, host, port,
+            "hosted in-process" if chief_hosts_service else "external PS task",
+        )
+        trainer = async_ps.RemotePSChief(
+            acfg, loss_fn, optimizer, params,
+            model_state=model_state,
+            rng=jax.random.key(FLAGS.seed),
+            **({"port": port} if chief_hosts_service else {"ps_addr": (host, port)}),
+        )
+        t0 = time.perf_counter()
+        final_params = trainer.run_chief()
+        dt = time.perf_counter() - t0
+        metrics = eval_fn(final_params) if eval_fn is not None else {}
+        _print_final(
+            step=trainer.global_step, dt=dt, local_bs=local_bs,
+            mode=f"{mode}_cluster", metrics=metrics,
+            extra={"workers": n_workers, "stale_dropped": trainer.total_dropped},
+        )
+        return final_params
+
+    # job == "worker"
+    wid = FLAGS.task_index
+    if not _probe_ps(host, port, 120.0):
+        raise ConnectionError(f"no PS service at {host}:{port} after 120 s")
+
+    def struct_init(rng):
+        p = init_fn(rng)
+        return p[0] if isinstance(p, tuple) else p
+
+    n = async_ps.remote_worker_loop(
+        host, port, wid,
+        cfg=acfg,
+        loss_fn=loss_fn,
+        init_fn=struct_init,
+        batches=iter(batches_for_worker(wid, local_bs, n_workers)),
+        model_state=model_state,
+        rng=jax.random.key(FLAGS.seed),
+    )
+    print(f"WORKER_DONE task={wid} contributed={n}")
+    return None
 
 
 def array_eval_fn(apply_logits: Callable, test: dict[str, np.ndarray], batch_size: int):

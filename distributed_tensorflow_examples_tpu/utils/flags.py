@@ -65,6 +65,13 @@ def define_training_flags(default_batch_size: int = 128, default_steps: int = 10
     )
     _define("bool", "profile", False, "Capture a jax.profiler trace window.")
     _define(
+        "string",
+        "platform",
+        "",
+        'Force the JAX platform (e.g. "cpu") — needed for CPU fake-cluster '
+        "runs on hosts whose TPU plugin overrides the JAX_PLATFORMS env var.",
+    )
+    _define(
         "bool",
         "zero_opt",
         False,
@@ -117,6 +124,15 @@ def define_legacy_cluster_flags():
     )
     _define(
         "integer",
+        "ps_tasks",
+        -1,
+        "Cross-process PS launch: number of dedicated --job_name=ps "
+        "processes in the cluster (-1 = one per --ps_hosts entry, the "
+        "reference convention; 0 = no PS task, the chief hosts the state "
+        "service in-process).",
+    )
+    _define(
+        "integer",
         "replicas_to_aggregate",
         0,
         "(legacy, sync_replicas) gradients to aggregate per update; 0 = "
@@ -131,27 +147,61 @@ def define_legacy_cluster_flags():
     )
 
 
+def is_cross_process_ps(FLAGS) -> bool:
+    """True when the CLI requests the reference's one-process-per-task PS
+    launch (SURVEY.md sections 3.1/3.2): a PS-emulation mode is selected,
+    a PS service address is given, and this process was assigned a task
+    role.  In that topology ``--ps_hosts`` is MEANINGFUL — it is where the
+    native state service (native/ps_server.cc) listens."""
+    return (
+        getattr(FLAGS, "job_name", "") in ("chief", "worker", "ps")
+        and bool(getattr(FLAGS, "ps_hosts", ""))
+        and (getattr(FLAGS, "ps_emulation", False) or not getattr(FLAGS, "sync_replicas", True))
+    )
+
+
 def resolve_legacy_cluster(FLAGS) -> dict:
     """Interpret legacy cluster flags against the mesh world; returns info for
     the example to log.  A process launched as a PS task has no role in SPMD:
-    we exit 0 immediately (the analog of ``server.join()`` never being needed).
-    """
+    we exit 0 immediately (the analog of ``server.join()`` never being
+    needed) — UNLESS cross-process PS emulation is active, where the PS
+    task hosts the native state service for real (is_cross_process_ps).
+
+    Also applies ``--platform`` (must run before first backend use)."""
+    if getattr(FLAGS, "platform", ""):
+        import jax
+
+        jax.config.update("jax_platforms", FLAGS.platform)
     info = {}
+    cross = is_cross_process_ps(FLAGS)
     if getattr(FLAGS, "ps_hosts", ""):
         info["ps_hosts"] = FLAGS.ps_hosts.split(",")
-        log.warning(
-            "--ps_hosts given: parameter servers are obsolete on TPU — "
-            "variables are mesh-sharded in HBM (replica_device_setter -> "
-            "sharding rules). Ignoring %d PS hosts.",
-            len(info["ps_hosts"]),
-        )
+        if cross:
+            log.info(
+                "--ps_hosts given with cross-process PS emulation: the "
+                "native state service (gradients/tokens/param snapshots) "
+                "serves at %s.",
+                info["ps_hosts"][0],
+            )
+        else:
+            log.warning(
+                "--ps_hosts given: parameter servers are obsolete on TPU — "
+                "variables are mesh-sharded in HBM (replica_device_setter -> "
+                "sharding rules). Ignoring %d PS hosts.",
+                len(info["ps_hosts"]),
+            )
     if getattr(FLAGS, "worker_hosts", ""):
         info["worker_hosts"] = FLAGS.worker_hosts.split(",")
         log.info(
-            "--worker_hosts given (%d workers): on TPU the equivalent "
-            "data-parallel degree comes from the mesh; launch one process "
-            "per host with jax.distributed (see parallel.dist).",
+            "--worker_hosts given (%d workers): %s",
             len(info["worker_hosts"]),
+            "cross-process PS emulation — one worker process per entry"
+            if cross
+            else "on TPU the equivalent data-parallel degree comes from the "
+            "mesh; launch one process per host with jax.distributed (see "
+            "parallel.dist).",
         )
-    info["is_legacy_ps_process"] = getattr(FLAGS, "job_name", "") == "ps"
+    info["is_legacy_ps_process"] = (
+        getattr(FLAGS, "job_name", "") == "ps" and not cross
+    )
     return info

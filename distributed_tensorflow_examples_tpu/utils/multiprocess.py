@@ -61,10 +61,19 @@ class MultiProcessRunner:
         env: dict[str, str] | None = None,
         timeout: float = 120.0,
         prelude: bool = True,
+        pin_cpu: bool = True,
     ):
         """``prelude=False`` skips the ``dist.initialize()`` header: the task
         script manages (or delegates) cluster bootstrap itself — e.g. a
-        supervisor task whose *child* joins the coordination service."""
+        supervisor task whose *child* joins the coordination service.
+
+        ``pin_cpu`` (default): every task pins the CPU platform via
+        ``jax.config`` before the task body runs — this runner IS the fake
+        localhost cluster (SURVEY.md section 4), and under the axon TPU
+        tunnel the JAX_PLATFORMS env var alone is overridden by the
+        plugin's registration hook (tasks would serialize, or hang, on the
+        single real chip).  Pass ``pin_cpu=False`` for a task that must
+        see real accelerators."""
         self.n = num_processes
         self.timeout = timeout
         self.port = _free_port()
@@ -72,15 +81,13 @@ class MultiProcessRunner:
         repo_root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         )
+        pin = (
+            'import jax\njax.config.update("jax_platforms", "cpu")\n'
+            if pin_cpu
+            else ""
+        )
         header = _WORKER_PRELUDE.format(repo_root=repo_root) if prelude else (
-            # Even without the dist.initialize() prelude, tasks must pin the
-            # CPU platform via jax.config — under the axon TPU tunnel the
-            # JAX_PLATFORMS env var alone is overridden by the plugin's
-            # registration hook, and a fake-cluster task that touches the
-            # single real TPU serializes (or hangs) on the tunnel.
-            "import jax\n"
-            'jax.config.update("jax_platforms", "cpu")\n'
-            f"import sys\nsys.path.insert(0, {repo_root!r})\n"
+            pin + f"import sys\nsys.path.insert(0, {repo_root!r})\n"
         )
         script = header + worker_src
         self.script_path = os.path.join(self._dir, "worker.py")
