@@ -252,14 +252,22 @@ def _block(cfg: Config, p, h, *, mesh, constrain, allow_custom_attn=True):
 
     aux = jnp.float32(0.0)
     if "moe" in p:
-        from ..ops import moe as moe_ops
-
-        y = _layernorm(p["ln2"], h)
-        y, aux = moe_ops.apply(p["moe"], y, _moe_cfg(cfg), dtype=cfg.dtype, mesh=mesh)
-        h = constrain(h + y, P(da, "seq", None))
+        h, aux = _moe_tail(cfg, p, h, constrain, mesh)
     else:
         h = _mlp_tail(cfg, p, h, constrain)
     return h, aux
+
+
+def _moe_tail(cfg: Config, p, h, constrain, mesh):
+    """ln2 -> GShard MoE FFN -> residual.  Shared by the training block and
+    the KV-cache decode block so the two paths cannot drift (decode's
+    ``constrain`` maps the 'seq' entry to None and discards the aux
+    loss)."""
+    from ..ops import moe as moe_ops
+
+    y = _layernorm(p["ln2"], h)
+    y, aux = moe_ops.apply(p["moe"], y, _moe_cfg(cfg), dtype=cfg.dtype, mesh=mesh)
+    return constrain(h + y, P(cfg.data_axes, "seq", None)), aux
 
 
 def _mlp_tail(cfg: Config, p, h, constrain):
@@ -387,7 +395,7 @@ def init_cache(cfg: Config, batch: int, max_len: int, *, mesh: Mesh | None = Non
         # Born sharded: zeros created UNDER jit with out_shardings, so the
         # full replicated cache never materialises on one device (a model
         # whose cache only fits sharded must not OOM in its own init).
-        sh = jax.sharding.NamedSharding(mesh, P("data", "model", None, None))
+        sh = jax.sharding.NamedSharding(mesh, P(cfg.data_axes, "model", None, None))
         one = jax.jit(
             lambda: jnp.zeros(shape, cfg.dtype), out_shardings=sh
         )
@@ -396,24 +404,32 @@ def init_cache(cfg: Config, batch: int, max_len: int, *, mesh: Mesh | None = Non
     }
 
 
-def _block_decode(cfg: Config, p, h, layer_cache, pos, *, constrain):
+def _block_decode(cfg: Config, p, h, layer_cache, pos, *, constrain, mesh=None):
     """One block for ONE new token: h [B, 1, D], cache updated at ``pos``.
 
     Static shapes throughout (cache is max_len long, masked beyond ``pos``)
     so the jitted step never recompiles as decoding advances.  ``constrain``
     pins activations/cache to the decode shardings (heads on 'model', batch
-    on 'data'; the T=1 dim never touches 'seq') — identity without a mesh.
-    """
+    on the data axes — ('data','expert') for MoE; the T=1 dim never touches
+    'seq') — identity without a mesh.
+
+    MoE blocks route their single position through the SAME GShard
+    dispatch/combine einsums as training (ops/moe.py; aux loss unused at
+    inference).  Decode capacity is per-step — with only B tokens in
+    flight nothing realistically drops, whereas a training forward at full
+    T may drop overflow tokens; per-position parity therefore holds
+    whenever training capacity is not exceeded (tested)."""
     B = h.shape[0]
+    da = cfg.data_axes
     y = _layernorm(p["ln1"], h)
     qkv = layers.dense(p["qkv"], y, dtype=cfg.dtype)
     qkv = qkv.reshape(B, 1, cfg.n_heads, 3, cfg.head_dim)
     q, k, v = [jnp.moveaxis(qkv[:, :, :, j], 2, 1) for j in range(3)]  # [B,H,1,hd]
-    q = constrain(q, P("data", "model", None, None))
+    q = constrain(q, P(da, "model", None, None))
     ck = jax.lax.dynamic_update_slice(layer_cache["k"], k, (0, 0, pos, 0))
     cv = jax.lax.dynamic_update_slice(layer_cache["v"], v, (0, 0, pos, 0))
-    ck = constrain(ck, P("data", "model", None, None))
-    cv = constrain(cv, P("data", "model", None, None))
+    ck = constrain(ck, P(da, "model", None, None))
+    cv = constrain(cv, P(da, "model", None, None))
     s = jnp.einsum(
         "bhqd,bhtd->bhqt", q, ck, preferred_element_type=jnp.float32
     ) / math.sqrt(cfg.head_dim)
@@ -423,8 +439,11 @@ def _block_decode(cfg: Config, p, h, layer_cache, pos, *, constrain):
     o = jnp.einsum("bhqt,bhtd->bhqd", w, cv)
     o = jnp.moveaxis(o, 1, 2).reshape(B, 1, cfg.dim)
     h = h + layers.dense(p["proj"], o, dtype=cfg.dtype)
-    h = constrain(h, P("data", None, None))
-    h = _mlp_tail(cfg, p, h, constrain)
+    h = constrain(h, P(da, None, None))
+    if "moe" in p:
+        h, _ = _moe_tail(cfg, p, h, constrain, mesh)
+    else:
+        h = _mlp_tail(cfg, p, h, constrain)
     return h, {"k": ck, "v": cv}
 
 
@@ -450,20 +469,28 @@ def decode_step(cfg: Config, params, cache, token, pos, *, mesh: Mesh | None = N
     With ``mesh``: runs TP-sharded — KV cache and attention heads on the
     'model' axis, Megatron dense sharding via the weight shardings +
     constraints (per-position parity with the replicated path is tested).
+    MoE models decode through the same GShard dispatch as training on a
+    data x expert mesh (batch over ``cfg.data_axes``, expert FFN weights
+    staying on their ranks); only pipelined models remain out of scope
+    (a pipelined decode would bubble O(stages) per token — serve those
+    with the stages collapsed).
     """
-    if cfg.moe_experts > 0 or cfg.pipeline_stages > 1:
-        raise NotImplementedError("decode supports the dense non-pipelined model")
+    if cfg.pipeline_stages > 1:
+        raise NotImplementedError(
+            "decode supports the non-pipelined model (dense or MoE)"
+        )
     constrain = _decode_constrain(mesh)
+    da = cfg.data_axes
     h = layers.embedding_lookup(params["emb"], token[:, None], dtype=cfg.dtype)
     h = h + jax.lax.dynamic_slice_in_dim(
         params["pos"]["table"], pos, 1, axis=0
     ).astype(cfg.dtype)[None]
-    h = constrain(h, P("data", None, None))
+    h = constrain(h, P(da, None, None))
     new_cache = {}
     for i in range(cfg.n_layers):
         h, new_cache[f"block_{i}"] = _block_decode(
             cfg, params[f"block_{i}"], h, cache[f"block_{i}"], pos,
-            constrain=constrain,
+            constrain=constrain, mesh=mesh,
         )
     h = _layernorm(params["ln_f"], h)
     return layers.dense(params["head"], h, dtype=cfg.dtype)[:, 0], new_cache

@@ -88,15 +88,11 @@ def main(argv):
         print("job_name=ps: parameter servers are not needed on TPU; exiting 0.")
         return
     prompt_len = 16
-    sampling = (
-        FLAGS.sample_tokens > 0
-        and FLAGS.pipeline_stages == 1
-        and not FLAGS.moe_experts
-    )
+    sampling = FLAGS.sample_tokens > 0 and FLAGS.pipeline_stages == 1
     if FLAGS.sample_tokens > 0 and not sampling:
         logging.warning(
-            "--sample_tokens ignored: decoding supports the dense "
-            "non-pipelined model (pipeline_stages=1, moe_experts=0)."
+            "--sample_tokens ignored: decoding supports the non-pipelined "
+            "model (dense or MoE; pipeline_stages=1)."
         )
     if sampling and prompt_len + FLAGS.sample_tokens > FLAGS.seq_len:
         # Validate BEFORE training: generate() would raise after the whole
@@ -160,9 +156,10 @@ def main(argv):
         # Inference surface: KV-cache greedy decode from a corpus prompt.
         import numpy as np
 
-        # Batch dim must cover the 'data' axis; decode runs TP-sharded on
-        # the same mesh the model trained on (KV cache heads on 'model').
-        dp = exp.mesh.shape.get("data", 1)
+        # Batch dim must cover the batch shards — ('data','expert') for
+        # MoE; decode runs sharded on the same mesh the model trained on
+        # (KV cache heads on 'model', expert FFNs on their ranks).
+        dp = exp.mesh.shape.get("data", 1) * exp.mesh.shape.get("expert", 1)
         prompt = np.tile(np.asarray(ids[:prompt_len], dtype=np.int32)[None], (dp, 1))
         out = models.transformer.generate(
             cfg, exp.state.params, prompt, max_new_tokens=FLAGS.sample_tokens,
