@@ -14,6 +14,7 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import PartitionSpec as P
 
 
 # ----------------------------------------------------------------------------
@@ -108,15 +109,70 @@ def conv2d(params, x, *, stride=1, padding="SAME", dtype=None):
 # ----------------------------------------------------------------------------
 
 
-def batchnorm_init(c: int):
+def batchnorm_init(c: int, *, ghost_slices: int = 0):
+    """``ghost_slices > 0``: running stats carry a leading per-slice dim
+    [S, C] (sharded P('slice', None) by the model's rules) so their EMA
+    update never crosses the slice boundary — see batchnorm's ghost path."""
     params = {"scale": jnp.ones((c,), jnp.float32), "bias": jnp.zeros((c,), jnp.float32)}
-    stats = {"mean": jnp.zeros((c,), jnp.float32), "var": jnp.ones((c,), jnp.float32)}
+    shape = (ghost_slices, c) if ghost_slices > 0 else (c,)
+    stats = {"mean": jnp.zeros(shape, jnp.float32), "var": jnp.ones(shape, jnp.float32)}
     return params, stats
+
+
+def _batchnorm_ghost(
+    params, stats, x, *, momentum, eps, mesh, relu, ghost_slices: int
+):
+    """Ghost-batch (slice-local) BN statistics for multi-slice meshes.
+
+    Full SyncBN reduces batch statistics over the WHOLE data axis — on a
+    multi-slice deployment that is 2 tiny all-reduces per BN layer
+    CROSSING DCN (98 per ResNet-50 step, the honest caveat in BASELINE.md
+    r3's hybrid table).  Here the batch dim is reshaped [B] -> [S, B/S]
+    with S pinned to the mesh's outermost ('slice') axis, so the
+    statistics reduce runs only over the slice-LOCAL sub-axis of data
+    (rides ICI) and each slice normalises with its own "ghost batch"
+    (batch/S) statistics — the standard mitigation, with the standard
+    statistics change (normalisation noise of a batch/S batch; quantified
+    in tests/test_models.py).  Running stats stay per-slice [S, C]
+    (sharded P('slice', None)) so the EMA update is collective-free;
+    evaluation averages them once.  Result: NO BatchNorm traffic ever
+    touches DCN — only the gradient all-reduce crosses."""
+    S = ghost_slices
+    B = x.shape[0]
+    if B % S:
+        raise ValueError(f"ghost BN: batch {B} not divisible by {S} slices")
+    spec_x = P("slice", "data", *([None] * (x.ndim - 1)))
+
+    def pin(t, spec):
+        if mesh is None:
+            return t
+        return jax.lax.with_sharding_constraint(
+            t, jax.sharding.NamedSharding(mesh, spec)
+        )
+
+    xr = pin(x.reshape(S, B // S, *x.shape[1:]), spec_x)
+    xf = xr.astype(jnp.float32)
+    axes = tuple(range(1, xr.ndim - 1))  # slice-local batch + spatial
+    mean = pin(jnp.mean(xf, axis=axes), P("slice", None))  # [S, C]
+    mean_sq = pin(jnp.mean(jnp.square(xf), axis=axes), P("slice", None))
+    var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
+    new_stats = {
+        "mean": momentum * stats["mean"] + (1 - momentum) * mean,
+        "var": momentum * stats["var"] + (1 - momentum) * var,
+    }
+    bshape = (S,) + (1,) * (x.ndim - 1) + (-1,)
+    inv = lax.rsqrt(var + eps) * params["scale"]
+    y = (xr - mean.reshape(bshape).astype(x.dtype)) * inv.reshape(bshape).astype(
+        x.dtype
+    ) + params["bias"].astype(x.dtype)
+    if relu:
+        y = jax.nn.relu(y)
+    return pin(y.reshape(x.shape), P(("slice", "data"), *([None] * (x.ndim - 1)))), new_stats
 
 
 def batchnorm(
     params, stats, x, *, train: bool, momentum=0.9, eps=1e-5, mesh=None,
-    relu: bool = False,
+    relu: bool = False, ghost_slices: int = 0,
 ):
     """Returns (y, new_stats).  In train mode the batch statistics are
     computed over the *global* batch: under jit with the batch sharded on the
@@ -138,6 +194,11 @@ def batchnorm(
     path the backward then recomputes the mask in-kernel instead of
     materialising the masked gradient (the r3 profile's +29 ms trap);
     semantically identical to relu(batchnorm(x))."""
+    if train and ghost_slices > 0:
+        return _batchnorm_ghost(
+            params, stats, x, momentum=momentum, eps=eps, mesh=mesh,
+            relu=relu, ghost_slices=ghost_slices,
+        )
     if train:
         from ..ops import bn as bn_ops
 
@@ -167,6 +228,18 @@ def batchnorm(
         }
     else:
         mean, var = stats["mean"], stats["var"]
+        if mean.ndim == 2:
+            # Ghost-trained stats [S, C]: evaluation recovers the exact
+            # GLOBAL moments by the law of total variance — mean of the
+            # within-slice variances PLUS the variance of the slice means
+            # (averaging the variances alone systematically undershoots
+            # when slices are not iid).  This is the one cross-slice
+            # reduction, paid at EVAL, not per step.
+            gmean = jnp.mean(mean, axis=0)
+            var = jnp.mean(var, axis=0) + jnp.mean(
+                jnp.square(mean - gmean), axis=0
+            )
+            mean = gmean
         new_stats = stats
     inv = lax.rsqrt(var + eps) * params["scale"]
     y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype) + params["bias"].astype(x.dtype)

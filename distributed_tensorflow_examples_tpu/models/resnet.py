@@ -36,26 +36,33 @@ class Config:
     #: *exactly equivalent* 4x4/s1 conv (kernel re-indexed, see _stem) is
     #: the standard TPU ResNet transform.  "conv7": the literal stem.
     stem: str = "s2d"
+    #: Ghost-batch BN for multi-slice meshes (r4): >0 scopes every BN's
+    #: batch statistics to a slice-local sub-axis of data — the mesh must
+    #: carry an outermost 'slice' axis of this size, and the batch shards
+    #: over ('slice', 'data').  All 98 per-layer statistics reductions
+    #: then ride ICI; only the gradient all-reduce crosses DCN
+    #: (layers._batchnorm_ghost; hybrid evidence in BASELINE.md).
+    bn_ghost_slices: int = 0
 
     @property
     def dtype(self):
         return jnp.dtype(self.compute_dtype)
 
 
-def _bottleneck_init(rng, cin: int, mid: int, *, downsample: bool):
+def _bottleneck_init(rng, cin: int, mid: int, *, downsample: bool, ghost: int = 0):
     """One bottleneck: 1x1 reduce -> 3x3 -> 1x1 expand (+ projection)."""
     cout = 4 * mid
     ks = jax.random.split(rng, 4)
     p, s = {}, {}
     p["conv1"] = layers.conv_init(ks[0], 1, 1, cin, mid, use_bias=False)
-    p["bn1"], s["bn1"] = layers.batchnorm_init(mid)
+    p["bn1"], s["bn1"] = layers.batchnorm_init(mid, ghost_slices=ghost)
     p["conv2"] = layers.conv_init(ks[1], 3, 3, mid, mid, use_bias=False)
-    p["bn2"], s["bn2"] = layers.batchnorm_init(mid)
+    p["bn2"], s["bn2"] = layers.batchnorm_init(mid, ghost_slices=ghost)
     p["conv3"] = layers.conv_init(ks[2], 1, 1, mid, cout, use_bias=False)
-    p["bn3"], s["bn3"] = layers.batchnorm_init(cout)
+    p["bn3"], s["bn3"] = layers.batchnorm_init(cout, ghost_slices=ghost)
     if downsample or cin != cout:
         p["proj"] = layers.conv_init(ks[3], 1, 1, cin, cout, use_bias=False)
-        p["bn_proj"], s["bn_proj"] = layers.batchnorm_init(cout)
+        p["bn_proj"], s["bn_proj"] = layers.batchnorm_init(cout, ghost_slices=ghost)
     return p, s
 
 
@@ -64,7 +71,7 @@ def _bottleneck_apply(cfg, p, s, x, *, stride: int, train: bool, mesh=None):
     shortcut = x
     bn = lambda name, t, relu=False: layers.batchnorm(
         p[name], s[name], t, train=train, momentum=cfg.bn_momentum, mesh=mesh,
-        relu=relu,
+        relu=relu, ghost_slices=cfg.bn_ghost_slices,
     )
     y = layers.conv2d(p["conv1"], x, stride=1, dtype=cfg.dtype)
     y, new_s["bn1"] = bn("bn1", y, relu=True)
@@ -84,14 +91,19 @@ def init(cfg: Config, rng: jax.Array, *, in_channels: int = 3):
     params: dict = {}
     state: dict = {}
     params["stem"] = layers.conv_init(rngs[0], 7, 7, in_channels, cfg.width, use_bias=False)
-    params["bn_stem"], state["bn_stem"] = layers.batchnorm_init(cfg.width)
+    params["bn_stem"], state["bn_stem"] = layers.batchnorm_init(
+        cfg.width, ghost_slices=cfg.bn_ghost_slices
+    )
     cin = cfg.width
     k = 1
     for stage, n_blocks in enumerate(cfg.stage_sizes):
         mid = cfg.width * (2 ** stage)
         for block in range(n_blocks):
             down = stage > 0 and block == 0
-            p, s = _bottleneck_init(rngs[k], cin, mid, downsample=down or cin != 4 * mid)
+            p, s = _bottleneck_init(
+                rngs[k], cin, mid, downsample=down or cin != 4 * mid,
+                ghost=cfg.bn_ghost_slices,
+            )
             params[f"stage{stage}/block{block}"] = p
             state[f"stage{stage}/block{block}"] = s
             cin = 4 * mid
@@ -148,6 +160,7 @@ def apply(cfg: Config, params, model_state, x, *, train: bool, mesh=None):
     y, new_state["bn_stem"] = layers.batchnorm(
         params["bn_stem"], model_state["bn_stem"], y, train=train,
         momentum=cfg.bn_momentum, mesh=mesh, relu=True,
+        ghost_slices=cfg.bn_ghost_slices,
     )
     # Explicit (1,1) pad + VALID, NOT "SAME": for even H (112), SAME pads
     # (lo=0, hi=1), which shifts every pooling window by one pixel.
@@ -201,3 +214,14 @@ def loss_fn(cfg: Config, *, l2: float = 1e-4, mesh=None):
 #: meshes the optimizer state could be sharded ZeRO-style over 'data'; kept
 #: mirrored for reference parity.
 SHARDING_RULES: tuple = ()
+
+
+def sharding_rules(cfg: Config) -> tuple:
+    """Ghost-batch BN keeps its per-slice running stats [S, C] SHARDED over
+    the 'slice' axis — replicated stats would force a per-layer cross-slice
+    all-gather in the EMA update, putting BN right back on DCN."""
+    if cfg.bn_ghost_slices > 0:
+        from jax.sharding import PartitionSpec as P
+
+        return ((r".*/bn[^/]*/(mean|var)$", P("slice", None)),)
+    return SHARDING_RULES

@@ -30,6 +30,7 @@ import jax
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
+AXIS_SLICE = "slice"
 AXIS_DATA = "data"
 AXIS_SEQ = "seq"
 AXIS_PIPE = "pipe"
@@ -39,7 +40,13 @@ AXIS_EXPERT = "expert"
 #: Canonical axis order, outermost (DCN-friendly, infrequent comms) first and
 #: innermost (ICI-hungry, per-layer comms) last.  Tensor-parallel collectives
 #: fire most often, so ``model`` sits innermost where ICI is densest.
-DEFAULT_AXES: tuple[str, ...] = (AXIS_DATA, AXIS_PIPE, AXIS_EXPERT, AXIS_SEQ, AXIS_MODEL)
+#: ``slice`` (r4) makes the DCN slice boundary an EXPLICIT outermost axis
+#: when a workload wants to scope collectives slice-locally (ghost-batch BN
+#: statistics — models/resnet.Config.bn_ghost_slices); batch then shards
+#: over ('slice', 'data') jointly.
+DEFAULT_AXES: tuple[str, ...] = (
+    AXIS_SLICE, AXIS_DATA, AXIS_PIPE, AXIS_EXPERT, AXIS_SEQ, AXIS_MODEL
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -57,9 +64,11 @@ class MeshSpec:
     expert: int = 1
     seq: int = 1
     model: int = 1
+    slice: int = 1
 
     def sizes(self) -> dict[str, int]:
         return {
+            AXIS_SLICE: self.slice,
             AXIS_DATA: self.data,
             AXIS_PIPE: self.pipe,
             AXIS_EXPERT: self.expert,

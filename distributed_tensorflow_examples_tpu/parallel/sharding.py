@@ -109,10 +109,12 @@ def shard_pytree(tree: Any, mesh: Mesh, rules: ShardingRules = ()) -> Any:
     return jax.device_put(tree, shardings)
 
 
-def batch_sharding(mesh: Mesh, data_axes=("data",)) -> NamedSharding:
+def batch_sharding(mesh: Mesh, data_axes=("slice", "data")) -> NamedSharding:
     """Input-batch sharding: leading (batch) dim split over the data axes —
     the analog of ``Dataset.shard``/``DistributedDataset`` per-replica splits
-    (SURVEY.md section 2b, D14)."""
+    (SURVEY.md section 2b, D14).  The default includes the multi-slice
+    'slice' axis (outermost, r4 ghost-BN meshes); absent or size-1 axes are
+    filtered, so single-slice meshes are unchanged."""
     present = tuple(a for a in data_axes if a in mesh.shape and mesh.shape[a] > 1)
     if not present:
         return NamedSharding(mesh, P())

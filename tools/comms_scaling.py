@@ -342,6 +342,32 @@ def hybrid_worker(n: int, slice_size: int) -> dict:
     out["cases"]["resnet50 dp%d(sliced)" % n] = {
         "per_kind": pk2, "unparsed": unk2,
     }
+
+    # ResNet GHOST-BN (r4): the slice structure becomes an explicit mesh
+    # axis, BN statistics scope to the slice-local sub-axis of data
+    # (Config.bn_ghost_slices) — the per-layer reductions must leave DCN,
+    # leaving only the gradient all-reduce crossing.
+    from jax.sharding import PartitionSpec as P
+
+    n_slices = n // slice_size
+    mesh3 = mesh_lib.local_mesh_for_testing(
+        {"slice": n_slices, "data": slice_size}
+    )
+    cfg3 = models.resnet.Config(bn_ghost_slices=n_slices)
+    st3, sh3 = train.create_sharded_state(
+        lambda r: models.resnet.init(cfg3, r), opt2, jax.random.key(0),
+        mesh=mesh3, rules=models.resnet.sharding_rules(cfg3),
+    )
+    bspec = P(("slice", "data"))
+    step3 = train.build_train_step(
+        models.resnet.loss_fn(cfg3), opt2, mesh=mesh3, state_shardings=sh3,
+        batch_spec=bspec,
+    )
+    b3 = as_global({"image": img, "label": lbl}, mesh3, spec=bspec)
+    pk3, unk3 = classify(step3.lower(st3, b3).compile().as_text())
+    out["cases"]["resnet50 GHOST-BN slice%d x dp%d" % (n_slices, slice_size)] = {
+        "per_kind": pk3, "unparsed": unk3,
+    }
     return out
 
 
