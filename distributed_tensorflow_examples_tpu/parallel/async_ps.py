@@ -55,6 +55,14 @@ class AsyncPSConfig:
     mode: str = "async"  # "async" (W2) | "sync_replicas" (W1/D5 semantics)
     replicas_to_aggregate: int | None = None  # sync mode; default num_workers
     max_staleness: int | None = None  # async mode: drop grads older than this
+    #: Async mode only: replace free-running worker threads with a
+    #: deterministic round-robin schedule — every applied gradient was
+    #: computed one schedule slot per peer earlier, so applies still happen
+    #: at STALE params (true W2 semantics) but the interleaving (and hence
+    #: the trajectory) is exactly reproducible.  The determinism analog of
+    #: the reference harness's fixed-seed async tests; the CLI's
+    #: ``--deterministic`` selects it (tests/test_examples_e2e.py W2 gate).
+    fixed_interleave: bool = False
     train_steps: int = 100
     # Checkpoint/resume (SURVEY.md section 5.4: the reference's PS world
     # recovered async runs from Saver checkpoints; same contract here).
@@ -90,6 +98,9 @@ class AsyncPSTrainer:
         self._params_lock = threading.Lock()
         self._stop = threading.Event()
         self.history: list[tuple[int, int, float]] = []  # (worker, local_step, loss)
+        #: Fixed-interleave only: (wid, computed_at, applied_at, dropped)
+        #: per scheduled gradient — the apply-time staleness evidence.
+        self.apply_log: list[tuple[int, int, int, bool]] = []
         self._history_lock = threading.Lock()
         self.total_dropped = 0
         self._worker_excs: list[tuple[int, BaseException]] = []
@@ -279,6 +290,73 @@ class AsyncPSTrainer:
 
     # -- run -----------------------------------------------------------------
 
+    def _run_async_fixed(self, batch_fns: list[Iterator]) -> Any:
+        """Deterministic async schedule (cfg.fixed_interleave): one pending
+        gradient per worker, applied round-robin — each apply uses a
+        gradient computed while the other workers' applies advanced the
+        params, i.e. genuinely STALE (staleness ~ num_workers-1), but the
+        order is fixed, so two runs produce bitwise-identical params.
+        ``apply_log`` records (wid, computed_at, applied_at, dropped) for
+        every scheduled gradient (the staleness evidence tests assert on).
+
+        No transport is involved, so gradients stay pytrees — the threaded
+        path's flatten/unflatten wire format would be two full host copies
+        per step for nothing."""
+        n = self.cfg.num_workers
+        if (
+            self.cfg.max_staleness is not None
+            and self.cfg.max_staleness < n - 1
+        ):
+            # Steady-state staleness of the rotation IS n-1; a tighter bound
+            # would deterministically drop the SAME trailing workers' every
+            # gradient — silent 100% starvation, unlike thread mode where
+            # random interleaving makes drops transient.
+            raise ValueError(
+                f"fixed_interleave with max_staleness="
+                f"{self.cfg.max_staleness} < num_workers-1={n - 1} would "
+                "starve trailing workers deterministically; raise the bound "
+                "or drop --deterministic"
+            )
+        its = [0] * n
+        pending: list[tuple[int, int, Any]] = []
+
+        def compute(wid: int) -> bool:
+            try:
+                batch = next(batch_fns[wid])
+            except StopIteration:
+                return False
+            rng = jax.random.fold_in(jax.random.fold_in(self.rng, wid), its[wid])
+            loss, grads = self._grad_fn(self.params, self.model_state, batch, rng)
+            self.history.append((wid, self.global_step, float(loss)))
+            pending.append((wid, self.global_step, grads))
+            its[wid] += 1
+            return True
+
+        for w in range(n):
+            compute(w)
+        while self.global_step < self.cfg.train_steps and pending:
+            wid, local_step, grads = pending.pop(0)
+            drop = (
+                self.cfg.max_staleness is not None
+                and self.global_step - local_step > self.cfg.max_staleness
+            )
+            self.apply_log.append((wid, local_step, self.global_step, drop))
+            if drop:
+                self.total_dropped += 1
+            else:
+                self._apply_update(grads)
+                self._maybe_checkpoint()
+            compute(wid)
+        if self.cfg.ckpt_dir:
+            self.save_checkpoint()
+        log.info(
+            "async-PS fixed-interleave run done: %d applied steps, %d stale "
+            "grads dropped",
+            self.global_step,
+            self.total_dropped,
+        )
+        return self.params
+
     def run(self, batch_fns: list[Iterator]) -> Any:
         """Train to ``train_steps`` applied updates; returns final params."""
         if len(batch_fns) != self.cfg.num_workers:
@@ -288,6 +366,8 @@ class AsyncPSTrainer:
         self.restore_latest()
         if self.global_step >= self.cfg.train_steps:
             return self.params
+        if self.cfg.mode == "async" and self.cfg.fixed_interleave:
+            return self._run_async_fixed(batch_fns)
         workers = [
             threading.Thread(target=self._worker, args=(i, batch_fns[i]), daemon=True)
             for i in range(self.cfg.num_workers)

@@ -163,6 +163,10 @@ def _ps_cfg(FLAGS, mode: str, n_workers: int):
         mode=mode,
         replicas_to_aggregate=r2a if mode == "sync_replicas" else None,
         max_staleness=getattr(FLAGS, "max_staleness", None) or None,
+        # --deterministic: async applies keep their stale-params semantics
+        # but run on the fixed round-robin schedule (reproducible runs —
+        # and a retry-free CLI acceptance gate).
+        fixed_interleave=bool(getattr(FLAGS, "deterministic", False)),
         train_steps=FLAGS.train_steps,
         ckpt_dir=os.path.join(FLAGS.log_dir, "ps_ckpt") if FLAGS.log_dir else None,
         checkpoint_every=FLAGS.checkpoint_every_steps,

@@ -14,9 +14,12 @@ decisions, and (c) host-side data order.  This module centralises the knob:
   every host agrees on the permutation.
 
 The async-PS emulation (parallel.async_ps) is *deliberately* nondeterministic
-in arrival order — that is the semantics being emulated (the reference's
-async config is racy by design; SURVEY.md section 5.2).  Its determinism
-story is the staleness bound, not this flag.
+in arrival order by default — that is the semantics being emulated (the
+reference's async config is racy by design; SURVEY.md section 5.2).  r4:
+``--deterministic`` ALSO switches the async trainer onto the fixed
+round-robin interleave (``AsyncPSConfig.fixed_interleave`` — applies still
+use stale params, but the schedule, and hence the trajectory, is exactly
+reproducible); thread mode's determinism story remains the staleness bound.
 """
 
 from __future__ import annotations

@@ -243,3 +243,48 @@ def test_gradient_queue_fifo_no_coalescing():
     gq.cancel()
     gq.pop()  # drains the remaining item
     assert gq.pop() is None
+
+
+def test_async_fixed_interleave_deterministic_and_stale():
+    """VERDICT r3 next-step #8: the fixed-interleave async schedule — true
+    W2 semantics (every apply uses a gradient computed at STALE params)
+    with a reproducible trajectory, so CLI acceptance gates need no
+    seed-retry OR.  Two runs must agree BITWISE; the schedule must apply
+    genuinely stale gradients; and the quadratic-ish blob loss must fall
+    deterministically."""
+
+    def run_once():
+        tr = _make_trainer("async", steps=40, lr=0.02, fixed_interleave=True)
+        tr.run([_blob_batches(1), _blob_batches(2)])
+        return tr
+
+    a, b = run_once(), run_once()
+    assert a.global_step == 40 and b.global_step == 40
+    for la, lb in zip(jax.tree.leaves(a.params), jax.tree.leaves(b.params)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb))
+    assert [h[2] for h in a.history] == [h[2] for h in b.history]
+
+    # Staleness AT APPLY TIME (apply_log records computed_at vs applied_at
+    # per scheduled gradient): most applies must use a gradient computed
+    # BEFORE the params they update — the W2 stale-apply semantics this
+    # mode must preserve.  With 2 workers the steady-state staleness is 1.
+    stale_applies = [
+        applied - computed
+        for (_, computed, applied, dropped) in a.apply_log
+        if not dropped
+    ]
+    assert len(stale_applies) == 40
+    assert sum(s >= 1 for s in stale_applies) >= 39, stale_applies[:10]
+    losses = [l for (_, _, l) in a.history]
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_async_fixed_interleave_rejects_starving_staleness():
+    """max_staleness < num_workers-1 under the fixed rotation would drop
+    the SAME workers' gradients every cycle (silent 100% starvation) —
+    rejected up front instead."""
+    tr = _make_trainer(
+        "async", steps=10, workers=3, fixed_interleave=True, max_staleness=1
+    )
+    with pytest.raises(ValueError, match="starve"):
+        tr.run([_blob_batches(1), _blob_batches(2), _blob_batches(3)])

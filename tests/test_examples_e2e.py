@@ -115,37 +115,33 @@ def test_mnist_ps_emulation_sync_replicas(tmp_path):
 def test_cifar10_async_ps(tmp_path):
     """W2: --sync_replicas=false selects the true-async apply path.
 
-    Async SGD on the 1-core CI box is variance-dominated (stale per-worker
-    applies, thread interleaving; 200 steps land anywhere from no-progress
-    to 0.32 accuracy), so the learning gate is an OR of two independent
-    signals with ONE retry on a different seed — a genuinely broken
-    trainer fails both attempts deterministically.  Sync quality
-    thresholds live in the mnist/resnet tests; async *semantics* are
-    deterministic unit tests in test_async_ps.py, and a DETERMINISTIC
-    async learning gate (quadratic converges to err<0.5 through the same
-    per-gradient apply path, across real processes) lives in
+    r4 (VERDICT r3 next-step #8): ``--deterministic`` runs the async
+    applies on the FIXED round-robin interleave — every gradient still
+    applies at stale params (W2 semantics, asserted in
+    test_async_ps.py::test_async_fixed_interleave_deterministic_and_stale)
+    but the trajectory is reproducible, so this gate is ONE run with ONE
+    threshold (measured 0.46 accuracy / loss 2.30->1.83 at these flags; no
+    seed-retry OR).  Free-running thread mode stays the CLI default; its
+    cross-process learning gate is
     tests/test_ps_remote.py::test_async_across_processes.
     """
-    last_f = None
-    for attempt, seed in enumerate((0, 1)):
-        out = _run(
-            "cifar10_cnn.py",
-            "--sync_replicas=false",
-            "--worker_hosts=a:1,b:1",
-            "--batch_size=128",
-            "--train_steps=200",
-            "--learning_rate=0.05",
-            "--max_staleness=4",
-            f"--seed={seed}",
-            f"--log_dir={tmp_path}/try{attempt}",
-        )
-        f = _final(out)
-        assert f["mode"] == "async"
-        assert f["step"] >= 200
-        last_f = f
-        if (f["last_loss"] < f["first_loss"] - 0.01) or f["test_accuracy"] >= 0.12:
-            return
-    raise AssertionError(f"async run never learned (2 attempts): {last_f}")
+    out = _run(
+        "cifar10_cnn.py",
+        "--sync_replicas=false",
+        "--worker_hosts=a:1,b:1",
+        "--batch_size=128",
+        "--train_steps=200",
+        "--learning_rate=0.05",
+        "--max_staleness=4",
+        "--deterministic",
+        "--seed=0",
+        f"--log_dir={tmp_path}",
+    )
+    f = _final(out)
+    assert f["mode"] == "async"
+    assert f["step"] >= 200
+    assert f["last_loss"] < f["first_loss"] - 0.2, f
+    assert f["test_accuracy"] >= 0.35, f
 
 
 def test_word2vec_sharded_mesh(tmp_path):
