@@ -206,7 +206,7 @@ def bench_resnet50(steps: int, batch_per_chip: int, image_size: int = 224):
 
 def bench_transformer(
     steps: int, batch_per_chip: int, seq_len: int = 2048, remat: bool = False,
-    loss_chunks: int = 0, n_heads: int = 8,
+    loss_chunks: int = 0, n_heads: int = 8, experts: int = 0, top_k: int = 2,
 ):
     """Transformer LM tokens/sec/chip + MFU (flash attention on TPU).
 
@@ -214,6 +214,10 @@ def bench_transformer(
     never materialise, which lets batch 16 fit in 16 GB without remat; it
     costs ~4%% throughput, so the flagship default stays dense (BASELINE.md
     r3 flagship account).
+
+    ``experts>0``: the SAME flagship dims with GShard MoE FFNs (E experts,
+    top-k routing) — one code path so dense-vs-MoE A/Bs can never skew on
+    a dropped knob.
     """
     import numpy as np
     import optax
@@ -226,6 +230,7 @@ def bench_transformer(
     cfg = models.transformer.Config(
         vocab_size=32000, dim=1024, n_layers=12, n_heads=n_heads,
         max_seq_len=seq_len, remat=remat, loss_chunks=loss_chunks,
+        moe_experts=experts, moe_top_k=top_k,
     )
 
     def make_batch(rng: np.random.Generator, n: int):
@@ -233,7 +238,7 @@ def bench_transformer(
         return {"x": toks[:, :-1], "y": toks[:, 1:]}
 
     return _bench(
-        "transformer",
+        "transformer_moe" if experts else "transformer",
         models.transformer,
         cfg,
         optax.adamw(1e-3),
@@ -244,6 +249,17 @@ def bench_transformer(
         loss_fn_factory=lambda mesh, _: models.transformer.loss_fn(cfg, mesh=mesh),
         unit_per_example=seq_len,  # headline unit = tokens
     )
+
+
+def bench_moe(steps: int, batch_per_chip: int, **kw):
+    """MoE flagship (VERDICT r3 missing #3: the expert-parallel axis needs a
+    measured number, not just HLO proofs): ``bench_transformer`` with E=8
+    top-2 — ~0.9B params, so the f32 AdamW state caps the single-chip batch
+    (default 4; sweep on TPU).  Dispatch-einsum share of step time:
+    ``tools/profile_step.py --model moe`` (BASELINE.md records the account
+    vs the dense flagship)."""
+    kw.setdefault("experts", 8)
+    return bench_transformer(steps, batch_per_chip, **kw)
 
 
 def bench_lstm(steps: int, batch_per_chip: int, seq_len: int = 20):
@@ -382,7 +398,7 @@ def main():
     ap.add_argument(
         "--model",
         default="resnet50",
-        choices=["resnet50", "mlp", "transformer", "lstm", "word2vec", "decode"],
+        choices=["resnet50", "mlp", "transformer", "moe", "lstm", "word2vec", "decode"],
     )
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--batch-per-chip", type=int, default=None)
@@ -401,6 +417,12 @@ def main():
         r = bench_transformer(
             args.steps or 10, args.batch_per_chip or 8, args.seq_len or 2048,
             remat=args.remat, loss_chunks=args.loss_chunks, n_heads=args.n_heads,
+        )
+    elif args.model == "moe":
+        r = bench_moe(
+            args.steps or 10, args.batch_per_chip or 4,
+            seq_len=args.seq_len or 2048, remat=args.remat,
+            loss_chunks=args.loss_chunks, n_heads=args.n_heads,
         )
     elif args.model == "decode":
         # --seq-len maps to the decode budget: prompt 32 + the rest new.

@@ -227,14 +227,27 @@ def _ring_flash_bwd_rule(axis_name, causal, block_q, block_k, res, do):
 
     # Hop 0 (diagonal, statically causal); all partials f32 (see fwd).
     f32 = jnp.float32
-    dq = fa.dq_call(
-        qf, kf, vf, dof, lse, delta, causal=causal, block_q=bq, block_k=bk,
-        out_dtype=f32,
-    )
-    dk0, dv0 = fa.dkv_call(
-        qf, kf, vf, dof, lse, delta, causal=causal, block_q=bq, block_k=bk,
-        out_dtype=f32,
-    )
+
+    def hop_bwd(kh, vh, *, hop_causal):
+        """Per-hop (dq, dk, dv) partials: the fused single-pass kernel when
+        the per-shard block counts reach its dispatch regime (long-context
+        shards), the split kernels otherwise — same contract either way."""
+        if fa._use_fused_bwd(T // bq, kh.shape[1] // bk, T, D):
+            return fa.fused_bwd_call(
+                qf, kh, vh, dof, lse, delta, causal=hop_causal,
+                block_q=bq, block_k=bk, out_dtype=f32,
+            )
+        dq_h = fa.dq_call(
+            qf, kh, vh, dof, lse, delta, causal=hop_causal, block_q=bq,
+            block_k=bk, out_dtype=f32,
+        )
+        dk_h, dv_h = fa.dkv_call(
+            qf, kh, vh, dof, lse, delta, causal=hop_causal, block_q=bq,
+            block_k=bk, out_dtype=f32,
+        )
+        return dq_h, dk_h, dv_h
+
+    dq, dk0, dv0 = hop_bwd(kf, vf, hop_causal=causal)
 
     def body(carry, i):
         dq, kr, vr, dk, dv = carry
@@ -253,14 +266,7 @@ def _ring_flash_bwd_rule(axis_name, causal, block_q, block_k, res, do):
         # never runs the kernel there (and skips ~half the off-diagonal
         # backward FLOPs under causal masking).
         def visit(dq, dk, dv):
-            dq_h = fa.dq_call(
-                qf, kr, vr, dof, lse, delta, causal=False, block_q=bq,
-                block_k=bk, out_dtype=f32,
-            )
-            dk_h, dv_h = fa.dkv_call(
-                qf, kr, vr, dof, lse, delta, causal=False, block_q=bq,
-                block_k=bk, out_dtype=f32,
-            )
+            dq_h, dk_h, dv_h = hop_bwd(kr, vr, hop_causal=False)
             return dq + dq_h, dk + dk_h, dv + dv_h
 
         if causal:

@@ -336,24 +336,20 @@ class AsyncPSTrainer:
             compute(w)
         while self.global_step < self.cfg.train_steps and pending:
             wid, local_step, grads = pending.pop(0)
-            drop = (
-                self.cfg.max_staleness is not None
-                and self.global_step - local_step > self.cfg.max_staleness
-            )
-            self.apply_log.append((wid, local_step, self.global_step, drop))
-            if drop:
-                self.total_dropped += 1
-            else:
-                self._apply_update(grads)
-                self._maybe_checkpoint()
+            # Apply-time staleness is bounded by n-1 (at most the other
+            # n-1 pending entries advanced global_step since compute), and
+            # the guard above requires max_staleness >= n-1 — so this
+            # schedule never drops; apply_log's field stays for the
+            # thread-mode-compatible contract.
+            self.apply_log.append((wid, local_step, self.global_step, False))
+            self._apply_update(grads)
+            self._maybe_checkpoint()
             compute(wid)
         if self.cfg.ckpt_dir:
             self.save_checkpoint()
         log.info(
-            "async-PS fixed-interleave run done: %d applied steps, %d stale "
-            "grads dropped",
+            "async-PS fixed-interleave run done: %d applied steps",
             self.global_step,
-            self.total_dropped,
         )
         return self.params
 

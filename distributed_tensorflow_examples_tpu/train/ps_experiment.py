@@ -23,6 +23,7 @@ PS workloads in the reference either.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import os
 import time
@@ -223,6 +224,16 @@ def run_ps_cluster_task(
     n_workers = worker_count(FLAGS)
     local_bs = max(1, FLAGS.batch_size // n_workers)
     acfg = _ps_cfg(FLAGS, mode, n_workers)
+    if acfg.fixed_interleave:
+        # Real processes free-run — there is no scheduler to fix their
+        # interleaving, so --deterministic must not silently promise a
+        # reproducible trajectory here (it still pins seeds/precision).
+        log.warning(
+            "--deterministic: the fixed async interleave applies only to "
+            "the single-process thread emulation; cross-process cluster "
+            "ordering remains arrival-order nondeterministic."
+        )
+        acfg = dataclasses.replace(acfg, fixed_interleave=False)
     job = FLAGS.job_name
     chief_hosts_service = FLAGS.ps_tasks == 0
 

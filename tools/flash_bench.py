@@ -110,9 +110,19 @@ def main():
     ap.add_argument("--t", type=int, default=0, help="single T (0 = 2k/8k/32k suite)")
     ap.add_argument("--causal", default=True, action=argparse.BooleanOptionalAction)
     ap.add_argument("--sweep", action="store_true", help="block-size sweep for ours")
+    ap.add_argument(
+        "--fused", choices=["auto", "0", "1"], default="auto",
+        help="fused dq/dk/dv backward: auto = the nq/nk>=4 dispatch gate, "
+        "0/1 force split/fused (r4 A/B comparisons)",
+    )
     ap.add_argument("--markdown", action="store_true")
     ap.add_argument("--steps", type=int, default=10)
     args = ap.parse_args()
+
+    if args.fused != "auto":
+        from distributed_tensorflow_examples_tpu.ops import flash_attention as F
+
+        F._FUSED_BWD_OVERRIDE = args.fused == "1"
 
     ts = [args.t] if args.t else [2048, 8192, 32768]
 

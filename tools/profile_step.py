@@ -35,6 +35,7 @@ def _trace_step(model: str, steps: int, batch_per_chip: int | None, **kw):
     fn = {
         "resnet50": lambda: bench.bench_resnet50,
         "transformer": lambda: bench.bench_transformer,
+        "moe": lambda: bench.bench_moe,
         "lstm": lambda: bench.bench_lstm,
         "word2vec": lambda: bench.bench_word2vec,
         "mlp": lambda: bench.bench_mlp,
@@ -42,6 +43,7 @@ def _trace_step(model: str, steps: int, batch_per_chip: int | None, **kw):
     defaults = {
         "resnet50": dict(batch_per_chip=256),
         "transformer": dict(batch_per_chip=8),
+        "moe": dict(batch_per_chip=4),
         "lstm": dict(batch_per_chip=256),
         "word2vec": dict(batch_per_chip=4096),
         "mlp": dict(batch_per_chip=1024),
