@@ -81,6 +81,7 @@ class MultiProcessRunner:
         repo_root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         )
+        self._pin_cpu = pin_cpu
         pin = (
             'import jax\njax.config.update("jax_platforms", "cpu")\n'
             if pin_cpu
@@ -113,6 +114,11 @@ class MultiProcessRunner:
             env = dict(os.environ)
             env.pop("XLA_FLAGS", None)  # no virtual-device leakage from pytest
             env["JAX_PLATFORMS"] = "cpu"
+            if self._pin_cpu:
+                # Belt and braces with the in-script jax.config pin: without
+                # this var the axon TPU plugin never registers at all, so a
+                # fake-cluster task cannot even touch the tunnel.
+                env.pop("PALLAS_AXON_POOL_IPS", None)
             env["TF_CONFIG"] = self._tf_config(i)
             env.update(self.extra_env)
             log_path = os.path.join(self._dir, f"task_{i}.log")

@@ -91,6 +91,10 @@ def _run_cli(example, *args, timeout=900):
         env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     )
     env["JAX_PLATFORMS"] = "cpu"
+    # The axon TPU plugin registers via sitecustomize when this var is set
+    # and OVERRIDES JAX_PLATFORMS — the child would then grab (or serialize
+    # on) the real TPU tunnel instead of the fake CPU mesh.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     p = subprocess.run(
         [sys.executable, os.path.join(root, "examples", example), *args],
