@@ -382,6 +382,29 @@ def _trunk(cfg: Config, params, x, *, mesh: Mesh | None):
 # ----------------------------------------------------------------------------
 
 
+def collapse_pipeline(cfg: Config, params):
+    """Pipeline-trained checkpoint -> the flat serving layout: the stacked
+    ``blocks`` pytree (leading layer dim, GPipe training layout) becomes
+    per-layer ``block_i`` keys and ``pipeline_stages`` drops to 1, so the
+    result decodes through the ordinary KV-cache path (decode_step /
+    generate).  Rationale: a pipelined DECODE would bubble O(stages) per
+    token — at T=1 there are no microbatches to fill the pipe — so serving
+    collapses the stages instead (weights are identical; parity tested).
+
+    Works on host or device pytrees; re-shard the result for the serving
+    mesh (e.g. ``shard_pytree`` with the dense rules) as needed."""
+    if cfg.pipeline_stages <= 1:
+        return cfg, params
+    from ..parallel import pipeline as pipeline_lib
+
+    flat = {k: v for k, v in params.items() if k != "blocks"}
+    for i, b in enumerate(
+        pipeline_lib.unstack_stages(params["blocks"], cfg.n_layers)
+    ):
+        flat[f"block_{i}"] = b
+    return dataclasses.replace(cfg, pipeline_stages=1, microbatches=1), flat
+
+
 def init_cache(cfg: Config, batch: int, max_len: int, *, mesh: Mesh | None = None):
     """Per-layer K/V cache [B, H, max_len, hd] (bf16 like the compute).
 

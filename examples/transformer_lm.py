@@ -88,12 +88,7 @@ def main(argv):
         print("job_name=ps: parameter servers are not needed on TPU; exiting 0.")
         return
     prompt_len = 16
-    sampling = FLAGS.sample_tokens > 0 and FLAGS.pipeline_stages == 1
-    if FLAGS.sample_tokens > 0 and not sampling:
-        logging.warning(
-            "--sample_tokens ignored: decoding supports the non-pipelined "
-            "model (dense or MoE; pipeline_stages=1)."
-        )
+    sampling = FLAGS.sample_tokens > 0
     if sampling and prompt_len + FLAGS.sample_tokens > FLAGS.seq_len:
         # Validate BEFORE training: generate() would raise after the whole
         # run completed and lose the FINAL line.
@@ -156,18 +151,49 @@ def main(argv):
         # Inference surface: KV-cache greedy decode from a corpus prompt.
         import numpy as np
 
-        # Batch dim must cover the batch shards — ('data','expert') for
-        # MoE; decode runs sharded on the same mesh the model trained on
-        # (KV cache heads on 'model', expert FFNs on their ranks).
-        dp = exp.mesh.shape.get("data", 1) * exp.mesh.shape.get("expert", 1)
-        prompt = np.tile(np.asarray(ids[:prompt_len], dtype=np.int32)[None], (dp, 1))
-        out = models.transformer.generate(
-            cfg, exp.state.params, prompt, max_new_tokens=FLAGS.sample_tokens,
-            mesh=exp.mesh,
-        )
-        logging.info(
-            "sampled token ids: %s", np.asarray(out)[0, prompt_len:].tolist()
-        )
+        if cfg.pipeline_stages > 1:
+            if jax.process_count() > 1:
+                # Sharded params spanning hosts are not fully addressable —
+                # device_get would raise AFTER the whole training run and
+                # lose the FINAL line.  Collapse-serving is a single-host
+                # demo surface; multi-host serving re-shards a restored
+                # checkpoint instead.
+                logging.warning(
+                    "--sample_tokens skipped on multi-host pipelined runs; "
+                    "restore the checkpoint single-host and sample there."
+                )
+                dcfg = None
+            else:
+                # Pipeline-trained weights serve through the COLLAPSED
+                # layout (a pipelined decode would bubble O(stages) per
+                # token at T=1); sampling is a demo surface, so decode
+                # replicated on host-fetched weights rather than
+                # re-sharding.
+                dcfg, dparams = models.transformer.collapse_pipeline(
+                    cfg, jax.device_get(exp.state.params)
+                )
+                dmesh = None
+        else:
+            dcfg, dparams, dmesh = cfg, exp.state.params, exp.mesh
+        if dcfg is not None:
+            # Batch dim must cover the batch shards — ('data','expert')
+            # for MoE; decode runs sharded on the same mesh the model
+            # trained on (KV cache heads on 'model', expert FFNs on their
+            # ranks).
+            dp = 1
+            if dmesh is not None:
+                dp = dmesh.shape.get("data", 1) * dmesh.shape.get("expert", 1)
+            prompt = np.tile(
+                np.asarray(ids[:prompt_len], dtype=np.int32)[None], (dp, 1)
+            )
+            out = models.transformer.generate(
+                dcfg, dparams, prompt, max_new_tokens=FLAGS.sample_tokens,
+                mesh=dmesh,
+            )
+            logging.info(
+                "sampled token ids: %s",
+                np.asarray(out)[0, prompt_len:].tolist(),
+            )
     m = exp.session.last_metrics
     exp.finish(final_perplexity=float(m.get("perplexity", 0.0)))
 

@@ -267,11 +267,13 @@ def test_transformer_pipeline_parallel(tmp_path):
         "--seq_len=64",
         "--vocab_size=512",
         "--attention=xla",
+        "--sample_tokens=8",  # r4: serve via collapsed stages after training
         f"--log_dir={tmp_path}",
     )
     f = _final(out)
     assert f["step"] == 8
     assert 0 < f["final_perplexity"] < 2 * 512, f
+    assert "sampled token ids:" in out
 
 
 def test_cifar10_native_loader(tmp_path):

@@ -209,3 +209,45 @@ def test_pipeline_checkpoint_roundtrip(tmp_path, mesh_pipe4):
     spec = restored.params["blocks"]["qkv"]["kernel"].sharding.spec
     assert spec[0] == "pipe", spec
     mgr.close()
+
+
+def test_pipeline_collapse_then_decode_matches_training_forward(mesh_pipe4):
+    """r4: a pipeline-TRAINED checkpoint must be servable — collapse the
+    stacked stages to the flat layout, then KV-cache decode: per-position
+    logits equal the pipelined training forward's, and generate() runs
+    greedy end-to-end.  (A pipelined decode itself would bubble O(stages)
+    per token at T=1; collapsing is the serving path, PARITY.md.)"""
+    cfg_pipe = models.transformer.Config(
+        vocab_size=64, dim=32, n_layers=4, n_heads=2, max_seq_len=16,
+        attention="xla", compute_dtype="float32",
+        pipeline_stages=4, microbatches=2,
+    )
+    state, _ = train.create_sharded_state(
+        lambda r: models.transformer.init(cfg_pipe, r),
+        optax.sgd(0.1),
+        jax.random.key(0),
+        mesh=mesh_pipe4,
+        rules=models.transformer.sharding_rules(cfg_pipe),
+    )
+    x = jax.random.randint(jax.random.key(5), (2, 10), 0, 64)
+    logits_pipe = jax.jit(
+        lambda p, x: models.transformer.apply(cfg_pipe, p, x, mesh=mesh_pipe4)
+    )(state.params, x)
+
+    cfg_flat, params_flat = models.transformer.collapse_pipeline(
+        cfg_pipe, jax.device_get(state.params)
+    )
+    assert cfg_flat.pipeline_stages == 1
+    cache = models.transformer.init_cache(cfg_flat, 2, 10)
+    for pos in range(10):
+        l, cache = models.transformer.decode_step(
+            cfg_flat, params_flat, cache, x[:, pos], pos
+        )
+        np.testing.assert_allclose(
+            np.asarray(l), np.asarray(logits_pipe[:, pos]),
+            rtol=2e-4, atol=2e-4,
+        )
+    out = models.transformer.generate(
+        cfg_flat, params_flat, np.asarray(x[:, :4]), max_new_tokens=5
+    )
+    assert out.shape == (2, 9)
