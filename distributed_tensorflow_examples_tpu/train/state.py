@@ -73,13 +73,16 @@ def create_sharded_state(
     leaves stay replicated.  Explicit rules always win.
 
     ``zero_opt_sharding`` (ZeRO-1, the T5X/praxis mechanism): every
-    still-replicated optimizer-state leaf of >= ``zero_min_elements`` whose
-    some dim divides the ``data`` axis gets that dim sharded over ``data``.
-    Params stay replicated — GSPMD then emits reduce-scatter(grads) ->
-    sharded optimizer update -> all-gather(params), cutting optimizer-state
-    HBM by the data-parallel degree with identical numerics.  The reference
-    has no analog (its PS *hosted* slot variables off-device; this is the
-    mesh-era version of not paying for optimizer state per replica).
+    still-replicated optimizer-state leaf of >= ``zero_min_elements`` gets
+    a dim sharded over the data-parallel axes — ``('slice','data')``
+    jointly on multi-slice meshes (HBM divides by the FULL dp degree; the
+    implied param all-gather then crosses DCN once per step, same as the
+    gradient reduction), falling back to a single axis for dims the joint
+    degree doesn't divide.  Params stay replicated — GSPMD then emits
+    reduce-scatter(grads) -> sharded optimizer update -> all-gather
+    (params), with identical numerics.  The reference has no analog (its
+    PS *hosted* slot variables off-device; this is the mesh-era version of
+    not paying for optimizer state per replica).
 
     Returns ``(state, state_shardings)``; the shardings tree is reused as the
     train step's in/out shardings and the checkpoint restore layout.
@@ -111,7 +114,8 @@ def create_sharded_state(
 
     abstract = jax.eval_shape(_init, rng)
     shardings = sharding_tree(abstract, mesh, rules, default_spec_fn=default_fn)
-    if zero_opt_sharding and mesh.shape.get("data", 1) > 1:
+    if zero_opt_sharding:
+        # _zero_shard_opt is a no-op when no data-parallel axis exceeds 1.
         shardings.opt_state = _zero_shard_opt(
             shardings.opt_state, abstract.opt_state, mesh, zero_min_elements
         )
@@ -120,12 +124,24 @@ def create_sharded_state(
 
 
 def _zero_shard_opt(opt_shardings, abstract_opt, mesh: Mesh, min_elements: int):
-    """Shard replicated optimizer-state leaves over the 'data' axis (ZeRO-1)."""
+    """Shard replicated optimizer-state leaves over the data axes (ZeRO-1).
+    On multi-slice meshes (an explicit 'slice' axis, r4) the slice axis
+    joins in — optimizer HBM then divides by the FULL data-parallel degree,
+    not just the within-slice part."""
     import math
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    dsize = mesh.shape["data"]
+    axes = tuple(
+        a for a in ("slice", "data") if mesh.shape.get(a, 1) > 1
+    )
+    if not axes:
+        return opt_shardings
+    # Preference order: the full joint degree first, then each single axis
+    # — a leaf whose dims don't divide slice*data still gets the partial
+    # sharding the single-axis layout allows (no silent replication
+    # regression on awkward shapes).
+    candidates = [axes] + ([(a,) for a in axes] if len(axes) > 1 else [])
 
     def one(sh, leaf):
         shape = getattr(leaf, "shape", ())
@@ -133,11 +149,13 @@ def _zero_shard_opt(opt_shardings, abstract_opt, mesh: Mesh, min_elements: int):
             return sh
         if any(e is not None for e in sh.spec):
             return sh  # already sharded by a rule (e.g. Megatron TP mirror)
-        for d, s in enumerate(shape):
-            if s % dsize == 0:
-                spec = [None] * len(shape)
-                spec[d] = "data"
-                return NamedSharding(mesh, P(*spec))
+        for cand in candidates:
+            dsize = math.prod(mesh.shape[a] for a in cand)
+            for d, s in enumerate(shape):
+                if s % dsize == 0:
+                    spec = [None] * len(shape)
+                    spec[d] = cand if len(cand) > 1 else cand[0]
+                    return NamedSharding(mesh, P(*spec))
         return sh
 
     return jax.tree.map(one, opt_shardings, abstract_opt)

@@ -119,3 +119,31 @@ def test_fused_bwd_dispatch_gate():
     assert not _use_fused_bwd(8, 2, 8192, 128)
     # VMEM cap on the [tq, d] accumulator: T=32768 @ d=128 stays split.
     assert not _use_fused_bwd(32, 32, 32768, 128)
+
+
+def test_fused_bwd_bf16_matches_split(monkeypatch):
+    """The flagship runs bf16 operands; the fused kernel's bf16 handling
+    (native-dtype MXU inputs, f32 accumulation, bf16 dq output flushes)
+    must agree with the split kernels at bf16 within bf16 tolerance."""
+    from distributed_tensorflow_examples_tpu.ops import flash_attention as F
+
+    r = jax.random.split(jax.random.key(11), 4)
+    mk = lambda rr: jax.random.normal(rr, (1, 2, 128, 16), jnp.bfloat16)
+    q, k, v = mk(r[0]), mk(r[1]), mk(r[2])
+
+    def loss(q, k, v):
+        return jnp.sum(
+            F.flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+            .astype(jnp.float32) ** 2
+        )
+
+    monkeypatch.setattr(F, "_FUSED_BWD_OVERRIDE", True)
+    g_fused = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setattr(F, "_FUSED_BWD_OVERRIDE", False)
+    g_split = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for gf, gs in zip(g_fused, g_split):
+        assert gf.dtype == jnp.bfloat16 and gs.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(gf, dtype=np.float32), np.asarray(gs, dtype=np.float32),
+            rtol=0.05, atol=0.05,
+        )
