@@ -558,15 +558,25 @@ def flash_viable(t: int) -> bool:
 
 
 def flash_attention(
-    q, k, v, *, causal: bool = False, block_q: int = 1024, block_k: int = 1024
+    q, k, v, *, causal: bool = False,
+    block_q: int | None = None, block_k: int | None = None,
 ):
     """Drop-in for ``ops.attention.mha``: q/k/v [B, H, T, D] -> [B, H, T, D].
 
     Block sizes auto-shrink to the largest divisor of T (so any T traces);
     differentiable (custom FA2 VJP); runs interpreted off-TPU.  Default
     1024x1024 tiles: the measured optimum of the v5e sweep (BASELINE.md;
-    ~18% faster than 512x512, and 2048 tiles blow VMEM at D=64).
+    ~18% faster than 512x512, and 2048 tiles blow VMEM at D=64).  The
+    DTX_FLASH_BQ / DTX_FLASH_BK env vars override the defaults — the
+    in-step block-sweep knob (bench.py re-runs per setting), read at
+    trace time.
     """
+    import os
+
+    if block_q is None:
+        block_q = int(os.environ.get("DTX_FLASH_BQ", "1024"))
+    if block_k is None:
+        block_k = int(os.environ.get("DTX_FLASH_BK", "1024"))
     B, H, T, D = q.shape
     bq = _pick_block(T, block_q)
     bk = _pick_block(T, block_k)
