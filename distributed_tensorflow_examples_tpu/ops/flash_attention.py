@@ -573,10 +573,24 @@ def flash_attention(
     """
     import os
 
+    def _env_block(name: str) -> int:
+        raw = os.environ.get(name, "1024")
+        try:
+            val = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"{name}={raw!r}: flash block overrides must be integers"
+            ) from None
+        if val < 128:
+            # A sweep typo (0, '2k', 16) must not silently record a
+            # pathological 1-row-tile run as a data point.
+            raise ValueError(f"{name}={val}: flash blocks must be >= 128")
+        return val
+
     if block_q is None:
-        block_q = int(os.environ.get("DTX_FLASH_BQ", "1024"))
+        block_q = _env_block("DTX_FLASH_BQ")
     if block_k is None:
-        block_k = int(os.environ.get("DTX_FLASH_BK", "1024"))
+        block_k = _env_block("DTX_FLASH_BK")
     B, H, T, D = q.shape
     bq = _pick_block(T, block_q)
     bk = _pick_block(T, block_k)

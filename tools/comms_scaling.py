@@ -323,51 +323,42 @@ def hybrid_worker(n: int, slice_size: int) -> dict:
         "per_kind": per_kind, "unparsed": unknown,
     }
 
-    # ResNet: pure dp — the one axis that must cross DCN.
-    mesh2 = mesh_lib.local_mesh_for_testing({"data": n})
-    cfg2 = models.resnet.Config()
-    opt2 = optax.sgd(0.1, momentum=0.9)
-    st2, sh2 = train.create_sharded_state(
-        lambda r: models.resnet.init(cfg2, r), opt2, jax.random.key(0),
-        mesh=mesh2, rules=models.resnet.SHARDING_RULES,
-    )
-    step2 = train.build_train_step(
-        models.resnet.loss_fn(cfg2), opt2, mesh=mesh2, state_shardings=sh2
-    )
-    img = rng.normal(size=(2 * n, 64, 64, 3)).astype("float32")
-    lbl = rng.integers(0, 1000, size=(2 * n,)).astype("int32")
-    b2 = as_global({"image": img, "label": lbl}, mesh2)
-    hlo2 = step2.lower(st2, b2).compile().as_text()
-    pk2, unk2 = classify(hlo2)
-    out["cases"]["resnet50 dp%d(sliced)" % n] = {
-        "per_kind": pk2, "unparsed": unk2,
-    }
-
-    # ResNet GHOST-BN (r4): the slice structure becomes an explicit mesh
-    # axis, BN statistics scope to the slice-local sub-axis of data
-    # (Config.bn_ghost_slices) — the per-layer reductions must leave DCN,
-    # leaving only the gradient all-reduce crossing.
+    # ResNet, twice: full SyncBN on pure dp (the honest every-all-reduce-
+    # crosses-DCN counterpoint) vs GHOST-BN (r4: the slice structure as an
+    # explicit mesh axis, BN statistics scoped slice-local — the per-layer
+    # reductions must leave DCN, only the gradient all-reduce crossing).
     from jax.sharding import PartitionSpec as P
 
+    opt2 = optax.sgd(0.1, momentum=0.9)
+    img = rng.normal(size=(2 * n, 64, 64, 3)).astype("float32")
+    lbl = rng.integers(0, 1000, size=(2 * n,)).astype("int32")
+
+    def resnet_case(label, mesh_r, cfg_r, bspec):
+        st, sh = train.create_sharded_state(
+            lambda r: models.resnet.init(cfg_r, r), opt2, jax.random.key(0),
+            mesh=mesh_r, rules=models.resnet.sharding_rules(cfg_r),
+        )
+        step = train.build_train_step(
+            models.resnet.loss_fn(cfg_r), opt2, mesh=mesh_r,
+            state_shardings=sh, batch_spec=bspec,
+        )
+        b = as_global({"image": img, "label": lbl}, mesh_r, spec=bspec)
+        pk, unk = classify(step.lower(st, b).compile().as_text())
+        out["cases"][label] = {"per_kind": pk, "unparsed": unk}
+
+    resnet_case(
+        "resnet50 dp%d(sliced)" % n,
+        mesh_lib.local_mesh_for_testing({"data": n}),
+        models.resnet.Config(),
+        None,
+    )
     n_slices = n // slice_size
-    mesh3 = mesh_lib.local_mesh_for_testing(
-        {"slice": n_slices, "data": slice_size}
+    resnet_case(
+        "resnet50 GHOST-BN slice%d x dp%d" % (n_slices, slice_size),
+        mesh_lib.local_mesh_for_testing({"slice": n_slices, "data": slice_size}),
+        models.resnet.Config(bn_ghost_slices=n_slices),
+        P(("slice", "data")),
     )
-    cfg3 = models.resnet.Config(bn_ghost_slices=n_slices)
-    st3, sh3 = train.create_sharded_state(
-        lambda r: models.resnet.init(cfg3, r), opt2, jax.random.key(0),
-        mesh=mesh3, rules=models.resnet.sharding_rules(cfg3),
-    )
-    bspec = P(("slice", "data"))
-    step3 = train.build_train_step(
-        models.resnet.loss_fn(cfg3), opt2, mesh=mesh3, state_shardings=sh3,
-        batch_spec=bspec,
-    )
-    b3 = as_global({"image": img, "label": lbl}, mesh3, spec=bspec)
-    pk3, unk3 = classify(step3.lower(st3, b3).compile().as_text())
-    out["cases"]["resnet50 GHOST-BN slice%d x dp%d" % (n_slices, slice_size)] = {
-        "per_kind": pk3, "unparsed": unk3,
-    }
     return out
 
 
