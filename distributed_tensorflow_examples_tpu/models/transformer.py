@@ -47,7 +47,10 @@ class Config:
     mlp_ratio: int = 4
     max_seq_len: int = 2048
     causal: bool = True
-    attention: str = "auto"  # "auto" | "xla" | "flash" (auto: flash on TPU)
+    #: "auto" (flash on TPU) | "xla" | "flash"; with a seq-sharded mesh
+    #: these select the ring impl, and "ulysses" selects all-to-all CP
+    #: (ops/attention.ulysses_attention) instead of the ring.
+    attention: str = "auto"
     compute_dtype: str = "bfloat16"
     #: >1 enables pipeline parallelism: blocks are STACKED (params carry a
     #: leading layer dim sharded P('pipe')) and run under the GPipe schedule
@@ -117,7 +120,9 @@ def _layernorm(p, x, eps=1e-5):
 def _use_flash(cfg: Config, seq_len: int) -> bool:
     if cfg.attention == "flash":
         return True
-    if cfg.attention == "auto":
+    if cfg.attention in ("auto", "ulysses"):
+        # Ulysses without a seq-sharded mesh degenerates to local
+        # attention — same flash-if-viable policy as auto.
         from ..ops.flash_attention import flash_viable
 
         return flash_viable(seq_len)

@@ -315,16 +315,23 @@ def sequence_parallel_attention(
     axis.
 
     ``impl``: per-hop block compute — "xla" (the reference ring), "flash"
-    (Pallas kernels fwd+bwd), or "auto" (flash on TPU, xla elsewhere —
-    interpret-mode Pallas inside a scan is prohibitively slow on CPU).
+    (Pallas kernels fwd+bwd), "ulysses" (all-to-all head-resharding CP —
+    see :func:`ulysses_attention`), or "auto" (flash ring on TPU, xla
+    elsewhere — interpret-mode Pallas inside a scan is prohibitively slow
+    on CPU).
 
     ``batch_axis`` may be a tuple of axes (('data','expert') for MoE
     models whose batches shard over both — models/transformer.data_axes).
     """
-    if impl not in ("auto", "xla", "flash"):
-        raise ValueError(f"impl must be auto|xla|flash, got {impl!r}")
+    if impl not in ("auto", "xla", "flash", "ulysses"):
+        raise ValueError(f"impl must be auto|xla|flash|ulysses, got {impl!r}")
     if mesh.shape.get(seq_axis, 1) == 1:
         return mha(q, k, v, causal=causal)
+    if impl == "ulysses":
+        return ulysses_attention(
+            mesh, q, k, v, causal=causal, seq_axis=seq_axis,
+            batch_axis=batch_axis, head_axis=head_axis,
+        )
     h_entry = head_axis if mesh.shape.get(head_axis, 1) > 1 else None
     spec = P(batch_axis, h_entry, seq_axis, None)
 
@@ -342,3 +349,67 @@ def sequence_parallel_attention(
         fn, mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
     return mapped(q, k, v)
+
+
+def ulysses_attention(
+    mesh: Mesh,
+    q,
+    k,
+    v,
+    *,
+    causal: bool = False,
+    seq_axis: str = "seq",
+    batch_axis="data",
+    head_axis: str = "model",
+):
+    """All-to-all sequence/context parallelism (the DeepSpeed-Ulysses
+    layout; SURVEY.md section 7 growth path #7 names it next to the ring):
+    instead of rotating k/v shards around a ring, ONE ``all_to_all`` per
+    tensor re-shards [B, H_loc, T/s, D] -> [B, H_loc/s, T, D] — sequence
+    gathered, heads scattered — then attention runs LOCALLY over the full
+    sequence (plain causal flag, no cross-hop online-softmax bookkeeping),
+    and one ``all_to_all`` brings the output back to the sequence layout.
+
+    Trade vs the ring: 4 all_to_alls moving activation-sized payloads per
+    layer and full-T local compute (which puts the per-shard shape squarely
+    in the fused flash backward's regime), against the ring's n-1
+    latency-chained permutes of k/v; Ulysses needs heads divisible by the
+    seq shards, the ring does not.  Same entry contract as
+    :func:`sequence_parallel_attention` (composes with Megatron head
+    sharding over ``head_axis``).
+    """
+    s = mesh.shape.get(seq_axis, 1)
+    if s == 1:
+        return mha(q, k, v, causal=causal)
+    H = q.shape[1]
+    h_shards = mesh.shape.get(head_axis, 1)
+    h_entry = head_axis if h_shards > 1 else None
+    if (H // h_shards) % s:
+        raise ValueError(
+            f"ulysses: {H} heads / {h_shards} '{head_axis}' shards leaves "
+            f"{H // h_shards} local heads, not divisible by {seq_axis}={s}; "
+            "use the ring (impl='flash'/'xla') for this shape"
+        )
+    spec = P(batch_axis, h_entry, seq_axis, None)
+
+    from .flash_attention import flash_attention, flash_viable
+
+    T = q.shape[2]
+    use_flash = flash_viable(T)  # full T is local after the reshard
+
+    def local(q, k, v):
+        # [b, h_loc, T/s, D] -> heads scattered, sequence gathered.
+        a2a = functools.partial(
+            lax.all_to_all, axis_name=seq_axis, tiled=True
+        )
+        q, k, v = (a2a(t, split_axis=1, concat_axis=2) for t in (q, k, v))
+        if use_flash:
+            o = flash_attention(q, k, v, causal=causal)
+        else:
+            o = mha(q, k, v, causal=causal)
+        # Back to the sequence-sharded layout for the rest of the layer.
+        return a2a(o, split_axis=2, concat_axis=1)
+
+    return collectives.shard_map(
+        local, mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)

@@ -38,7 +38,11 @@ flags.DEFINE_integer("n_layers", 4, "Decoder blocks.")
 flags.DEFINE_integer("n_heads", 8, "Attention heads.")
 flags.DEFINE_integer("seq_len", 512, "Sequence length.")
 flags.DEFINE_enum(
-    "attention", "auto", ["auto", "xla", "flash"], "Per-chip attention impl."
+    "attention", "auto", ["auto", "xla", "flash", "ulysses"],
+    "Attention impl: auto/xla/flash select the per-chip kernel (and the "
+    "ring impl under a seq-sharded mesh); ulysses = all-to-all CP instead "
+    "of the ring (local heads per TP shard must be a multiple of the seq "
+    "shard count).",
 )
 flags.DEFINE_float("clip_norm", 1.0, "Global-norm gradient clip.")
 flags.DEFINE_bool(

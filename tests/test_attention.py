@@ -189,3 +189,79 @@ def test_ring_flash_fused_hop_bwd_matches_full(causal, monkeypatch):
         np.testing.assert_allclose(
             np.asarray(b), np.asarray(a), rtol=2e-4, atol=2e-4
         )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_full(causal):
+    """All-to-all CP (r4, SURVEY growth path #7's second option): output ==
+    full mha, and the compiled step really moves tokens by all_to_all."""
+    mesh = local_mesh_for_testing({"data": 2, "seq": 4})
+    q, k, v = _qkv(t=32, d=8, seed=9)  # h=4 default
+    q, k, v = (jnp.tile(x, (1, 4, 1, 1)) for x in (q, k, v))  # H=16, % seq=4 == 0
+
+    ref = A.mha(q, k, v, causal=causal)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("data", None, "seq", None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    fn = jax.jit(
+        lambda q, k, v: A.ulysses_attention(mesh, q, k, v, causal=causal)
+    )
+    hlo = fn.lower(qs, ks, vs).compile().as_text()
+    assert "all-to-all" in hlo, "ulysses did not lower to all_to_all"
+    out = fn(qs, ks, vs)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_ulysses_grads_match_full():
+    mesh = local_mesh_for_testing({"data": 2, "seq": 4})
+    q, k, v = _qkv(t=16, d=8, seed=10)
+    q, k, v = (jnp.tile(x, (1, 4, 1, 1)) for x in (q, k, v))  # H=16
+
+    def loss_ref(q, k, v):
+        return jnp.sum(A.mha(q, k, v, causal=True) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("data", None, "seq", None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+
+    def loss_u(q, k, v):
+        return jnp.sum(A.ulysses_attention(mesh, q, k, v, causal=True) ** 2)
+
+    g_u = jax.jit(jax.grad(loss_u, argnums=(0, 1, 2)))(qs, ks, vs)
+    for a, b in zip(g_ref, g_u):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_ulysses_composes_with_head_sharding():
+    """seq=2 x model=2: heads shard over BOTH axes after the reshard."""
+    mesh = local_mesh_for_testing({"data": 2, "seq": 2, "model": 2})
+    q, k, v = _qkv(t=16, d=8, seed=11)
+    q, k, v = (jnp.tile(x, (1, 4, 1, 1)) for x in (q, k, v))  # H=16: 8 local heads per model shard, % seq=2 == 0
+
+    ref = A.mha(q, k, v, causal=True)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("data", "model", "seq", None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    out = jax.jit(
+        lambda q, k, v: A.ulysses_attention(mesh, q, k, v, causal=True)
+    )(qs, ks, vs)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_ulysses_rejects_indivisible_heads():
+    mesh = local_mesh_for_testing({"data": 2, "seq": 4})
+    q, k, v = _qkv(h=2, t=16, d=8)  # H=2, not divisible by seq=4
+    with pytest.raises(ValueError, match="ring"):
+        A.ulysses_attention(mesh, q, k, v, causal=True)

@@ -435,3 +435,24 @@ def test_transformer_moe_sharded_sampling(tmp_path):
     f = _final(out)
     assert f["step"] == 8
     assert "sampled token ids:" in out
+
+
+def test_transformer_ulysses_sequence_parallel(tmp_path):
+    """r4: --attention=ulysses trains with all-to-all CP on a
+    data=2,seq=2,model=2 mesh (heads reshard over both model and seq)."""
+    out = _run(
+        "transformer_lm.py",
+        "--mesh=data=2,seq=2,model=2",
+        "--train_steps=8",
+        "--batch_size=8",
+        "--dim=64",
+        "--n_layers=2",
+        "--n_heads=4",
+        "--seq_len=64",
+        "--vocab_size=512",
+        "--attention=ulysses",
+        f"--log_dir={tmp_path}",
+    )
+    f = _final(out)
+    assert f["step"] == 8
+    assert 0 < f["final_perplexity"] < 2 * 512, f
