@@ -133,6 +133,24 @@ def _workloads(n: int):
             per_chip=2,
             batch_spec=True,
         ),
+        "transformer_ulysses": dict(
+            # All-to-all CP (r4): same mesh family as the ring transformer,
+            # but the seq reshard moves activations by all_to_all instead
+            # of rotating k/v by collective-permute.
+            mesh={"data": n // tp // (2 if n >= 16 else 1), "seq": (2 if n >= 16 else 1), "model": tp},
+            model=models.transformer,
+            cfg=models.transformer.Config(
+                vocab_size=8192, dim=256, n_layers=2, n_heads=8,
+                max_seq_len=256, compute_dtype="float32", attention="ulysses",
+            ),
+            opt=optax.adam(1e-3),
+            batch=lambda rng, b: {
+                "x": rng.integers(0, 8192, size=(b, 256)).astype("int32"),
+                "y": rng.integers(0, 8192, size=(b, 256)).astype("int32"),
+            },
+            per_chip=2,
+            batch_spec=True,
+        ),
         "transformer_pp": dict(
             # Pipeline parallel: per-rank stage weights, ppermute handoff.
             mesh={"data": n // 4, "pipe": 2, "model": 2},
