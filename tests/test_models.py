@@ -471,3 +471,70 @@ def test_ghost_bn_eval_recovers_global_moments():
     y_ghost, _ = models.layers.batchnorm(params, stats_ghost, x, train=False)
     y_ref, _ = models.layers.batchnorm(params, stats_global, x, train=False)
     np.testing.assert_allclose(np.asarray(y_ghost), np.asarray(y_ref), rtol=1e-6)
+
+
+def test_ghost_bn_composes_with_zero1_and_checkpoint(tmp_path):
+    """r4 features together: ghost-BN (per-slice [S, C] stats sharded over
+    'slice') + ZeRO-1 over ('slice','data') + checkpoint save/restore of
+    the sharded state — one train step each side of the roundtrip."""
+    import optax
+
+    from jax.sharding import PartitionSpec as P
+
+    mesh = local_mesh_for_testing({"slice": 2, "data": 4})
+    cfg = models.resnet.Config(
+        num_classes=10, stage_sizes=(1,), width=8,
+        compute_dtype="float32", bn_ghost_slices=2,
+    )
+    opt = optax.adam(1e-3)
+    bspec = P(("slice", "data"))
+
+    def make():
+        state, sh = train.create_sharded_state(
+            lambda r: models.resnet.init(cfg, r), opt, jax.random.key(0),
+            mesh=mesh, rules=models.resnet.sharding_rules(cfg),
+            zero_opt_sharding=True, zero_min_elements=256,
+        )
+        step = train.build_train_step(
+            models.resnet.loss_fn(cfg, l2=0.0), opt, mesh=mesh,
+            state_shardings=sh, batch_spec=bspec,
+        )
+        return state, sh, step
+
+    state, sh, step = make()
+    # Both r4 layouts present: some opt leaf sharded over slice+data, BN
+    # stats sharded over slice.
+    assert any(
+        "slice" in str(s.spec) for s in jax.tree.leaves(sh.opt_state)
+    )
+    assert any(
+        "slice" in str(s.spec) for s in jax.tree.leaves(sh.model_state)
+    )
+
+    rng = np.random.default_rng(0)
+    batch = as_global(
+        {
+            "image": rng.normal(size=(16, 16, 16, 3)).astype(np.float32),
+            "label": rng.integers(0, 10, size=(16,)).astype(np.int32),
+        },
+        mesh,
+        spec=bspec,
+    )
+    state, m = step(state, batch)
+    assert np.isfinite(float(m["loss"]))
+
+    mgr = train.checkpoint.CheckpointManager(
+        str(tmp_path / "ckpt"), async_save=False
+    )
+    mgr.save(int(state.step), state, force=True)
+    mgr.wait()
+    fresh, _, step2 = make()
+    restored = mgr.restore_latest(fresh)
+    mgr.close()
+    assert restored is not None and int(restored.step) == 1
+    for a, b in zip(
+        jax.tree.leaves(state.model_state), jax.tree.leaves(restored.model_state)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    restored, m2 = step2(restored, batch)
+    assert np.isfinite(float(m2["loss"]))
