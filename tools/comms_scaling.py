@@ -312,34 +312,37 @@ def hybrid_worker(n: int, slice_size: int) -> dict:
 
     out: dict = {"n": n, "slice_size": slice_size, "cases": {}}
 
-    # Transformer: dp over DCN+ICI, sp/tp inner (slice-local by layout).
+    # Transformer: dp over DCN+ICI, sp/tp inner (slice-local by layout) —
+    # once with the ring (collective-permute) and once with Ulysses
+    # all-to-all CP (r4): both layouts' per-layer traffic must stay ICI.
     mesh = mesh_lib.local_mesh_for_testing(
         {"data": n // 4, "seq": 2, "model": 2}
     )
-    cfg = models.transformer.Config(
-        vocab_size=8192, dim=256, n_layers=2, n_heads=8, max_seq_len=256,
-        compute_dtype="float32", attention="xla",
-    )
-    opt = optax.adam(1e-3)
-    state, sh = train.create_sharded_state(
-        lambda r: models.transformer.init(cfg, r), opt, jax.random.key(0),
-        mesh=mesh, rules=models.transformer.SHARDING_RULES,
-    )
-    step = train.build_train_step(
-        models.transformer.loss_fn(cfg, mesh=mesh), opt, mesh=mesh,
-        state_shardings=sh, batch_spec=models.transformer.batch_spec(cfg),
-    )
     rng = np.random.default_rng(0)
     toks = rng.integers(0, 8192, size=(2 * (n // 4), 257)).astype("int32")
-    b = as_global(
-        {"x": toks[:, :-1], "y": toks[:, 1:]}, mesh,
-        spec=models.transformer.batch_spec(cfg),
-    )
-    hlo = step.lower(state, b).compile().as_text()
-    per_kind, unknown = classify(hlo)
-    out["cases"]["transformer dp%d(sliced) x sp2 x tp2" % (n // 4)] = {
-        "per_kind": per_kind, "unparsed": unknown,
-    }
+    opt = optax.adam(1e-3)
+    for attn, label in (
+        ("xla", "transformer dp%d(sliced) x sp2 x tp2" % (n // 4)),
+        ("ulysses", "transformer ULYSSES dp%d(sliced) x sp2 x tp2" % (n // 4)),
+    ):
+        cfg = models.transformer.Config(
+            vocab_size=8192, dim=256, n_layers=2, n_heads=8, max_seq_len=256,
+            compute_dtype="float32", attention=attn,
+        )
+        state, sh = train.create_sharded_state(
+            lambda r: models.transformer.init(cfg, r), opt, jax.random.key(0),
+            mesh=mesh, rules=models.transformer.SHARDING_RULES,
+        )
+        step = train.build_train_step(
+            models.transformer.loss_fn(cfg, mesh=mesh), opt, mesh=mesh,
+            state_shardings=sh, batch_spec=models.transformer.batch_spec(cfg),
+        )
+        b = as_global(
+            {"x": toks[:, :-1], "y": toks[:, 1:]}, mesh,
+            spec=models.transformer.batch_spec(cfg),
+        )
+        per_kind, unknown = classify(step.lower(state, b).compile().as_text())
+        out["cases"][label] = {"per_kind": per_kind, "unparsed": unknown}
 
     # ResNet, twice: full SyncBN on pure dp (the honest every-all-reduce-
     # crosses-DCN counterpoint) vs GHOST-BN (r4: the slice structure as an
