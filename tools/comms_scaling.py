@@ -321,25 +321,31 @@ def hybrid_worker(n: int, slice_size: int) -> dict:
     rng = np.random.default_rng(0)
     toks = rng.integers(0, 8192, size=(2 * (n // 4), 257)).astype("int32")
     opt = optax.adam(1e-3)
+    # init/rules/batch_spec don't depend on the attention variant, so the
+    # sharded state and global batch are built once and only the step
+    # (whose loss_fn embeds the attention impl) differs per case.
+    cfg = models.transformer.Config(
+        vocab_size=8192, dim=256, n_layers=2, n_heads=8, max_seq_len=256,
+        compute_dtype="float32", attention="xla",
+    )
+    state, sh = train.create_sharded_state(
+        lambda r: models.transformer.init(cfg, r), opt, jax.random.key(0),
+        mesh=mesh, rules=models.transformer.SHARDING_RULES,
+    )
+    b = as_global(
+        {"x": toks[:, :-1], "y": toks[:, 1:]}, mesh,
+        spec=models.transformer.batch_spec(cfg),
+    )
+    import dataclasses as _dc
+
     for attn, label in (
         ("xla", "transformer dp%d(sliced) x sp2 x tp2" % (n // 4)),
         ("ulysses", "transformer ULYSSES dp%d(sliced) x sp2 x tp2" % (n // 4)),
     ):
-        cfg = models.transformer.Config(
-            vocab_size=8192, dim=256, n_layers=2, n_heads=8, max_seq_len=256,
-            compute_dtype="float32", attention=attn,
-        )
-        state, sh = train.create_sharded_state(
-            lambda r: models.transformer.init(cfg, r), opt, jax.random.key(0),
-            mesh=mesh, rules=models.transformer.SHARDING_RULES,
-        )
+        cfg_a = _dc.replace(cfg, attention=attn)
         step = train.build_train_step(
-            models.transformer.loss_fn(cfg, mesh=mesh), opt, mesh=mesh,
-            state_shardings=sh, batch_spec=models.transformer.batch_spec(cfg),
-        )
-        b = as_global(
-            {"x": toks[:, :-1], "y": toks[:, 1:]}, mesh,
-            spec=models.transformer.batch_spec(cfg),
+            models.transformer.loss_fn(cfg_a, mesh=mesh), opt, mesh=mesh,
+            state_shardings=sh, batch_spec=models.transformer.batch_spec(cfg_a),
         )
         per_kind, unknown = classify(step.lower(state, b).compile().as_text())
         out["cases"][label] = {"per_kind": per_kind, "unparsed": unknown}
