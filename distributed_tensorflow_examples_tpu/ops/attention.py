@@ -1,17 +1,24 @@
-"""Attention ops: reference MHA + ring attention for sequence/context
-parallelism.
+"""Attention ops: reference MHA + BOTH canonical sequence/context-parallel
+layouts — the ring and (r4) Ulysses all-to-all.
 
 No reference analog (SURVEY.md section 5.7: the reference has no attention
 model; its longest-sequence workload scales only by TBPTT unroll).  This is
 the framework's long-context growth path, first-class per the blueprint:
-sequences shard over the mesh ``seq`` axis, and attention runs as a ring —
-each shard keeps its queries local while key/value blocks rotate around the
-axis via ``ppermute`` (one hop per step, riding ICI neighbor links), with the
-online-softmax accumulation of flash attention so no shard ever materialises
-the full [T, T] score matrix.
+sequences shard over the mesh ``seq`` axis, and attention runs either
 
-Numerical contract (tested): ring attention over a seq-sharded mesh ==
-full-sequence attention on one device, for both causal and full attention.
+- as a RING — queries stay local while key/value blocks rotate around the
+  axis via ``ppermute`` (one hop per step, riding ICI neighbor links), with
+  the online-softmax accumulation of flash attention so no shard ever
+  materialises the full [T, T] score matrix; works for any head count — or
+- as ULYSSES all-to-all CP — one ``all_to_all`` per tensor trades the
+  sequence sharding for head sharding, attention runs locally over the
+  full sequence (no cross-hop softmax bookkeeping; the fused flash
+  backward's regime), one ``all_to_all`` back; needs local heads
+  divisible by the seq shards (:func:`ulysses_attention`).
+
+Numerical contract (tested): either layout over a seq-sharded mesh ==
+full-sequence attention on one device, for both causal and full attention,
+values and gradients.
 """
 
 from __future__ import annotations

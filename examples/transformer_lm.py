@@ -4,8 +4,9 @@ The five reference workloads predate attention (SURVEY.md section 5.7); this
 CLI exists to exercise what the reference never could — the long-context and
 model-parallel axes of the framework:
 
-- ``--mesh "data=2,seq=2,model=2"``: data x sequence(ring attention) x
-  tensor(Megatron) parallelism in one run,
+- ``--mesh "data=2,seq=2,model=2"``: data x sequence x tensor(Megatron)
+  parallelism in one run — ring attention by default, or
+  ``--attention=ulysses`` for all-to-all CP (r4),
 - ``--attention flash``: the Pallas flash kernel (O(block) VMEM — sequence
   length bounded by HBM, not by the [T, T] score matrix),
 - the same TrainSession/hooks/checkpoint/preemption machinery as the five
