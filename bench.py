@@ -20,6 +20,43 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
+def _require_devices(timeout_s: int = 480):
+    """jax backend init with a hang watchdog: a dead TPU tunnel makes
+    ``jax.devices()`` block FOREVER in a fresh process (r4 observed a
+    multi-hour outage), which would hang the whole bench run silently.
+    Normal init is seconds; if it exceeds ``timeout_s`` print the one
+    scrapable JSON line as an explicit error record and exit 84."""
+    import threading
+
+    done = threading.Event()
+
+    def _watch():
+        if not done.wait(timeout_s):
+            print(
+                json.dumps(
+                    {
+                        "metric": "error",
+                        "value": 0,
+                        "unit": "none",
+                        "vs_baseline": 0,
+                        "detail": (
+                            f"jax backend init exceeded {timeout_s}s — "
+                            "accelerator tunnel unresponsive; no measurement"
+                        ),
+                    }
+                ),
+                flush=True,
+            )
+            os._exit(84)
+
+    threading.Thread(target=_watch, daemon=True).start()
+    import jax
+
+    devs = jax.devices()
+    done.set()
+    return devs
+
+
 def _bench_step_loop(step_fn, state, batch, *, steps: int, warmup: int):
     """Time the compiled step over an on-device batch.
 
@@ -409,6 +446,7 @@ def main():
     ap.add_argument("--loss-chunks", type=int, default=0)
     ap.add_argument("--n-heads", type=int, default=8)
     args = ap.parse_args()
+    _require_devices()
 
     if args.model == "resnet50":
         # Headline (BASELINE.md): per-chip batch 256 is the measured optimum.
