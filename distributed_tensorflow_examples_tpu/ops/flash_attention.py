@@ -289,6 +289,16 @@ def _bwd(causal, block_q, block_k, res, do):
 #: r3 expected-value analysis funds — BASELINE.md), True/False = force.
 _FUSED_BWD_OVERRIDE: bool | None = None
 
+#: Hardware-validation latch (ADVICE r4 medium): the fused kernel's
+#: running-flush dq scheme depends on Mosaic writing the revisited dq output
+#: window every grid step with last-write-wins ordering — semantics CPU
+#: interpret mode cannot validate.  Until ``tools/flash_parity.py`` has
+#: PASSED on a real chip, auto-dispatch stays on the split kernels; opt in
+#: per-process with DTX_FUSED_BWD=1 (the measurement campaign does, after
+#: running the parity gate first).  Flip to True once BASELINE.md records
+#: the TPU parity + bitwise-determinism pass.
+_FUSED_BWD_VALIDATED = False
+
 #: Upper bound on the fused kernel's [tq, d] f32 dq accumulator (VMEM
 #: scratch).  8 MB = T=16384 at head_dim 128 — beyond that the split
 #: kernels take over (VMEM is ~tens of MB and the s/p tiles need most of
@@ -302,9 +312,25 @@ def _use_fused_bwd(nq: int, nk: int, tq: int, d: int) -> bool:
     VMEM accumulator and nk running dq flushes; it starts paying at
     nq/nk >= 4 — exactly the long-context (T >= 4k per shard at 1024
     tiles) regime the r3 analysis funds.  The T=2048 flagship (nk=2)
-    keeps the split kernels."""
+    keeps the split kernels.
+
+    DTX_FUSED_BWD=0 forces split, =1 opts into the auto regime without the
+    ``_FUSED_BWD_VALIDATED`` latch (read at trace time, like the block-size
+    env vars — one setting per process)."""
+    import os
+
     if _FUSED_BWD_OVERRIDE is not None:
         return _FUSED_BWD_OVERRIDE
+    env = os.environ.get("DTX_FUSED_BWD", "")
+    if env not in ("", "0", "1"):
+        # Same contract as the DTX_FLASH_BQ/BK guard: an A/B typo
+        # (=true, =yes) must not silently record a split-kernel run
+        # under a fused label.
+        raise ValueError(f"DTX_FUSED_BWD={env!r}: must be '0' or '1'")
+    if env == "0":
+        return False
+    if env != "1" and not _FUSED_BWD_VALIDATED:
+        return False
     return nq >= 4 and nk >= 4 and tq * d * 4 <= _FUSED_MAX_ACC_BYTES
 
 
@@ -594,6 +620,18 @@ def flash_attention(
     B, H, T, D = q.shape
     bq = _pick_block(T, block_q)
     bk = _pick_block(T, block_k)
+    if "DTX_FLASH_BQ" in os.environ or "DTX_FLASH_BK" in os.environ:
+        # Env overrides are read at TRACE time and do not key the jit cache:
+        # an in-process sweep that re-sets them silently reuses the first
+        # trace (ADVICE r4).  Each sweep point must be a fresh process
+        # (bench.py is); this line only prints when a trace actually
+        # happens, so a sweep log with a missing line is a stale-cache run.
+        import sys
+
+        print(
+            f"flash_attention: traced with blocks bq={bq} bk={bk} (T={T})",
+            file=sys.stderr,
+        )
     fold = lambda x: x.reshape(B * H, T, D)
     o = _flash_bhd(fold(q), fold(k), fold(v), causal, bq, bk)
     return o.reshape(B, H, T, D)

@@ -62,6 +62,12 @@ class AsyncPSConfig:
     #: the trajectory) is exactly reproducible.  The determinism analog of
     #: the reference harness's fixed-seed async tests; the CLI's
     #: ``--deterministic`` selects it (tests/test_examples_e2e.py W2 gate).
+    #: Resume caveat (ADVICE r4): reproducibility is UNINTERRUPTED-run
+    #: scoped — pending (in-flight) gradients are not checkpointed, so a
+    #: preempted-and-resumed run recomputes them at the restored params and
+    #: diverges bitwise from an uninterrupted run with the same seed.  Two
+    #: runs agree bitwise iff they share the same checkpoint/restart
+    #: schedule.
     fixed_interleave: bool = False
     train_steps: int = 100
     # Checkpoint/resume (SURVEY.md section 5.4: the reference's PS world
@@ -416,8 +422,12 @@ class RemotePSChief(AsyncPSTrainer):
 
     def __init__(
         self, cfg, loss_fn, optimizer, init_params, *,
-        port: int = 0, ps_addr: tuple[str, int] | None = None, **kw,
+        port: int = 0, ps_addr: tuple[str, int] | None = None,
+        listen_all: bool = False, **kw,
     ):
+        """``listen_all``: bind the in-process service on all interfaces
+        (workers on other hosts; unauthenticated — explicit opt-in only,
+        same contract as ``host_ps_task``)."""
         from . import ps_service
 
         if ps_addr is not None:
@@ -425,7 +435,7 @@ class RemotePSChief(AsyncPSTrainer):
             self._client = ps_service.PSClient(ps_addr[0], ps_addr[1])
             self._owns_server = False
         else:
-            self.port = ps_service.start_server(port)
+            self.port = ps_service.start_server(port, loopback_only=not listen_all)
             self._client = ps_service.PSClient("127.0.0.1", self.port)
             self._owns_server = True
         super().__init__(cfg, loss_fn, optimizer, init_params, **kw)

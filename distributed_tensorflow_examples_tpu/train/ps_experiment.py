@@ -174,6 +174,30 @@ def _ps_cfg(FLAGS, mode: str, n_workers: int):
     )
 
 
+def _resolve_listen_all(FLAGS, host: str) -> bool:
+    """Network exposure is an explicit operator decision (--ps_listen_all),
+    never inferred from how the hostname is spelled: '::1' or a
+    loopback-resolving FQDN must not silently bind INADDR_ANY, and a
+    non-loopback entry without the flag is a launch error, not a silent
+    network-wide bind of an unauthenticated service (ADVICE r4).  Applies
+    to BOTH service-hosting paths: the dedicated PS task and the
+    chief-hosted (--ps_tasks=0) service."""
+    listen_all = bool(getattr(FLAGS, "ps_listen_all", False))
+    if not listen_all and host not in ("127.0.0.1", "localhost"):
+        raise ValueError(
+            f"--ps_hosts entry {host!r} is not a literal loopback "
+            "address; serving other hosts needs the unauthenticated "
+            "state service bound on all interfaces — opt in explicitly "
+            "with --ps_listen_all (trusted networks only)"
+        )
+    if listen_all:
+        log.warning(
+            "--ps_listen_all: PS state service binding ALL interfaces "
+            "(UNAUTHENTICATED — trusted networks only)"
+        )
+    return listen_all
+
+
 def _probe_ps(host: str, port: int, deadline_s: float) -> bool:
     """True when a PS service answers PING at host:port within the window."""
     from ..parallel import ps_service
@@ -246,9 +270,8 @@ def run_ps_cluster_task(
         my_host, my_port = entries[
             min(FLAGS.task_index, len(entries) - 1)
         ].rsplit(":", 1)
-        bound = async_ps.host_ps_task(
-            int(my_port), loopback_only=my_host in ("127.0.0.1", "localhost")
-        )
+        listen_all = _resolve_listen_all(FLAGS, my_host)
+        bound = async_ps.host_ps_task(int(my_port), loopback_only=not listen_all)
         print(f"PS_DONE port={bound}")
         return None
 
@@ -267,19 +290,33 @@ def run_ps_cluster_task(
             mode, n_workers, host, port,
             "hosted in-process" if chief_hosts_service else "external PS task",
         )
+        # Scrapable platform record: tools/ps_tpu_smoke.py asserts the chief
+        # genuinely ran the accelerator plugin (not a silent CPU fallback).
+        print(f"CHIEF_PLATFORM={jax.devices()[0].platform}", flush=True)
         trainer = async_ps.RemotePSChief(
             acfg, loss_fn, optimizer, params,
             model_state=model_state,
             rng=jax.random.key(FLAGS.seed),
-            **({"port": port} if chief_hosts_service else {"ps_addr": (host, port)}),
+            **(
+                # Chief-hosted service: same explicit-exposure contract as
+                # the dedicated PS task (code-review r5).
+                {"port": port, "listen_all": _resolve_listen_all(FLAGS, host)}
+                if chief_hosts_service
+                else {"ps_addr": (host, port)}
+            ),
         )
         t0 = time.perf_counter()
         final_params = trainer.run_chief()
         dt = time.perf_counter() - t0
         metrics = eval_fn(final_params) if eval_fn is not None else {}
+        # Same examples_per_sec_per_chip DEFINITION as the thread-emulation
+        # path: divide by the chief's device count (ADVICE r4 — one scrapable
+        # field name must not carry two definitions across the PS modes).
+        sps = trainer.global_step / dt if dt > 0 else 0.0
         _print_final(
             step=trainer.global_step, dt=dt, local_bs=local_bs,
             mode=f"{mode}_cluster", metrics=metrics,
+            eps_per_chip=sps * local_bs / max(1, len(jax.devices())),
             extra={"workers": n_workers, "stale_dropped": trainer.total_dropped},
         )
         return final_params

@@ -132,6 +132,16 @@ def define_legacy_cluster_flags():
         "service in-process).",
     )
     _define(
+        "bool",
+        "ps_listen_all",
+        False,
+        "Bind the (unauthenticated) PS state service on ALL interfaces so "
+        "workers on other hosts can reach it.  Off = loopback only.  "
+        "Required whenever the task's --ps_hosts entry is not a literal "
+        "loopback address — network exposure must be an explicit operator "
+        "decision, never inferred from hostname spelling (ADVICE r4).",
+    )
+    _define(
         "integer",
         "replicas_to_aggregate",
         0,

@@ -288,3 +288,30 @@ def test_async_fixed_interleave_rejects_starving_staleness():
     )
     with pytest.raises(ValueError, match="starve"):
         tr.run([_blob_batches(1), _blob_batches(2), _blob_batches(3)])
+
+
+def test_ps_task_nonloopback_requires_explicit_listen_all():
+    """ADVICE r4: network exposure of the unauthenticated PS service must be
+    an explicit operator decision (--ps_listen_all), never inferred from
+    hostname spelling — '::1', 'localhost.localdomain', or any non-literal
+    loopback entry without the flag is a launch ERROR, not a silent
+    INADDR_ANY bind."""
+    from types import SimpleNamespace
+
+    from distributed_tensorflow_examples_tpu.train import ps_experiment
+
+    def flags(host, listen_all):
+        return SimpleNamespace(
+            ps_hosts=f"{host}:7777", worker_hosts="a:1,b:1", job_name="ps",
+            task_index=0, batch_size=8, train_steps=1, log_dir="",
+            checkpoint_every_steps=50, replicas_to_aggregate=0,
+            max_staleness=0, deterministic=False, ps_tasks=-1, seed=0,
+            ps_listen_all=listen_all,
+        )
+
+    for host in ("::1", "localhost.localdomain", "10.0.0.5"):
+        with pytest.raises(ValueError, match="ps_listen_all"):
+            ps_experiment.run_ps_cluster_task(
+                init_fn=None, loss_fn=None, optimizer=None,
+                batches_for_worker=None, FLAGS=flags(host, False), mode="async",
+            )

@@ -110,15 +110,42 @@ def test_fused_bwd_deterministic(monkeypatch):
         assert np.array_equal(np.asarray(x), np.asarray(y))
 
 
-def test_fused_bwd_dispatch_gate():
+def test_fused_bwd_dispatch_gate(monkeypatch):
+    from distributed_tensorflow_examples_tpu.ops import flash_attention as F
     from distributed_tensorflow_examples_tpu.ops.flash_attention import _use_fused_bwd
 
+    # With the hardware-validation latch open, the nq/nk >= 4 regime gate:
+    monkeypatch.delenv("DTX_FUSED_BWD", raising=False)
+    monkeypatch.setattr(F, "_FUSED_BWD_VALIDATED", True)
     assert _use_fused_bwd(4, 4, 4096, 128)
     assert _use_fused_bwd(16, 16, 16384, 128)
     assert not _use_fused_bwd(2, 2, 2048, 128)   # T=2048 flagship @1024 tiles
     assert not _use_fused_bwd(8, 2, 8192, 128)
     # VMEM cap on the [tq, d] accumulator: T=32768 @ d=128 stays split.
     assert not _use_fused_bwd(32, 32, 32768, 128)
+    # DTX_FUSED_BWD=0 forces split even when the latch is open:
+    monkeypatch.setenv("DTX_FUSED_BWD", "0")
+    assert not _use_fused_bwd(4, 4, 4096, 128)
+
+
+def test_fused_bwd_validation_latch(monkeypatch):
+    """ADVICE r4 (medium): until tools/flash_parity.py passes on real
+    Mosaic, the in-regime shapes must NOT auto-dispatch to the fused kernel
+    — opt-in is per-process via DTX_FUSED_BWD=1 (what the measurement
+    campaign sets after running the parity gate)."""
+    from distributed_tensorflow_examples_tpu.ops import flash_attention as F
+    from distributed_tensorflow_examples_tpu.ops.flash_attention import _use_fused_bwd
+
+    monkeypatch.setattr(F, "_FUSED_BWD_VALIDATED", False)
+    monkeypatch.delenv("DTX_FUSED_BWD", raising=False)
+    assert not _use_fused_bwd(4, 4, 4096, 128)
+    monkeypatch.setenv("DTX_FUSED_BWD", "1")
+    assert _use_fused_bwd(4, 4, 4096, 128)
+    assert not _use_fused_bwd(2, 2, 2048, 128)  # opt-in keeps the regime gate
+    # The explicit override (tests, flash_bench --fused) beats everything:
+    monkeypatch.setenv("DTX_FUSED_BWD", "0")
+    monkeypatch.setattr(F, "_FUSED_BWD_OVERRIDE", True)
+    assert _use_fused_bwd(2, 2, 2048, 128)
 
 
 def test_fused_bwd_bf16_matches_split(monkeypatch):
