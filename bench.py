@@ -355,7 +355,10 @@ def bench_word2vec(steps: int, batch_per_chip: int):
     )
 
 
-def bench_decode(batch_per_chip: int, prompt_len: int = 32, new_tokens: int = 256):
+def bench_decode(
+    batch_per_chip: int, prompt_len: int = 32, new_tokens: int = 256,
+    variant: str = "dense",
+):
     """Inference surface: KV-cache autoregressive decode throughput on the
     flagship config (tokens/sec/chip; the whole decode loop is ONE jitted
     lax.scan, so the tunnel dispatch amortises over every position).
@@ -365,7 +368,23 @@ def bench_decode(batch_per_chip: int, prompt_len: int = 32, new_tokens: int = 25
     prompt_len - 1 + new_tokens of them) — the number bandwidth math must
     use; the headline tokens/s counts only the new_tokens actually
     produced.
+
+    ``variant`` (VERDICT r4 #5 — the r4 serving paths need tokens/s rows):
+    - ``dense``: the flagship config (the r2 row).
+    - ``moe``: same dims with E=8 top-2 GShard FFNs — decode routes each
+      position through the SAME dispatch/combine einsums as training
+      (models/transformer.py _block_decode), so this prices MoE serving's
+      per-token routing overhead against the dense row.
+    - ``pipeline``: a pipeline-trained checkpoint (stacked ``blocks``
+      layout, stages=4) collapsed to the flat serving layout via
+      ``collapse_pipeline`` and decoded through the ordinary KV-cache path
+      — a pipelined decode would bubble O(stages) per token at T=1, so
+      serving collapses the stages; weights are bit-identical, and the row
+      should match ``dense`` (the measurement proves the path, the parity
+      test proves the weights).
     """
+    import dataclasses
+
     import jax
     import numpy as np
 
@@ -374,8 +393,14 @@ def bench_decode(batch_per_chip: int, prompt_len: int = 32, new_tokens: int = 25
     cfg = models.transformer.Config(
         vocab_size=32000, dim=1024, n_layers=12, n_heads=8,
         max_seq_len=prompt_len + new_tokens,
+        moe_experts=8 if variant == "moe" else 0, moe_top_k=2,
     )
-    params = models.transformer.init(cfg, jax.random.key(0))
+    if variant == "pipeline":
+        train_cfg = dataclasses.replace(cfg, pipeline_stages=4, microbatches=4)
+        stacked = models.transformer.init(train_cfg, jax.random.key(0))
+        cfg, params = models.transformer.collapse_pipeline(train_cfg, stacked)
+    else:
+        params = models.transformer.init(cfg, jax.random.key(0))
     rng = np.random.default_rng(0)
     prompt = rng.integers(0, cfg.vocab_size, size=(batch_per_chip, prompt_len)).astype("int32")
     out = models.transformer.generate(cfg, params, prompt, max_new_tokens=new_tokens)
@@ -389,7 +414,7 @@ def bench_decode(batch_per_chip: int, prompt_len: int = 32, new_tokens: int = 25
     positions = prompt_len - 1 + new_tokens
     tps = batch_per_chip * new_tokens / best
     return {
-        "model": "decode",
+        "model": "decode" if variant == "dense" else f"decode_{variant}",
         "images_per_sec": tps,
         "images_per_sec_per_chip": tps,
         "n_chips": 1,
@@ -422,6 +447,8 @@ def bench_mlp(steps: int, batch_per_chip: int):
 
 _UNITS = {
     "decode": "tokens/sec/chip",
+    "decode_moe": "tokens/sec/chip",
+    "decode_pipeline": "tokens/sec/chip",
     "resnet50": "images/sec/chip",
     "mnist_mlp": "images/sec/chip",
     "transformer": "tokens/sec/chip",
@@ -445,6 +472,11 @@ def main():
     # dense loss (loss_chunks is the fit-bigger knob, not a throughput one).
     ap.add_argument("--loss-chunks", type=int, default=0)
     ap.add_argument("--n-heads", type=int, default=8)
+    ap.add_argument(
+        "--decode-variant", choices=["dense", "moe", "pipeline"], default="dense",
+        help="--model decode: dense flagship, MoE (E=8 top-2 routed per "
+        "position), or pipeline-trained checkpoint collapsed for serving",
+    )
     args = ap.parse_args()
     _require_devices()
 
@@ -466,7 +498,8 @@ def main():
         # --seq-len maps to the decode budget: prompt 32 + the rest new.
         total = args.seq_len or (32 + 256)
         r = bench_decode(
-            args.batch_per_chip or 8, prompt_len=32, new_tokens=total - 32
+            args.batch_per_chip or 8, prompt_len=32, new_tokens=total - 32,
+            variant=args.decode_variant,
         )
     elif args.model == "lstm":
         r = bench_lstm(args.steps or 50, args.batch_per_chip or 256, args.seq_len or 20)

@@ -1,0 +1,223 @@
+"""One-command TPU measurement campaign (VERDICT r4 next-round #7).
+
+The r4 lesson: hardware windows are scarce and perishable — the tunnel died
+mid-round and every queued measurement was lost.  This driver converts any
+~45-minute window into a complete round: it (optionally) waits for the
+tunnel, then runs the full BASELINE.md measurement agenda serially — each
+step a FRESH process (the block-size/fused env knobs are read at trace
+time, so sweep points must not share a jit cache — ADVICE r4) with its own
+timeout — and appends machine-readable results to the out-file after every
+step, so a mid-campaign wedge loses nothing already measured.
+
+Order (by value — the r4 perf agenda first):
+  1.  flash_parity        fused-vs-split bwd parity + determinism ON TPU
+                          (the advisor's Mosaic-risk gate: FAIL -> every
+                          later step runs with DTX_FUSED_BWD=0)
+  2.  bench T=8192 fused / split end-to-end A/B, block sweeps
+  3.  flash_bench kernel-table rows T=8192/16384 x fused 0/1
+  4.  batch-4 via --loss-chunks 8
+  5.  MoE bench + dispatch-share profile
+  6.  headline re-measures (resnet, T=2048 flagship)
+  7.  comms_scaling --measure (Ulysses t_step columns)
+  8.  ulysses_ab (single-chip CP compute A/B)
+  9.  decode rows: dense / moe / collapsed-pipeline
+  10. T=16384 flagship (the fused kernel's deep regime)
+  11. ps_tpu_smoke (chief-on-TPU PS cluster)
+
+Usage:
+  python tools/measure_campaign.py --wait          # poll until tunnel live
+  python tools/measure_campaign.py                 # run now (probe once)
+  python tools/measure_campaign.py --only bench_t8192_fused,flash_parity
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+def probe(timeout_s: int = 150) -> bool:
+    """True when the accelerator backend initialises in a fresh process.
+    One short-lived probe at a time (a pile of hung clients can extend a
+    tunnel wedge)."""
+    try:
+        r = subprocess.run(
+            [PY, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s, cwd=ROOT,
+        )
+        return r.returncode == 0 and r.stdout.strip() != ""
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def last_json_line(text: str):
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def steps_plan() -> list[dict]:
+    """The ordered agenda.  '{FUSED}' env placeholders are substituted at
+    run time with the flash_parity outcome ('1' pass / '0' fail)."""
+    bench = [PY, "bench.py"]
+    t8192 = bench + ["--model", "transformer", "--seq-len", "8192", "--batch-per-chip", "2"]
+    fb = [PY, "tools/flash_bench.py", "--b", "1", "--h", "8", "--d", "128", "--markdown"]
+    plan = [
+        dict(name="flash_parity", cmd=[PY, "tools/flash_parity.py"], timeout=1500),
+        dict(name="bench_t8192_fused", cmd=t8192, env={"DTX_FUSED_BWD": "{FUSED}"}, timeout=1500),
+        dict(name="bench_t8192_split", cmd=t8192, env={"DTX_FUSED_BWD": "0"}, timeout=1500),
+        dict(name="bench_t8192_bq512_bk512", cmd=t8192,
+             env={"DTX_FUSED_BWD": "{FUSED}", "DTX_FLASH_BQ": "512", "DTX_FLASH_BK": "512"}, timeout=1200),
+        dict(name="bench_t8192_bq512_bk1024", cmd=t8192,
+             env={"DTX_FUSED_BWD": "{FUSED}", "DTX_FLASH_BQ": "512", "DTX_FLASH_BK": "1024"}, timeout=1200),
+        dict(name="bench_t8192_bq1024_bk512", cmd=t8192,
+             env={"DTX_FUSED_BWD": "{FUSED}", "DTX_FLASH_BQ": "1024", "DTX_FLASH_BK": "512"}, timeout=1200),
+        # The --fused 1 rows force the kernel via the explicit override —
+        # deliberate even after a parity failure (they are diagnostic A/B
+        # rows labeled f1, and state['fused_gate'] sits next to them in the
+        # results file); everything that MEASURES A WORKLOAD (bench_*,
+        # ulysses_ab) respects the '{FUSED}' gate instead.
+        dict(name="flash_bench_t8192_f0", cmd=fb + ["--t", "8192", "--fused", "0"], timeout=1200),
+        dict(name="flash_bench_t8192_f1", cmd=fb + ["--t", "8192", "--fused", "1"], timeout=1200),
+        dict(name="flash_bench_t16384_f0", cmd=fb + ["--t", "16384", "--fused", "0"], timeout=1200),
+        dict(name="flash_bench_t16384_f1", cmd=fb + ["--t", "16384", "--fused", "1"], timeout=1200),
+        dict(name="bench_t8192_b4_chunks", cmd=bench + [
+            "--model", "transformer", "--seq-len", "8192",
+            "--batch-per-chip", "4", "--loss-chunks", "8",
+        ], env={"DTX_FUSED_BWD": "{FUSED}"}, timeout=1500),
+        dict(name="bench_moe", cmd=bench + ["--model", "moe"], timeout=1500),
+        dict(name="profile_moe", cmd=[PY, "tools/profile_step.py", "--model", "moe"], timeout=1500),
+        dict(name="bench_resnet", cmd=bench[:], timeout=1500),
+        dict(name="bench_t2048", cmd=bench + ["--model", "transformer"], timeout=1200),
+        dict(name="comms_measure", cmd=[PY, "tools/comms_scaling.py", "--measure"], timeout=2400),
+        dict(name="ulysses_ab", cmd=[PY, "tools/ulysses_ab.py"],
+             env={"DTX_FUSED_BWD": "{FUSED}"}, timeout=1500),
+        dict(name="bench_decode", cmd=bench + ["--model", "decode"], timeout=1200),
+        dict(name="bench_decode_moe", cmd=bench + ["--model", "decode", "--decode-variant", "moe"], timeout=1500),
+        dict(name="bench_decode_pipeline", cmd=bench + ["--model", "decode", "--decode-variant", "pipeline"], timeout=1500),
+        dict(name="bench_t16384", cmd=bench + [
+            "--model", "transformer", "--seq-len", "16384",
+            "--batch-per-chip", "1", "--loss-chunks", "16",
+        ], env={"DTX_FUSED_BWD": "{FUSED}"}, timeout=1800, optional=True),
+        dict(name="ps_tpu_smoke", cmd=[PY, "tools/ps_tpu_smoke.py"], timeout=1100),
+    ]
+    return plan
+
+
+def run_step(step: dict, fused_env: str) -> dict:
+    step = dict(step)
+    step["env"] = {
+        k: (fused_env if v == "{FUSED}" else v)
+        for k, v in step.get("env", {}).items()
+    }
+    env = dict(os.environ)
+    env.update(step["env"])
+    t0 = time.time()
+    timed_out = False
+    try:
+        r = subprocess.run(
+            step["cmd"], capture_output=True, text=True,
+            timeout=step["timeout"], cwd=ROOT, env=env,
+        )
+        rc, out, err = r.returncode, r.stdout, r.stderr
+    except subprocess.TimeoutExpired as e:
+        timed_out = True
+        rc = -9
+        out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+    dt = time.time() - t0
+    rec = {
+        "name": step["name"],
+        "cmd": " ".join(step["cmd"][1:]) if step["cmd"][0] == PY else " ".join(step["cmd"]),
+        "env": step.get("env", {}),
+        "rc": rc,
+        "timed_out": timed_out,
+        "seconds": round(dt, 1),
+        "json": last_json_line(out),
+        "stdout_tail": out[-4000:],
+        "stderr_tail": err[-2500:],
+    }
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(ROOT, "CAMPAIGN_r05.json"))
+    ap.add_argument("--wait", action="store_true", help="poll until the tunnel answers")
+    ap.add_argument("--poll-s", type=int, default=600)
+    ap.add_argument("--max-wait-h", type=float, default=11.0)
+    ap.add_argument("--only", default="", help="comma list of step names")
+    args = ap.parse_args()
+
+    state = {"started": time.strftime("%Y-%m-%dT%H:%M:%S"), "status": "waiting", "steps": []}
+
+    def flush():
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=1)
+        os.replace(tmp, args.out)
+
+    flush()
+    deadline = time.time() + args.max_wait_h * 3600
+    alive = probe()
+    while not alive and args.wait and time.time() < deadline:
+        print(f"[campaign] tunnel dead; retry in {args.poll_s}s", flush=True)
+        time.sleep(args.poll_s)
+        alive = probe()
+    if not alive:
+        state["status"] = "tunnel_dead"
+        flush()
+        print("[campaign] no hardware — wrote status=tunnel_dead", flush=True)
+        sys.exit(84)
+
+    state["status"] = "running"
+    flush()
+
+    # Step 1 resolves the fused gate for everything after it.
+    fused_env = "0"
+    only = {s for s in args.only.split(",") if s}
+    for step in steps_plan():
+        if only and step["name"] not in only:
+            continue
+        print(f"[campaign] step {step['name']} ...", flush=True)
+        rec = run_step(step, fused_env)
+        state["steps"].append(rec)
+        flush()
+        print(f"[campaign]   rc={rec['rc']} {rec['seconds']}s", flush=True)
+        if step["name"] == "flash_parity":
+            fused_env = "1" if rec["rc"] == 0 else "0"
+            state["fused_gate"] = fused_env
+            flush()
+        if rec["timed_out"]:
+            # A killed TPU job can wedge the tunnel (r4): probe-wait before
+            # piling more jobs on; give up after ~30 min of dead probes.
+            ok = False
+            for _ in range(6):
+                time.sleep(300)
+                if probe():
+                    ok = True
+                    break
+            if not ok:
+                state["status"] = "wedged_after_" + step["name"]
+                flush()
+                print("[campaign] tunnel wedged; partial results kept", flush=True)
+                sys.exit(85)
+    state["status"] = "complete"
+    flush()
+    print("[campaign] complete", flush=True)
+
+
+if __name__ == "__main__":
+    main()
