@@ -119,7 +119,7 @@ def _workloads(n: int):
             init_kwargs=lambda dp, per_chip: {"batch_size": per_chip * dp},
         ),
         "transformer": dict(
-            mesh={"data": n // tp // (2 if n >= 16 else 1), "seq": (2 if n >= 16 else 1), "model": tp},
+            mesh={"data": n // tp // (2 if n >= 8 else 1), "seq": (2 if n >= 8 else 1), "model": tp},
             model=models.transformer,
             cfg=models.transformer.Config(
                 vocab_size=8192, dim=256, n_layers=2, n_heads=8,
@@ -136,8 +136,10 @@ def _workloads(n: int):
         "transformer_ulysses": dict(
             # All-to-all CP (r4): same mesh family as the ring transformer,
             # but the seq reshard moves activations by all_to_all instead
-            # of rotating k/v by collective-permute.
-            mesh={"data": n // tp // (2 if n >= 16 else 1), "seq": (2 if n >= 16 else 1), "model": tp},
+            # of rotating k/v by collective-permute.  seq=2 from N=8 up —
+            # a seq=1 row would be bit-identical to the ring row and
+            # compare nothing (VERDICT r4 weak #2).
+            mesh={"data": n // tp // (2 if n >= 8 else 1), "seq": (2 if n >= 8 else 1), "model": tp},
             model=models.transformer,
             cfg=models.transformer.Config(
                 vocab_size=8192, dim=256, n_layers=2, n_heads=8,
