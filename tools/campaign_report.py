@@ -1,0 +1,71 @@
+"""Render CAMPAIGN_r05.json into BASELINE.md-ready markdown.
+
+The campaign writes raw per-step records (tools/measure_campaign.py); this
+turns them into the tables/sentences BASELINE.md wants, so the scarce
+minutes after a hardware window close on bookkeeping, not reformatting.
+
+Usage: python tools/campaign_report.py [CAMPAIGN_r05.json]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fmt_bench(rec: dict) -> str:
+    j = rec.get("json") or {}
+    d = j.get("detail", {})
+    if not j:
+        return f"- `{rec['name']}`: NO JSON (rc={rec['rc']}, {rec['seconds']}s)"
+    mfu = d.get("mfu")
+    mfu_s = f", {mfu*100:.1f}% MFU" if isinstance(mfu, (int, float)) else ""
+    env = " ".join(f"{k}={v}" for k, v in rec.get("env", {}).items())
+    return (
+        f"- `{rec['name']}`: **{j.get('value')} {j.get('unit')}**{mfu_s} "
+        f"(vs_baseline {j.get('vs_baseline')}; {env or 'default env'}; "
+        f"{rec['seconds']}s wall)"
+    )
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(ROOT, "CAMPAIGN_r05.json")
+    with open(path) as f:
+        state = json.load(f)
+    print(f"# Campaign report — started {state.get('started')}, status {state.get('status')}")
+    print(f"fused gate after parity: DTX_FUSED_BWD={state.get('fused_gate', '?')}\n")
+    for rec in state.get("steps", []):
+        name = rec["name"]
+        ok = "ok" if rec["rc"] == 0 else f"FAILED rc={rec['rc']}" + (" (timeout)" if rec.get("timed_out") else "")
+        if name.startswith("bench_"):
+            print(fmt_bench(rec))
+        elif name == "flash_parity":
+            j = rec.get("json") or {}
+            print(f"- `flash_parity` [{ok}]: parity_ok={j.get('parity_ok')} platform={j.get('platform')}")
+            for c in j.get("cases", []):
+                print(f"    - {c.get('shape')} {c.get('dtype')} causal={c.get('causal')}: "
+                      f"ok={c.get('ok')} bitwise={c.get('bitwise_deterministic')} "
+                      f"dq_rel={c.get('dq_vs_split_rel')}")
+        elif name == "ulysses_ab":
+            j = rec.get("json") or {}
+            print(f"- `ulysses_ab` [{ok}] fused_env={j.get('fused_env')}:")
+            for r in j.get("rows", []):
+                print(f"    - sp={r['sp']}: ulysses {r['t_ulysses_ms']} ms vs "
+                      f"ring >= {r['t_ring_ms']} ms (ratio >= {r['ring_over_ulysses']})")
+        elif name == "ps_tpu_smoke":
+            j = rec.get("json") or {}
+            print(f"- `ps_tpu_smoke` [{ok}]: chief_platform={j.get('chief_platform')} "
+                  f"final={j.get('final')}")
+        else:
+            # flash_bench / profile / comms: markdown or text — show the tail.
+            print(f"- `{name}` [{ok}] ({rec['seconds']}s):")
+            for line in (rec.get("stdout_tail") or "").splitlines()[-14:]:
+                print(f"    {line}")
+    print()
+
+
+if __name__ == "__main__":
+    main()

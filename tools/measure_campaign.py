@@ -159,9 +159,25 @@ def main():
     ap.add_argument("--poll-s", type=int, default=600)
     ap.add_argument("--max-wait-h", type=float, default=11.0)
     ap.add_argument("--only", default="", help="comma list of step names")
+    ap.add_argument(
+        "--resume", action="store_true",
+        help="keep the out-file's succeeded steps and run only the rest — "
+        "a wedge mid-campaign must not cost the measurements already taken",
+    )
     args = ap.parse_args()
 
     state = {"started": time.strftime("%Y-%m-%dT%H:%M:%S"), "status": "waiting", "steps": []}
+    succeeded: set[str] = set()
+    if args.resume and os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                prev = json.load(f)
+            state["steps"] = [r for r in prev.get("steps", []) if r.get("rc") == 0]
+            succeeded = {r["name"] for r in state["steps"]}
+            state["resumed_from"] = prev.get("started")
+            print(f"[campaign] resuming; keeping {sorted(succeeded)}", flush=True)
+        except (json.JSONDecodeError, OSError) as e:
+            print(f"[campaign] resume failed ({e}); starting fresh", flush=True)
 
     def flush():
         tmp = args.out + ".tmp"
@@ -186,10 +202,12 @@ def main():
     flush()
 
     # Step 1 resolves the fused gate for everything after it.
-    fused_env = "0"
+    fused_env = "1" if "flash_parity" in succeeded else "0"
     only = {s for s in args.only.split(",") if s}
     for step in steps_plan():
         if only and step["name"] not in only:
+            continue
+        if step["name"] in succeeded:
             continue
         print(f"[campaign] step {step['name']} ...", flush=True)
         rec = run_step(step, fused_env)

@@ -57,7 +57,7 @@ def grad_time(b, h, t, d, *, steps: int) -> float:
     kernels rather than citing a kernel just proven broken."""
     from distributed_tensorflow_examples_tpu.ops import flash_attention as F
 
-    assert F._FUSED_BWD_OVERRIDE is None
+    F._FUSED_BWD_OVERRIDE = None  # auto: DTX_FUSED_BWD decides
     q, k, v = _qkv(b, h, t, d)
     g = jax.jit(
         jax.grad(
