@@ -244,6 +244,7 @@ def bench_resnet50(steps: int, batch_per_chip: int, image_size: int = 224):
 def bench_transformer(
     steps: int, batch_per_chip: int, seq_len: int = 2048, remat: bool = False,
     loss_chunks: int = 0, n_heads: int = 8, experts: int = 0, top_k: int = 2,
+    moe_group_size: int = 1024,
 ):
     """Transformer LM tokens/sec/chip + MFU (flash attention on TPU).
 
@@ -267,7 +268,7 @@ def bench_transformer(
     cfg = models.transformer.Config(
         vocab_size=32000, dim=1024, n_layers=12, n_heads=n_heads,
         max_seq_len=seq_len, remat=remat, loss_chunks=loss_chunks,
-        moe_experts=experts, moe_top_k=top_k,
+        moe_experts=experts, moe_top_k=top_k, moe_group_size=moe_group_size,
     )
 
     def make_batch(rng: np.random.Generator, n: int):
@@ -473,6 +474,12 @@ def main():
     ap.add_argument("--loss-chunks", type=int, default=0)
     ap.add_argument("--n-heads", type=int, default=8)
     ap.add_argument(
+        "--moe-group-size", type=int, default=1024,
+        help="--model moe: GShard routing-group size G — the dispatch-share "
+        "knob (dispatch FLOPs/token ~ G); sweep if profile shows dispatch "
+        "einsums above the ~15%% budget",
+    )
+    ap.add_argument(
         "--decode-variant", choices=["dense", "moe", "pipeline"], default="dense",
         help="--model decode: dense flagship, MoE (E=8 top-2 routed per "
         "position), or pipeline-trained checkpoint collapsed for serving",
@@ -493,6 +500,7 @@ def main():
             args.steps or 10, args.batch_per_chip or 4,
             seq_len=args.seq_len or 2048, remat=args.remat,
             loss_chunks=args.loss_chunks, n_heads=args.n_heads,
+            moe_group_size=args.moe_group_size,
         )
     elif args.model == "decode":
         # --seq-len maps to the decode budget: prompt 32 + the rest new.

@@ -322,3 +322,23 @@ def test_moe_composes_with_ulysses():
     assert s.get("all-to-all", {}).get("count", 0) >= 2, sorted(s)
     state, m = compiled(state, batch)
     assert np.isfinite(float(m["loss"])), m
+
+
+def test_moe_group_size_plumbs_from_transformer_config():
+    """r5: Config.moe_group_size reaches ops.moe.MoEConfig (the dispatch-
+    share knob the campaign sweeps) — and both group sizes train finite."""
+    import numpy as np
+
+    from distributed_tensorflow_examples_tpu import models
+    from distributed_tensorflow_examples_tpu.models.transformer import _moe_cfg
+
+    for g in (32, 64):
+        cfg = models.transformer.Config(
+            vocab_size=64, dim=32, n_layers=1, n_heads=4, max_seq_len=64,
+            compute_dtype="float32", moe_experts=4, moe_group_size=g,
+        )
+        assert _moe_cfg(cfg).group_size == g
+        p = models.transformer.init(cfg, jax.random.key(0))
+        batch = {"x": np.zeros((2, 64), np.int32), "y": np.zeros((2, 64), np.int32)}
+        loss, _ = models.transformer.loss_fn(cfg)(p, None, batch, jax.random.key(1))
+        assert np.isfinite(float(loss))

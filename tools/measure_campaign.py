@@ -99,6 +99,11 @@ def steps_plan() -> list[dict]:
         ], env={"DTX_FUSED_BWD": "{FUSED}"}, timeout=1500),
         dict(name="bench_moe", cmd=bench + ["--model", "moe"], timeout=1500),
         dict(name="profile_moe", cmd=[PY, "tools/profile_step.py", "--model", "moe"], timeout=1500),
+        # Dispatch-share lever A/B: G=512 halves dispatch FLOPs/token vs the
+        # G=1024 default (capacity semantics change with G — this is a
+        # throughput A/B, not a parity pair).
+        dict(name="bench_moe_g512", cmd=bench + ["--model", "moe", "--moe-group-size", "512"],
+             timeout=1500, optional=True),
         dict(name="bench_resnet", cmd=bench[:], timeout=1500),
         dict(name="bench_t2048", cmd=bench + ["--model", "transformer"], timeout=1200),
         dict(name="comms_measure", cmd=[PY, "tools/comms_scaling.py", "--measure"], timeout=2400),

@@ -40,6 +40,16 @@ def _final(out: str) -> dict:
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="4-process PS cluster, chief on the real chip — run "
+        "ONLY via the measurement campaign (one TPU process at a time). "
+        "Spawns real training processes; --help must never start them."
+    )
+    ap.add_argument("--train-steps", type=int, default=40)
+    args = ap.parse_args()
+
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
@@ -57,7 +67,7 @@ def main():
     common = [
         "--ps_emulation",
         "--batch_size=128",
-        "--train_steps=40",
+        f"--train_steps={args.train_steps}",
         f"--ps_hosts=127.0.0.1:{port}",
         "--worker_hosts=wh0:1,wh1:1",
         f"--log_dir={log_dir}",
