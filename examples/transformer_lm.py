@@ -78,6 +78,12 @@ flags.DEFINE_integer(
     "top-2 routing, Switch aux loss.",
 )
 flags.DEFINE_float("moe_capacity_factor", 1.25, "Expert capacity factor.")
+flags.DEFINE_integer(
+    "moe_group_size",
+    1024,
+    "GShard routing-group size G (dispatch FLOPs/token ~ G; capacity is "
+    "per-group) — the dispatch-share knob, see bench.py --moe-group-size.",
+)
 
 FLAGS = flags.FLAGS
 
@@ -121,6 +127,7 @@ def main(argv):
         microbatches=FLAGS.microbatches,
         moe_experts=FLAGS.moe_experts,
         moe_capacity_factor=FLAGS.moe_capacity_factor,
+        moe_group_size=FLAGS.moe_group_size,
         remat=FLAGS.remat,
         loss_chunks=FLAGS.loss_chunks,
     )
