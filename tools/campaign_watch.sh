@@ -1,0 +1,11 @@
+#!/bin/bash
+# Self-restarting campaign watcher: survives wedges (rc 85 -> resume after a
+# cooldown), crashes (resume), and --wait timeouts (loop keeps waiting).
+cd /root/repo
+while true; do
+  python tools/measure_campaign.py --wait --resume --poll-s 480
+  rc=$?
+  echo "[watch] campaign exited rc=$rc at $(date -u +%H:%M:%S)"
+  [ "$rc" -eq 0 ] && break
+  sleep 600
+done
