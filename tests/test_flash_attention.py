@@ -174,3 +174,45 @@ def test_fused_bwd_bf16_matches_split(monkeypatch):
             np.asarray(gf, dtype=np.float32), np.asarray(gs, dtype=np.float32),
             rtol=0.05, atol=0.05,
         )
+
+
+def test_fused_bwd_regime_shape_sweep(monkeypatch):
+    """r5 hardening before the hardware window: fused-vs-reference parity
+    across the dispatch regime's corners — uneven nq != nk grids, rectangular
+    blocks, both dtypes — in one bounded test.  The fixed-shape parity tests
+    cover the center of the regime; the corners are where a grid-indexing
+    bug in the running-flush dq scheme would hide."""
+    from distributed_tensorflow_examples_tpu.ops import flash_attention as F
+
+    monkeypatch.setattr(F, "_FUSED_BWD_OVERRIDE", True)
+    cases = [
+        # (t, d, bq, bk, causal, dtype): nq=t/bq, nk=t/bk — all >= 4
+        (128, 8, 32, 16, True, jnp.float32),    # nq=4, nk=8 (rectangular)
+        (128, 8, 16, 32, False, jnp.float32),   # nq=8, nk=4
+        (256, 16, 32, 32, True, jnp.float32),   # nq=nk=8
+        (192, 8, 48, 16, True, jnp.float32),    # non-power-of-two blocks
+        (128, 16, 16, 16, True, jnp.bfloat16),  # bf16 corner, nq=nk=8
+    ]
+    for i, (t, d, bq, bk, causal, dtype) in enumerate(cases):
+        r = jax.random.split(jax.random.key(100 + i), 3)
+        mk = lambda rr: (jax.random.normal(rr, (1, 2, t, d), jnp.float32) * 0.5).astype(dtype)
+        q, k, v = mk(r[0]), mk(r[1]), mk(r[2])
+
+        def loss(q, k, v):
+            return jnp.sum(
+                F.flash_attention(q, k, v, causal=causal, block_q=bq, block_k=bk)
+                .astype(jnp.float32) ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(A.mha(q, k, v, causal=causal).astype(jnp.float32) ** 2)
+
+        gf = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        tol = 0.06 if dtype == jnp.bfloat16 else 3e-4
+        for name, a, b in zip(("dq", "dk", "dv"), gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a, dtype=np.float32), np.asarray(b, dtype=np.float32),
+                rtol=tol, atol=tol,
+                err_msg=f"case {i} {name} t={t} d={d} bq={bq} bk={bk} causal={causal} {dtype}",
+            )
