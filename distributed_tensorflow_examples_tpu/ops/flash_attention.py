@@ -274,12 +274,20 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 def _bwd(causal, block_q, block_k, res, do):
     q, k, v, o, lse = res
     delta = compute_delta(do, o)
-    bq = _pick_block(q.shape[1], block_q)
+    tq, d = q.shape[1], q.shape[2]
+    bq = _pick_block(tq, block_q)
     bk = _pick_block(k.shape[1], block_k)
-    if _use_fused_bwd(q.shape[1] // bq, k.shape[1] // bk, q.shape[1], q.shape[2]):
-        return fused_bwd_call(
-            q, k, v, do, lse, delta, causal=causal, block_q=bq, block_k=bk
-        )
+    if _fused_bwd_policy(tq // bq, k.shape[1] // bk):
+        if tq * d * 4 <= _FUSED_MAX_ACC_BYTES:
+            return fused_bwd_call(
+                q, k, v, do, lse, delta, causal=causal, block_q=bq, block_k=bk
+            )
+        seg = _fused_segment_rows(tq, d, bq, bk)
+        if seg:
+            return fused_bwd_segmented(
+                q, k, v, do, lse, delta,
+                causal=causal, block_q=bq, block_k=bk, seg=seg,
+            )
     dq = dq_call(q, k, v, do, lse, delta, causal=causal, block_q=bq, block_k=bk)
     dk, dv = dkv_call(q, k, v, do, lse, delta, causal=causal, block_q=bq, block_k=bk)
     return dq, dk, dv
@@ -316,7 +324,17 @@ def _use_fused_bwd(nq: int, nk: int, tq: int, d: int) -> bool:
 
     DTX_FUSED_BWD=0 forces split, =1 opts into the auto regime without the
     ``_FUSED_BWD_VALIDATED`` latch (read at trace time, like the block-size
-    env vars — one setting per process)."""
+    env vars — one setting per process).
+
+    This predicate answers "single fused call?"; beyond the VMEM cap the
+    dispatcher (``_bwd``) may still serve the fused MECHANISM via the
+    r5 segmented wrapper (``fused_bwd_segmented``)."""
+    return _fused_bwd_policy(nq, nk) and tq * d * 4 <= _FUSED_MAX_ACC_BYTES
+
+
+def _fused_bwd_policy(nq: int, nk: int) -> bool:
+    """Override/env/latch + the nq/nk regime — everything about WANTING the
+    fused mechanism; the VMEM-cap/segmentation split is the dispatcher's."""
     import os
 
     if _FUSED_BWD_OVERRIDE is not None:
@@ -331,7 +349,88 @@ def _use_fused_bwd(nq: int, nk: int, tq: int, d: int) -> bool:
         return False
     if env != "1" and not _FUSED_BWD_VALIDATED:
         return False
-    return nq >= 4 and nk >= 4 and tq * d * 4 <= _FUSED_MAX_ACC_BYTES
+    return nq >= 4 and nk >= 4
+
+
+def _fused_segment_rows(tq: int, d: int, bq: int, bk: int) -> int:
+    """Largest q-segment length that (a) fits the [seg, d] f32 accumulator
+    cap, (b) divides tq, (c) is a multiple of BOTH blocks (the diagonal and
+    prefix calls tile k in bk-sized blocks over seg-multiples) — or 0 when
+    no such segmentation exists (dispatcher falls back to the split
+    kernels)."""
+    cap_rows = _FUSED_MAX_ACC_BYTES // (d * 4)
+    for m in range(2, tq // bq + 1):
+        if tq % m:
+            continue
+        seg = tq // m
+        if seg % bq or seg % bk:
+            continue
+        if seg <= cap_rows:
+            return seg
+    return 0
+
+
+def fused_bwd_segmented(
+    q, k, v, do, lse, delta, *, causal, block_q, block_k, seg,
+):
+    """r5: the fused backward past its VMEM cap — T splits into q segments
+    whose [seg, d] dq accumulators fit, each running the SAME hardware-
+    validated kernel against only the k/v it can see:
+
+    - causal: segment s pairs one square DIAGONAL call (q_s x k_s, local
+      causal == global causal because both carry the same offset) with one
+      rectangular full-visibility PREFIX call (q_s x k[:s*seg],
+      causal=False); k beyond the segment is fully masked and never runs.
+    - non-causal: one rectangular call per segment (q_s x full k).
+
+    dq is exact per segment (summed across its calls); dk/dv arrive as
+    per-call partials accumulated in f32 outside the kernel.  Extra HBM
+    traffic vs the in-cap path is the f32 dk/dv partial accumulation —
+    O(nseg) passes over k-prefix-sized buffers — which the 7->5 matmul
+    saving dominates at the T >= 32k shapes this serves (BASELINE.md r5).
+    Parity: tests/test_flash_attention.py segmented sweep."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    nseg = tq // seg
+    f32 = jnp.float32
+    dk_acc = jnp.zeros((bh, tk, d), f32)
+    dv_acc = jnp.zeros((bh, tk, d), f32)
+    dq_parts = []
+    for s in range(nseg):
+        rows = slice(s * seg, (s + 1) * seg)
+        q_s, do_s = q[:, rows], do[:, rows]
+        lse_s, delta_s = lse[:, rows], delta[:, rows]
+        if not causal:
+            dq_s, dk_p, dv_p = fused_bwd_call(
+                q_s, k, v, do_s, lse_s, delta_s,
+                causal=False, block_q=block_q, block_k=block_k, out_dtype=f32,
+            )
+            dk_acc = dk_acc + dk_p
+            dv_acc = dv_acc + dv_p
+        else:
+            kcols = slice(s * seg, (s + 1) * seg)
+            dq_s, dk_d, dv_d = fused_bwd_call(
+                q_s, k[:, kcols], v[:, kcols], do_s, lse_s, delta_s,
+                causal=True, block_q=block_q, block_k=block_k, out_dtype=f32,
+            )
+            dk_acc = dk_acc.at[:, kcols].add(dk_d)
+            dv_acc = dv_acc.at[:, kcols].add(dv_d)
+            if s > 0:
+                pre = slice(0, s * seg)
+                dq_p, dk_p, dv_p = fused_bwd_call(
+                    q_s, k[:, pre], v[:, pre], do_s, lse_s, delta_s,
+                    causal=False, block_q=block_q, block_k=block_k,
+                    out_dtype=f32,
+                )
+                dq_s = dq_s + dq_p
+                dk_acc = dk_acc.at[:, pre].add(dk_p)
+                dv_acc = dv_acc.at[:, pre].add(dv_p)
+        dq_parts.append(dq_s.astype(q.dtype))
+    return (
+        jnp.concatenate(dq_parts, axis=1),
+        dk_acc.astype(k.dtype),
+        dv_acc.astype(v.dtype),
+    )
 
 
 def compute_delta(do, o):
