@@ -104,6 +104,12 @@ def run_case(b, h, t, d, dtype, causal, check_ref):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="skip the T=8192 case")
+    ap.add_argument(
+        "--segmented", action="store_true",
+        help="add a T=32768 case exercising the r5 segmented fused path "
+        "(two 16384-row segments) — slow; the campaign runs it as its own "
+        "step before the T=32768 bench rows",
+    )
     args = ap.parse_args()
 
     platform = jax.devices()[0].platform
@@ -115,6 +121,10 @@ def main():
     if not args.quick:
         # flagship regime: the exact shape bench.py --seq-len 8192 dispatches
         cases.append(run_case(1, 8, 8192, 128, jnp.bfloat16, True, check_ref=False))
+    if args.segmented:
+        # past the VMEM cap: auto-dispatch routes through fused_bwd_segmented
+        # (h=1 bounds compile+run time; the mechanism is per-head-batch).
+        cases.append(run_case(1, 1, 32768, 128, jnp.bfloat16, True, check_ref=False))
     ok = all(c["ok"] for c in cases)
     print(json.dumps({"parity_ok": ok, "platform": platform, "cases": cases}))
     sys.exit(0 if ok else 1)

@@ -93,7 +93,11 @@ def steps_plan() -> list[dict]:
         dict(name="flash_bench_t8192_f1", cmd=fb + ["--t", "8192", "--fused", "1"], timeout=1200),
         dict(name="flash_bench_t16384_f0", cmd=fb + ["--t", "16384", "--fused", "0"], timeout=1200),
         dict(name="flash_bench_t16384_f1", cmd=fb + ["--t", "16384", "--fused", "1"], timeout=1200),
-        # r5 segmented fused regime (past the VMEM cap): T=32768 A/B.
+        # r5 segmented fused regime (past the VMEM cap): parity first, then
+        # the T=32768 A/B.
+        dict(name="flash_parity_segmented",
+             cmd=[PY, "tools/flash_parity.py", "--quick", "--segmented"],
+             timeout=1500, optional=True),
         dict(name="flash_bench_t32768_f0", cmd=fb + ["--t", "32768", "--fused", "0"],
              timeout=1500, optional=True),
         dict(name="flash_bench_t32768_f1", cmd=fb + ["--t", "32768", "--fused", "1"],
