@@ -125,6 +125,12 @@ def steps_plan() -> list[dict]:
             "--model", "transformer", "--seq-len", "16384",
             "--batch-per-chip", "1", "--loss-chunks", "16",
         ], env={"DTX_FUSED_BWD": "{FUSED}"}, timeout=1800, optional=True),
+        # Deep-regime flagship: T=32768 rides the r5 segmented fused path
+        # (fails cleanly if the activations don't fit — optional row).
+        dict(name="bench_t32768", cmd=bench + [
+            "--model", "transformer", "--seq-len", "32768",
+            "--batch-per-chip", "1", "--loss-chunks", "32",
+        ], env={"DTX_FUSED_BWD": "{FUSED}"}, timeout=2400, optional=True),
         dict(name="ps_tpu_smoke", cmd=[PY, "tools/ps_tpu_smoke.py"], timeout=1100),
     ]
     return plan
