@@ -95,3 +95,44 @@ def test_ps_smoke_final_parser():
     assert f["step"] == 40 and f["mode"] == "sync_replicas_cluster"
     with pytest.raises(AssertionError):
         ps_tpu_smoke._final("no final here")
+
+
+def test_campaign_report_renders(tmp_path, capsys):
+    import json
+
+    import campaign_report
+
+    state = {
+        "started": "2026-07-31T06:00:00", "status": "complete", "fused_gate": "1",
+        "steps": [
+            {"name": "flash_parity", "cmd": "tools/flash_parity.py", "env": {},
+             "rc": 0, "timed_out": False, "seconds": 120.0,
+             "json": {"parity_ok": True, "platform": "tpu", "cases": [
+                 {"shape": [1, 8, 8192, 128], "dtype": "bfloat16", "causal": True,
+                  "ok": True, "bitwise_deterministic": True, "dq_vs_split_rel": 0.01}]},
+             "stdout_tail": "", "stderr_tail": ""},
+            {"name": "bench_t8192_fused", "cmd": "bench.py ...", "env": {"DTX_FUSED_BWD": "1"},
+             "rc": 0, "timed_out": False, "seconds": 300.0,
+             "json": {"metric": "transformer_tokens_per_sec_per_chip", "value": 70000.0,
+                      "unit": "tokens/sec/chip", "vs_baseline": 1.11,
+                      "detail": {"mfu": 0.42}},
+             "stdout_tail": "", "stderr_tail": ""},
+            {"name": "flash_bench_t8192_f1", "cmd": "tools/flash_bench.py ...", "env": {},
+             "rc": -9, "timed_out": True, "seconds": 1200.0, "json": None,
+             "stdout_tail": "| row |", "stderr_tail": ""},
+        ],
+    }
+    p = tmp_path / "c.json"
+    p.write_text(json.dumps(state))
+    import sys as _sys
+
+    old = _sys.argv
+    _sys.argv = ["campaign_report.py", str(p)]
+    try:
+        campaign_report.main()
+    finally:
+        _sys.argv = old
+    out = capsys.readouterr().out
+    assert "parity_ok=True" in out
+    assert "70000.0 tokens/sec/chip" in out and "42.0% MFU" in out
+    assert "FAILED rc=-9 (timeout)" in out
