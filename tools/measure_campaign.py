@@ -48,9 +48,13 @@ def probe(timeout_s: int = 150) -> bool:
     One short-lived probe at a time (a pile of hung clients can extend a
     tunnel wedge)."""
     try:
+        # /usr/bin/timeout wraps the probe so it self-kills even if THIS
+        # process dies first — an orphaned probe would otherwise hang on a
+        # dead tunnel indefinitely (hung clients can extend a wedge).
         r = subprocess.run(
-            [PY, "-c", "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=timeout_s, cwd=ROOT,
+            ["timeout", str(timeout_s),
+             PY, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s + 10, cwd=ROOT,
         )
         return r.returncode == 0 and r.stdout.strip() != ""
     except subprocess.TimeoutExpired:
