@@ -150,17 +150,30 @@ def run_step(step: dict, fused_env: str) -> dict:
     env.update(step["env"])
     t0 = time.time()
     timed_out = False
+    # Own session per step so a timeout kills the WHOLE process group —
+    # ps_tpu_smoke spawns a 4-process cluster, and a leaked hung chief
+    # would sit on the tunnel exactly when the wedge-recovery loop needs
+    # it quiet.
+    p = subprocess.Popen(
+        step["cmd"], stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=ROOT, env=env, start_new_session=True,
+    )
     try:
-        r = subprocess.run(
-            step["cmd"], capture_output=True, text=True,
-            timeout=step["timeout"], cwd=ROOT, env=env,
-        )
-        rc, out, err = r.returncode, r.stdout, r.stderr
-    except subprocess.TimeoutExpired as e:
+        out, err = p.communicate(timeout=step["timeout"])
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
         timed_out = True
         rc = -9
-        out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
-        err = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+        import signal
+
+        try:
+            os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            out, err = p.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            out, err = "", ""
     dt = time.time() - t0
     rec = {
         "name": step["name"],
