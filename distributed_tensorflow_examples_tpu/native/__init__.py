@@ -52,12 +52,29 @@ def _load() -> ctypes.CDLL:
             ctypes.c_int64,
             ctypes.POINTER(ctypes.c_float),
         ]
+        lib.acc_apply_tagged.restype = ctypes.c_int
+        lib.acc_apply_tagged.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float),
+        ]
         lib.acc_take.restype = ctypes.c_int64
         lib.acc_take.argtypes = [
             ctypes.c_void_p,
             ctypes.c_int64,
             ctypes.POINTER(ctypes.c_float),
         ]
+        lib.acc_take_timed.restype = ctypes.c_int64
+        lib.acc_take_timed.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.acc_deduped.restype = ctypes.c_int64
+        lib.acc_deduped.argtypes = [ctypes.c_void_p]
         lib.acc_set_global_step.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.acc_dropped.restype = ctypes.c_int64
         lib.acc_dropped.argtypes = [ctypes.c_void_p]
@@ -69,6 +86,8 @@ def _load() -> ctypes.CDLL:
         lib.tq_push.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
         lib.tq_pop.restype = ctypes.c_int64
         lib.tq_pop.argtypes = [ctypes.c_void_p]
+        lib.tq_pop_timed.restype = ctypes.c_int64
+        lib.tq_pop_timed.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.tq_size.restype = ctypes.c_int64
         lib.tq_size.argtypes = [ctypes.c_void_p]
         lib.tq_cancel.argtypes = [ctypes.c_void_p]
@@ -81,20 +100,70 @@ def _load() -> ctypes.CDLL:
             ctypes.c_int64,
             ctypes.POINTER(ctypes.c_float),
         ]
+        lib.gq_push_tagged.restype = ctypes.c_int
+        lib.gq_push_tagged.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float),
+        ]
         lib.gq_pop.restype = ctypes.c_int64
         lib.gq_pop.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_float)]
+        lib.gq_pop_timed.restype = ctypes.c_int64
+        lib.gq_pop_timed.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float),
+        ]
         lib.gq_set_min_step.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.gq_dropped.restype = ctypes.c_int64
         lib.gq_dropped.argtypes = [ctypes.c_void_p]
+        lib.gq_deduped.restype = ctypes.c_int64
+        lib.gq_deduped.argtypes = [ctypes.c_void_p]
         lib.gq_size.restype = ctypes.c_int64
         lib.gq_size.argtypes = [ctypes.c_void_p]
         lib.gq_cancel.argtypes = [ctypes.c_void_p]
+        lib.acc_reset_worker.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.gq_reset_worker.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ps_server_start.restype = ctypes.c_int
+        lib.ps_server_start.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.ps_server_incarnation.restype = ctypes.c_int64
+        lib.ps_server_requests.restype = ctypes.c_int64
         _lib = lib
     return _lib
 
 
 def _as_float_ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+#: Sentinel returned by deadline-bounded blocking ops (take/pop with a
+#: timeout) when the deadline expires — distinct from ``None`` (cancelled),
+#: so fault-recovery loops can re-issue without mistaking a timeout for
+#: shutdown.
+TIMED_OUT = object()
+
+
+def _timeout_ms(timeout_s: float) -> int:
+    """A requested bounded wait must stay bounded: the C side treats
+    timeout_ms <= 0 as "block forever", so sub-millisecond (and zero)
+    timeouts clamp to 1 ms instead of silently inverting the contract."""
+    return max(1, int(timeout_s * 1000))
+
+
+
+def _tag(worker: int, seq: int) -> int:
+    """Wire packing of a (worker, seq) dedup tag (ps_server.cc layout).
+    Worker is capped at 15 bits: the tag travels as a SIGNED i64, so bit 63
+    must stay clear (worker << 48 with worker >= 2**15 would overflow the
+    wire format)."""
+    if not 0 <= worker < (1 << 15):
+        raise ValueError(f"worker tag {worker} out of range")
+    if not 0 <= seq < (1 << 48):
+        raise ValueError(f"seq {seq} out of range")
+    return (worker << 48) | seq
 
 
 class GradientAccumulator:
@@ -115,10 +184,34 @@ class GradientAccumulator:
             raise ValueError(f"grad size {g.size} != {self.num_elems}")
         return bool(self._lib.acc_apply(self._h, int(local_step), _as_float_ptr(g)))
 
-    def take(self, num_required: int) -> np.ndarray | None:
-        """Blocking average of >= num_required fresh grads; None if cancelled."""
+    def apply_tagged(self, local_step: int, worker: int, seq: int, grad: np.ndarray) -> bool:
+        """Replay-safe apply: (worker, seq) dedup-tagged — a re-issue of a
+        seq the server already processed is counted in ``deduped`` and NOT
+        re-applied.  Returns True when the gradient counts toward the next
+        take (fresh first delivery); False for stale drops AND duplicates."""
+        g = np.ascontiguousarray(grad, dtype=np.float32).reshape(-1)
+        if g.size != self.num_elems:
+            raise ValueError(f"grad size {g.size} != {self.num_elems}")
+        _tag(worker, seq)  # range check (wire parity with the socket path)
+        return (
+            self._lib.acc_apply_tagged(
+                self._h, int(local_step), int(worker), int(seq), _as_float_ptr(g)
+            )
+            == 1
+        )
+
+    def take(self, num_required: int, timeout_s: float | None = None):
+        """Blocking average of >= num_required fresh grads; None if
+        cancelled; ``TIMED_OUT`` when ``timeout_s`` expires first."""
         out = np.empty((self.num_elems,), np.float32)
-        n = self._lib.acc_take(self._h, int(num_required), _as_float_ptr(out))
+        if timeout_s is None:
+            n = self._lib.acc_take(self._h, int(num_required), _as_float_ptr(out))
+        else:
+            n = self._lib.acc_take_timed(
+                self._h, int(num_required), _timeout_ms(timeout_s), _as_float_ptr(out)
+            )
+            if n == -3:
+                return TIMED_OUT
         return None if n < 0 else out
 
     def set_global_step(self, step: int) -> None:
@@ -127,6 +220,10 @@ class GradientAccumulator:
     @property
     def dropped(self) -> int:
         return int(self._lib.acc_dropped(self._h))
+
+    @property
+    def deduped(self) -> int:
+        return int(self._lib.acc_deduped(self._h))
 
     @property
     def pending(self) -> int:
@@ -164,10 +261,37 @@ class GradientQueue:
         r = self._lib.gq_push(self._h, int(local_step), _as_float_ptr(g))
         return None if r < 0 else r == 1
 
-    def pop(self) -> tuple[int, np.ndarray] | None:
-        """Blocking; returns (local_step, grad) or None when cancelled+drained."""
+    def push_tagged(
+        self, local_step: int, worker: int, seq: int, grad: np.ndarray,
+        timeout_s: float | None = None,
+    ):
+        """Replay-safe push ((worker, seq) dedup like the accumulator's).
+        True enqueued OR duplicate-of-enqueued, False stale-dropped, None
+        cancelled, ``TIMED_OUT`` when the bounded space wait expires."""
+        g = np.ascontiguousarray(grad, dtype=np.float32).reshape(-1)
+        if g.size != self.num_elems:
+            raise ValueError(f"grad size {g.size} != {self.num_elems}")
+        _tag(worker, seq)
+        r = self._lib.gq_push_tagged(
+            self._h, int(local_step), int(worker), int(seq),
+            0 if timeout_s is None else _timeout_ms(timeout_s), _as_float_ptr(g),
+        )
+        if r == -3:
+            return TIMED_OUT
+        return None if r < 0 else r != 0
+
+    def pop(self, timeout_s: float | None = None):
+        """Blocking; returns (local_step, grad), None when cancelled+drained,
+        or ``TIMED_OUT`` when ``timeout_s`` expires first."""
         out = np.empty((self.num_elems,), np.float32)
-        step = self._lib.gq_pop(self._h, _as_float_ptr(out))
+        if timeout_s is None:
+            step = self._lib.gq_pop(self._h, _as_float_ptr(out))
+        else:
+            step = self._lib.gq_pop_timed(
+                self._h, _timeout_ms(timeout_s), _as_float_ptr(out)
+            )
+            if step == -3:
+                return TIMED_OUT
         return None if step < 0 else (int(step), out)
 
     def set_min_step(self, step: int) -> None:
@@ -176,6 +300,10 @@ class GradientQueue:
     @property
     def dropped(self) -> int:
         return int(self._lib.gq_dropped(self._h))
+
+    @property
+    def deduped(self) -> int:
+        return int(self._lib.gq_deduped(self._h))
 
     def __len__(self) -> int:
         return int(self._lib.gq_size(self._h))
@@ -202,9 +330,15 @@ class TokenQueue:
     def push(self, step: int, n: int = 1) -> None:
         self._lib.tq_push(self._h, int(step), int(n))
 
-    def pop(self) -> int | None:
-        """Blocking; returns the token's global step, or None if cancelled."""
-        step = self._lib.tq_pop(self._h)
+    def pop(self, timeout_s: float | None = None):
+        """Blocking; returns the token's global step, None if cancelled, or
+        ``TIMED_OUT`` when ``timeout_s`` expires first."""
+        if timeout_s is None:
+            step = self._lib.tq_pop(self._h)
+        else:
+            step = self._lib.tq_pop_timed(self._h, _timeout_ms(timeout_s))
+            if step == -3:
+                return TIMED_OUT
         return None if step < 0 else int(step)
 
     def __len__(self) -> int:

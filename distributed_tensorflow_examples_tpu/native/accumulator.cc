@@ -19,15 +19,50 @@
 // - token queue: chief pushes N tokens tagged with the new global step;
 //   each worker pops one to proceed (sync_replicas_optimizer.py:399).
 
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <deque>
+#include <map>
 #include <mutex>
 #include <new>
 #include <vector>
 
 namespace {
+
+// Tagged-op dedup (fault recovery): a client that loses its connection
+// mid-op replays the op after reconnecting; a per-worker monotone sequence
+// number makes the replay idempotent — the server records the highest seq
+// it has processed per worker and answers "duplicate" for anything at or
+// below it, so a gradient that DID land before the drop is never applied
+// twice (the replay analog of the reference's stale-gradient drop).
+struct DedupTable {
+  std::map<int64_t, int64_t> last_seq;  // worker -> highest processed seq
+  int64_t deduped = 0;
+
+  // True (and counted) when (worker, seq) was already processed.  Does NOT
+  // record — callers record() only once the op will actually be processed,
+  // so a check on a path that later bails (timeout, cancel) cannot turn a
+  // future legitimate replay into a false duplicate.  Owner's mutex held.
+  bool check_duplicate(int64_t worker, int64_t seq) {
+    auto it = last_seq.find(worker);
+    if (it != last_seq.end() && seq <= it->second) {
+      ++deduped;
+      return true;
+    }
+    return false;
+  }
+
+  void record(int64_t worker, int64_t seq) { last_seq[worker] = seq; }
+
+  // Forget a worker's history: a RESTARTED worker process (fresh client,
+  // fresh 0-based sequence counter, same worker id) announces itself so
+  // its new stream is not answered "duplicate" against its dead
+  // incarnation's sequences.  Replays within one client lifetime are
+  // unaffected (the client resets only at construction).
+  void reset_worker(int64_t worker) { last_seq.erase(worker); }
+};
 
 struct Accumulator {
   std::mutex mu;
@@ -36,6 +71,7 @@ struct Accumulator {
   int64_t count = 0;
   int64_t global_step = 0;
   int64_t dropped = 0;  // stale-gradient counter (observability)
+  DedupTable dedup;
   bool cancelled = false;
 
   explicit Accumulator(int64_t n) : sum(static_cast<size_t>(n), 0.0f) {}
@@ -61,6 +97,7 @@ struct GradQueue {
   std::deque<std::pair<int64_t, std::vector<float>>> q;  // (local_step, grad)
   int64_t min_step = 0;  // staleness gate: pushes below this are dropped
   int64_t dropped = 0;
+  DedupTable dedup;
   bool cancelled = false;
 
   GradQueue(int64_t n, int64_t cap)
@@ -100,13 +137,56 @@ int acc_apply(void* h, int64_t local_step, const float* grad) {
   return 1;
 }
 
-// Blocks until `num_required` fresh gradients accumulated (or cancel);
-// writes their average to `out` and resets.  Returns the number averaged,
-// or -1 on cancellation.
-int64_t acc_take(void* h, int64_t num_required, float* out) {
+// Fault-tolerant apply: like acc_apply, but tagged with (worker, seq) so a
+// client replaying the op after a connection drop gets "duplicate" (2)
+// instead of double-counting its gradient.  Returns 1 accepted, 0 dropped
+// stale, 2 duplicate replay.  seq must be monotone per worker per logical
+// apply (retries of ONE apply reuse its seq).  The seq is recorded even
+// for stale drops, so a replayed drop answers 2 and the dropped counter
+// stays exact.
+int acc_apply_tagged(void* h, int64_t local_step, int64_t worker, int64_t seq,
+                     const float* grad) {
+  auto* a = static_cast<Accumulator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  if (a->dedup.check_duplicate(worker, seq)) return 2;
+  a->dedup.record(worker, seq);
+  if (local_step < a->global_step) {
+    ++a->dropped;
+    return 0;
+  }
+  for (size_t i = 0; i < a->sum.size(); ++i) a->sum[i] += grad[i];
+  ++a->count;
+  a->cv.notify_all();
+  return 1;
+}
+
+int64_t acc_deduped(void* h) {
+  auto* a = static_cast<Accumulator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return a->dedup.deduped;
+}
+
+void acc_reset_worker(void* h, int64_t worker) {
+  auto* a = static_cast<Accumulator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  a->dedup.reset_worker(worker);
+}
+
+// Deadline-bounded take (fault recovery: a waiter must be able to notice a
+// dead peer instead of blocking forever).  timeout_ms <= 0 blocks forever.
+// Returns the number averaged, -1 on cancel, -3 on timeout (the caller
+// re-issues — the wait itself mutates nothing).
+int64_t acc_take_timed(void* h, int64_t num_required, int64_t timeout_ms,
+                       float* out) {
   auto* a = static_cast<Accumulator*>(h);
   std::unique_lock<std::mutex> lock(a->mu);
-  a->cv.wait(lock, [&] { return a->cancelled || a->count >= num_required; });
+  auto ready = [&] { return a->cancelled || a->count >= num_required; };
+  if (timeout_ms <= 0) {
+    a->cv.wait(lock, ready);
+  } else if (!a->cv.wait_for(lock, std::chrono::milliseconds(timeout_ms),
+                             ready)) {
+    return -3;
+  }
   if (a->cancelled) return -1;
   const float inv = 1.0f / static_cast<float>(a->count);
   for (size_t i = 0; i < a->sum.size(); ++i) {
@@ -116,6 +196,13 @@ int64_t acc_take(void* h, int64_t num_required, float* out) {
   const int64_t n = a->count;
   a->count = 0;
   return n;
+}
+
+// Blocks until `num_required` fresh gradients accumulated (or cancel);
+// writes their average to `out` and resets.  Returns the number averaged,
+// or -1 on cancellation.
+int64_t acc_take(void* h, int64_t num_required, float* out) {
+  return acc_take_timed(h, num_required, 0, out);
 }
 
 void acc_set_global_step(void* h, int64_t step) {
@@ -158,16 +245,26 @@ void tq_push(void* h, int64_t step, int64_t n) {
   q->cv.notify_all();
 }
 
-// Blocks until a token is available; returns its step, or -1 on cancel.
-int64_t tq_pop(void* h) {
+// Deadline-bounded pop: timeout_ms <= 0 blocks forever; returns the
+// token's step, -1 on cancel, -3 on timeout (no token consumed).
+int64_t tq_pop_timed(void* h, int64_t timeout_ms) {
   auto* q = static_cast<TokenQueue*>(h);
   std::unique_lock<std::mutex> lock(q->mu);
-  q->cv.wait(lock, [&] { return q->cancelled || !q->tokens.empty(); });
+  auto ready = [&] { return q->cancelled || !q->tokens.empty(); };
+  if (timeout_ms <= 0) {
+    q->cv.wait(lock, ready);
+  } else if (!q->cv.wait_for(lock, std::chrono::milliseconds(timeout_ms),
+                             ready)) {
+    return -3;
+  }
   if (q->cancelled && q->tokens.empty()) return -1;
   const int64_t step = q->tokens.front();
   q->tokens.pop_front();
   return step;
 }
+
+// Blocks until a token is available; returns its step, or -1 on cancel.
+int64_t tq_pop(void* h) { return tq_pop_timed(h, 0); }
 
 int64_t tq_size(void* h) {
   auto* q = static_cast<TokenQueue*>(h);
@@ -212,12 +309,66 @@ int gq_push(void* h, int64_t local_step, const float* grad) {
   return 1;
 }
 
-// Blocks for the oldest gradient; writes it to `out` and returns its
-// local_step, or -1 on cancellation.
-int64_t gq_pop(void* h, float* out) {
+// Fault-tolerant push: tagged with (worker, seq) like acc_apply_tagged, so
+// a post-reconnect replay of a push that DID land is not enqueued (and
+// hence applied) twice.  Bounded wait for space — timeout_ms <= 0 blocks
+// like gq_push — so a client deadline can't strand the serving thread in
+// an unbounded full-queue wait.  Returns 1 enqueued, 0 dropped stale,
+// 2 duplicate replay, -1 cancelled, -3 timed out waiting for space.
+int gq_push_tagged(void* h, int64_t local_step, int64_t worker, int64_t seq,
+                   int64_t timeout_ms, const float* grad) {
   auto* q = static_cast<GradQueue*>(h);
   std::unique_lock<std::mutex> lock(q->mu);
-  q->cv.wait(lock, [&] { return q->cancelled || !q->q.empty(); });
+  // Duplicate check BEFORE the space wait: a replay of a push that already
+  // landed needs no space and must answer immediately — against a
+  // persistently full queue it would otherwise poll until the client's
+  // stall budget expired for a gradient already delivered.
+  if (q->dedup.check_duplicate(worker, seq)) return 2;
+  auto ready = [&] { return q->cancelled || q->q.size() < q->capacity; };
+  if (timeout_ms <= 0) {
+    q->cv_space.wait(lock, ready);
+  } else if (!q->cv_space.wait_for(lock, std::chrono::milliseconds(timeout_ms),
+                                   ready)) {
+    return -3;
+  }
+  if (q->cancelled) return -1;
+  // Re-check: the wait released the mutex, so a racing replay of the same
+  // (worker, seq) may have been processed meanwhile.
+  if (q->dedup.check_duplicate(worker, seq)) return 2;
+  q->dedup.record(worker, seq);
+  if (local_step < q->min_step) {
+    ++q->dropped;
+    return 0;
+  }
+  q->q.emplace_back(local_step, std::vector<float>(grad, grad + q->n_elems));
+  q->cv.notify_all();
+  return 1;
+}
+
+int64_t gq_deduped(void* h) {
+  auto* q = static_cast<GradQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  return q->dedup.deduped;
+}
+
+void gq_reset_worker(void* h, int64_t worker) {
+  auto* q = static_cast<GradQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  q->dedup.reset_worker(worker);
+}
+
+// Deadline-bounded pop: timeout_ms <= 0 blocks forever; returns the
+// gradient's local_step, -1 on cancel+drained, -3 on timeout.
+int64_t gq_pop_timed(void* h, int64_t timeout_ms, float* out) {
+  auto* q = static_cast<GradQueue*>(h);
+  std::unique_lock<std::mutex> lock(q->mu);
+  auto ready = [&] { return q->cancelled || !q->q.empty(); };
+  if (timeout_ms <= 0) {
+    q->cv.wait(lock, ready);
+  } else if (!q->cv.wait_for(lock, std::chrono::milliseconds(timeout_ms),
+                             ready)) {
+    return -3;
+  }
   if (q->q.empty()) return -1;  // cancelled and drained
   auto& front = q->q.front();
   std::memcpy(out, front.second.data(), q->n_elems * sizeof(float));
@@ -226,6 +377,10 @@ int64_t gq_pop(void* h, float* out) {
   q->cv_space.notify_all();
   return step;
 }
+
+// Blocks for the oldest gradient; writes it to `out` and returns its
+// local_step, or -1 on cancellation.
+int64_t gq_pop(void* h, float* out) { return gq_pop_timed(h, 0, out); }
 
 void gq_set_min_step(void* h, int64_t step) {
   auto* q = static_cast<GradQueue*>(h);

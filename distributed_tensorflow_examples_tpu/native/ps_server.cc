@@ -41,22 +41,31 @@
 extern "C" {
 void* acc_new(int64_t);
 int acc_apply(void*, int64_t, const float*);
+int acc_apply_tagged(void*, int64_t, int64_t, int64_t, const float*);
 int64_t acc_take(void*, int64_t, float*);
+int64_t acc_take_timed(void*, int64_t, int64_t, float*);
 void acc_set_global_step(void*, int64_t);
 int64_t acc_dropped(void*);
+int64_t acc_deduped(void*);
+void acc_reset_worker(void*, int64_t);
 int64_t acc_num_elems(void*);
 void acc_cancel(void*);
 void* tq_new();
 void tq_push(void*, int64_t, int64_t);
 int64_t tq_pop(void*);
+int64_t tq_pop_timed(void*, int64_t);
 int64_t tq_size(void*);
 void tq_cancel(void*);
 void* gq_new(int64_t, int64_t);
 int gq_push(void*, int64_t, const float*);
+int gq_push_tagged(void*, int64_t, int64_t, int64_t, int64_t, const float*);
 int64_t gq_pop(void*, float*);
+int64_t gq_pop_timed(void*, int64_t, float*);
 int64_t gq_num_elems(void*);
 void gq_set_min_step(void*, int64_t);
 int64_t gq_dropped(void*);
+int64_t gq_deduped(void*);
+void gq_reset_worker(void*, int64_t);
 void gq_cancel(void*);
 void* pstore_new(int64_t);
 void pstore_set(void*, int64_t, const float*);
@@ -85,7 +94,34 @@ enum Op : uint8_t {
   PSTORE_GET_OBJ = 16,
   PSTORE_SET = 17,
   PSTORE_GET = 18,
+  // Fault-recovery extensions (r6).  Blocking ops additionally honor a
+  // timeout operand (ACC_TAKE: b, TQ_POP: a, GQ_POP: b, in ms; 0 = block
+  // forever, the pre-r6 wire behavior) and answer -3 on expiry.
+  INCARNATION = 19,       // status = this server instance's incarnation id
+  ACC_APPLY_TAGGED = 20,  // a = local_step, b = (worker << 48) | seq
+  GQ_PUSH_TAGGED = 21,    // a = local_step, b = (worker << 48) | seq
+  ACC_DEDUPED = 22,
+  GQ_DEDUPED = 23,
+  // A (re)starting worker announces itself: forget its dedup history so a
+  // fresh 0-based sequence stream is not answered "duplicate" against a
+  // dead incarnation's sequences.  a = worker id.  Idempotent.
+  ACC_RESET_WORKER = 24,
+  GQ_RESET_WORKER = 25,
 };
+
+// Tag operand layout for the *_TAGGED ops: worker in bits 48..62 (15 bits
+// — bit 63 stays clear, the operand travels as a signed i64), the
+// per-worker monotone sequence number in the low 48.
+constexpr int kTagWorkerShift = 48;
+constexpr int64_t kTagSeqMask = (int64_t{1} << kTagWorkerShift) - 1;
+
+// Bounded server-side wait for space in GQ_PUSH_TAGGED (its operands are
+// fully spent on step + tag): a full queue answers -3 after this long and
+// the dedup-protected client re-issues, so a client deadline can never
+// strand a serving thread in an unbounded wait.  Sized to the client's
+// block chunk — each re-issue re-sends the gradient payload, so the poll
+// period bounds that redundant I/O.
+constexpr int64_t kPushSpaceWaitMs = 2000;
 
 struct Object {
   uint8_t kind;  // 'a' acc, 't' tq, 'g' gq, 'p' pstore
@@ -96,6 +132,13 @@ struct Server {
   std::mutex mu;
   std::map<std::string, Object> objects;
   int listen_fd = -1;
+  // Incarnation id: unique per server instance, so a reconnecting client
+  // can tell "same server, transient drop" (replay suffices) from "server
+  // restarted, all state lost" (re-create objects, republish, re-seed).
+  int64_t incarnation = 0;
+  // Requests served (all connections).  Deterministic per protocol op
+  // sequence — the fault layer's "kill PS at request N" trigger.
+  std::atomic<int64_t> requests{0};
   std::atomic<bool> stopping{false};
   std::thread accept_thread;
   // Live connection fds: stop() shuts them down so blocked readers exit
@@ -203,11 +246,14 @@ void serve_conn_impl(Server* s, int fd) {
     // mismatched payloads are drained (framing intact) and answered -2.
     // ``payload_obj`` is reused by the dispatch below (one lookup, one
     // mutex acquisition per request on the gradient-push hot path).
+    s->requests.fetch_add(1, std::memory_order_relaxed);
     size_t expected = 0;
     Object* payload_obj = nullptr;
-    if (op == ACC_APPLY && (payload_obj = find(s, name, 'a')))
+    if ((op == ACC_APPLY || op == ACC_APPLY_TAGGED) &&
+        (payload_obj = find(s, name, 'a')))
       expected = static_cast<size_t>(acc_num_elems(payload_obj->handle));
-    else if (op == GQ_PUSH && (payload_obj = find(s, name, 'g')))
+    else if ((op == GQ_PUSH || op == GQ_PUSH_TAGGED) &&
+             (payload_obj = find(s, name, 'g')))
       expected = static_cast<size_t>(gq_num_elems(payload_obj->handle));
     else if (op == PSTORE_SET && (payload_obj = find(s, name, 'p')))
       expected = static_cast<size_t>(pstore_num_elems(payload_obj->handle));
@@ -229,6 +275,9 @@ void serve_conn_impl(Server* s, int fd) {
       case PING:
         status = 0;
         break;
+      case INCARNATION:
+        status = s->incarnation;
+        break;
       case CANCEL_ALL:
         cancel_all(s);
         status = 0;
@@ -249,11 +298,26 @@ void serve_conn_impl(Server* s, int fd) {
         // Size already validated against the pre-checked object above.
         if ((o = payload_obj)) status = acc_apply(o->handle, a, payload.data());
         break;
+      case ACC_APPLY_TAGGED:
+        if ((o = payload_obj))
+          status = acc_apply_tagged(o->handle, a, b >> kTagWorkerShift,
+                                    b & kTagSeqMask, payload.data());
+        break;
       case ACC_TAKE:
         if ((o = find(s, name, 'a'))) {
           out.resize((size_t)acc_num_elems(o->handle));
-          status = acc_take(o->handle, a, out.data());
+          // b = client deadline in ms (0 = block forever, pre-r6 wire).
+          status = acc_take_timed(o->handle, a, b, out.data());
           if (status < 0) out.clear();
+        }
+        break;
+      case ACC_DEDUPED:
+        if ((o = find(s, name, 'a'))) status = acc_deduped(o->handle);
+        break;
+      case ACC_RESET_WORKER:
+        if ((o = find(s, name, 'a'))) {
+          acc_reset_worker(o->handle, a);
+          status = 0;
         }
         break;
       case ACC_SET_STEP:
@@ -272,7 +336,8 @@ void serve_conn_impl(Server* s, int fd) {
         }
         break;
       case TQ_POP:
-        if ((o = find(s, name, 't'))) status = tq_pop(o->handle);
+        // a = client deadline in ms (0 = block forever, pre-r6 wire).
+        if ((o = find(s, name, 't'))) status = tq_pop_timed(o->handle, a);
         break;
       case GQ_PUSH:
         // Size validated against the QUEUE's element count in the
@@ -280,13 +345,29 @@ void serve_conn_impl(Server* s, int fd) {
         // memcpy nor drive an allocation.
         if ((o = payload_obj)) status = gq_push(o->handle, a, payload.data());
         break;
+      case GQ_PUSH_TAGGED:
+        if ((o = payload_obj))
+          status = gq_push_tagged(o->handle, a, b >> kTagWorkerShift,
+                                  b & kTagSeqMask, kPushSpaceWaitMs,
+                                  payload.data());
+        break;
       case GQ_POP:
         if ((o = find(s, name, 'g'))) {
           // Output sized from the server-side queue, NEVER from client
           // input (a client-controlled size here was a heap overflow).
           out.resize((size_t)gq_num_elems(o->handle));
-          status = gq_pop(o->handle, out.data());
+          // b = client deadline in ms (0 = block forever, pre-r6 wire).
+          status = gq_pop_timed(o->handle, b, out.data());
           if (status < 0) out.clear();
+        }
+        break;
+      case GQ_DEDUPED:
+        if ((o = find(s, name, 'g'))) status = gq_deduped(o->handle);
+        break;
+      case GQ_RESET_WORKER:
+        if ((o = find(s, name, 'g'))) {
+          gq_reset_worker(o->handle, a);
+          status = 0;
         }
         break;
       case GQ_SET_MIN:
@@ -390,9 +471,31 @@ int ps_server_start(int port, int loopback_only) {
     return -1;
   }
   s->listen_fd = fd;
+  // Unique across restarts WITHIN a process (clock advances) and across
+  // processes (pid mixed in); masked positive so the wire status stays
+  // out of the error range.
+  const int64_t nanos = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                            std::chrono::system_clock::now().time_since_epoch())
+                            .count();
+  s->incarnation =
+      ((nanos ^ (static_cast<int64_t>(::getpid()) << 40)) & 0x7FFFFFFFFFFFFFFF);
+  if (s->incarnation == 0) s->incarnation = 1;
   s->accept_thread = std::thread(accept_loop, s);
   g_server = s;
   return static_cast<int>(ntohs(addr.sin_port));
+}
+
+// This process's live server incarnation id, or -1 when no server runs.
+int64_t ps_server_incarnation() {
+  std::lock_guard<std::mutex> lock(g_server_mu);
+  return g_server ? g_server->incarnation : -1;
+}
+
+// Requests served by this process's live server (-1 when none runs) — the
+// fault layer's deterministic "kill PS at request N" trigger reads this.
+int64_t ps_server_requests() {
+  std::lock_guard<std::mutex> lock(g_server_mu);
+  return g_server ? g_server->requests.load(std::memory_order_relaxed) : -1;
 }
 
 // Cancels all blocking waiters, stops accepting, shuts down live

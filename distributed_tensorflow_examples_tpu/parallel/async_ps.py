@@ -36,6 +36,7 @@ import dataclasses
 import logging
 import os
 import threading
+import time
 from typing import Any, Callable, Iterator
 
 import numpy as np
@@ -45,6 +46,7 @@ import jax.numpy as jnp
 import optax
 
 from .. import native
+from ..utils import faults
 
 log = logging.getLogger("dtx.async_ps")
 
@@ -74,6 +76,17 @@ class AsyncPSConfig:
     # recovered async runs from Saver checkpoints; same contract here).
     ckpt_dir: str | None = None
     checkpoint_every: int = 50  # applied updates between saves
+    #: Cross-process mode only — the PS client's fault posture (r6).
+    #: Per-op deadline: blocking ops become bounded server-side waits the
+    #: client re-issues, so a dead PS surfaces within ~one chunk instead of
+    #: hanging forever.  None = pre-r6 unbounded ops.
+    ps_op_timeout_s: float | None = 30.0
+    #: How long a client keeps reconnecting (exponential backoff) before a
+    #: PS outage becomes fatal (``PSDeadlineError`` -> the supervisor's
+    #: whole-job crash-restart path).  Must comfortably cover one PS-task
+    #: restart: supervise() backoff + process relaunch + import.  0 = the
+    #: pre-r6 fail-fast client.
+    ps_reconnect_deadline_s: float = 60.0
 
 
 class AsyncPSTrainer:
@@ -109,6 +122,10 @@ class AsyncPSTrainer:
         self.apply_log: list[tuple[int, int, int, bool]] = []
         self._history_lock = threading.Lock()
         self.total_dropped = 0
+        #: Duplicate replays suppressed by the (worker, seq) dedup tables —
+        #: stays 0 unless a connection drop forced a replay of an op the
+        #: server had already processed (fault-recovery observability).
+        self.total_deduped = 0
         self._worker_excs: list[tuple[int, BaseException]] = []
 
         leaves, self._treedef = jax.tree.flatten(self.params)
@@ -215,13 +232,31 @@ class AsyncPSTrainer:
             self.params = jax.tree.map(np.asarray, new_params)
             self.global_step += 1
 
+    #: Sync mode: a take() stalled this long re-pushes the current step's
+    #: tokens.  Tokens and drained aggregations are the two coordination
+    #: quantities a connection drop can lose without a trace (their drain
+    #: ops are not replay-idempotent — see ps_service docstring); extra
+    #: tokens only produce gradients the staleness gate drops, so periodic
+    #: re-push converts both loss windows from deadlock into delay.
+    #: None in the in-process thread emulation — no transport, nothing can
+    #: be lost, and a merely-slow aggregation must not receive extra
+    #: same-step tokens (they would pass the staleness gate and change the
+    #: averaged count).  RemotePSChief (the socket path) enables it.
+    sync_stall_repush_s: float | None = None
+
     def _chief_sync(self):
         n_agg = self.cfg.replicas_to_aggregate or self.cfg.num_workers
         acc = self._accs[0]
         acc.set_global_step(self.global_step)
         self._tq.push(self.global_step, self.cfg.num_workers)
-        for step in range(self.global_step, self.cfg.train_steps):
-            out = acc.take(n_agg)
+        while self.global_step < self.cfg.train_steps:
+            out = acc.take(n_agg, timeout_s=self.sync_stall_repush_s)
+            if out is native.TIMED_OUT:
+                faults.log_event(
+                    "sync_stall_repush", step=self.global_step, n_agg=n_agg
+                )
+                self._tq.push(self.global_step, self.cfg.num_workers)
+                continue
             if out is None:
                 return
             self._apply_update(self._unflatten_concat(out))
@@ -394,6 +429,9 @@ class AsyncPSTrainer:
         self.total_dropped = sum(acc.dropped for acc in self._accs) + (
             self._gq.dropped if self._gq is not None else 0
         )
+        self.total_deduped = sum(acc.deduped for acc in self._accs) + (
+            self._gq.deduped if self._gq is not None else 0
+        )
         log.info(
             "async-PS run done: %d applied steps, %d stale grads dropped",
             self.global_step,
@@ -418,7 +456,19 @@ class RemotePSChief(AsyncPSTrainer):
     ``ps_addr``: connect to an EXTERNAL PS service (a ``--job_name=ps``
     process running :func:`host_ps_task`) instead of hosting in-process —
     the reference's dedicated-PS-task topology; the chief then signals
-    ``ps_shutdown`` when training ends so the PS process exits 0."""
+    ``ps_shutdown`` when training ends so the PS process exits 0.
+
+    Fault posture (r6): the client carries per-op deadlines and a
+    reconnect budget (cfg.ps_op_timeout_s / ps_reconnect_deadline_s); when
+    a reconnect lands on a NEW server incarnation (the PS task was
+    restarted, e.g. by ``supervise()``, losing all coordination state) the
+    chief re-seeds it — republish params, restore the accumulator's global
+    step, re-push the current step's tokens — so training continues from
+    the chief's own state instead of crash-restarting the whole job."""
+
+    #: Socket path: lost tokens/aggregations are real here — self-heal
+    #: (see AsyncPSTrainer.sync_stall_repush_s).
+    sync_stall_repush_s = 30.0
 
     def __init__(
         self, cfg, loss_fn, optimizer, init_params, *,
@@ -430,13 +480,18 @@ class RemotePSChief(AsyncPSTrainer):
         same contract as ``host_ps_task``)."""
         from . import ps_service
 
+        client_kw = dict(
+            op_timeout_s=cfg.ps_op_timeout_s,
+            reconnect_deadline_s=cfg.ps_reconnect_deadline_s,
+            role=faults.current_role() or "chief0",
+        )
         if ps_addr is not None:
             self.port = ps_addr[1]
-            self._client = ps_service.PSClient(ps_addr[0], ps_addr[1])
+            self._client = ps_service.PSClient(ps_addr[0], ps_addr[1], **client_kw)
             self._owns_server = False
         else:
             self.port = ps_service.start_server(port, loopback_only=not listen_all)
-            self._client = ps_service.PSClient("127.0.0.1", self.port)
+            self._client = ps_service.PSClient("127.0.0.1", self.port, **client_kw)
             self._owns_server = True
         super().__init__(cfg, loss_fn, optimizer, init_params, **kw)
         total = sum(self._leaf_sizes)
@@ -450,7 +505,26 @@ class RemotePSChief(AsyncPSTrainer):
             )
         self._tq = ps_service.RemoteTokenQueue(self._client, "tokens")
         self._pstore = ps_service.RemoteParamStore(self._client, "params", total)
+        self._client.on_reincarnation(self._reseed_ps_state)
         self._publish()
+
+    def _reseed_ps_state(self) -> None:
+        """Run after a reconnect re-created the (empty) objects on a
+        restarted PS: push back the volatile coordination state that only
+        the chief can reconstruct.  In-flight worker gradients from the old
+        incarnation are lost — exactly the reference's stale-drop posture —
+        and re-pushed tokens may admit an extra gradient per worker, which
+        the staleness gate then drops."""
+        faults.log_event(
+            "chief_reseed", step=self.global_step, mode=self.cfg.mode
+        )
+        self._publish()
+        if self.cfg.mode == "sync_replicas":
+            self._accs[0].set_global_step(self.global_step)
+            if self.global_step < self.cfg.train_steps:
+                self._tq.push(self.global_step, self.cfg.num_workers)
+        elif self.cfg.max_staleness is not None:
+            self._gq.set_min_step(self.global_step - self.cfg.max_staleness)
 
     def _publish(self) -> None:
         flat = np.concatenate(
@@ -492,18 +566,25 @@ class RemotePSChief(AsyncPSTrainer):
                 self.total_dropped = sum(
                     acc.dropped for acc in self._accs
                 ) + (self._gq.dropped if self._gq is not None else 0)
+                self.total_deduped = sum(
+                    acc.deduped for acc in self._accs
+                ) + (self._gq.deduped if self._gq is not None else 0)
             except Exception:
                 self.total_dropped = -1  # transport gone; counter unknown
+                self.total_deduped = -1
         if self.cfg.ckpt_dir:
             self.save_checkpoint()
         if not self._owns_server:
             # Dedicated-PS topology: release the external PS task LAST —
             # after the dropped-counter reads above — so host_ps_task only
             # tears the service down once nothing will dial it again.
+            # Best-effort: the PS may already have exited via its
+            # cancel-grace window, so do NOT spend the reconnect budget.
             try:
+                self._client.fail_fast()
                 ps_service.RemoteTokenQueue(self._client, "ps_shutdown").push(0)
             except Exception:
-                log.exception("ps_shutdown signal failed (ps already down?)")
+                log.info("ps_shutdown signal not delivered (ps already down)")
         log.info(
             "remote async-PS chief done: %d applied steps, %d stale drops",
             self.global_step,
@@ -518,18 +599,45 @@ def host_ps_task(port: int, *, loopback_only: bool = True) -> int:
     chief signals ``ps_shutdown`` (the analog of ``server.join()``, except
     it RETURNS when training ends instead of blocking forever).  Returns
     the bound port.  ``loopback_only=False`` serves other hosts (trusted
-    networks only — see ps_service.start_server)."""
+    networks only — see ps_service.start_server).
+
+    Arms any ``die`` fault specs for this process (``DTX_FAULT_PLAN``) —
+    ``after_reqs`` triggers off the server's request counter, the
+    deterministic "kill the PS at request N" fault the recovery tests
+    inject; a supervisor (``supervise()``) restarts the task and the
+    clients reconnect into the fresh incarnation."""
     import time as _time
 
     from . import ps_service
 
     bound = ps_service.start_server(port, loopback_only=loopback_only)
-    log.info("PS task serving on port %d (blocking until chief shutdown)", bound)
-    client = ps_service.PSClient("127.0.0.1", bound)
+    faults.arm_process_faults(
+        request_count_fn=ps_service.server_request_count
+    )
+    log.info(
+        "PS task serving on port %d, incarnation %d (blocking until chief "
+        "shutdown)", bound, ps_service.server_incarnation(),
+    )
+    client = ps_service.PSClient("127.0.0.1", bound, timeout_s=10.0)
     tq = ps_service.RemoteTokenQueue(client, "ps_shutdown")
     cancelled = 0
+    # Supervised child (ps_experiment --ps_restarts): a SIGKILL of the
+    # visible PS pid kills only the supervisor — it cannot forward an
+    # uncatchable signal — so watch for re-parenting and exit rather than
+    # serve on as an orphan squatting the port.
+    supervised = os.environ.get("DTX_PS_SUPERVISED") == "1"
+    ppid0 = os.getppid()
     while True:
-        token = tq.pop()  # blocks; None = a cancel_all broadcast
+        # Bounded pops keep this thread responsive (fault triggers, signal
+        # delivery) without consuming the shutdown contract below; 2 s
+        # keeps idle polling to a trickle so ``die:after_reqs`` triggers
+        # stay dominated by real coordination traffic.
+        token = tq.pop(timeout_s=2.0)
+        if token is ps_service.TIMED_OUT:
+            if supervised and os.getppid() != ppid0:
+                log.warning("PS task: supervisor died; exiting")
+                break
+            continue
         if token is not None:
             break
         # cancel_all reaches this queue too (the chief cancels before its
@@ -563,10 +671,23 @@ def remote_worker_loop(
 
     ``init_fn`` rebuilds the parameter STRUCTURE locally (deterministic
     shapes/treedef); values always come from the param store.
+
+    Fault posture (r6): the client reconnects through PS outages (bounded
+    by cfg.ps_reconnect_deadline_s) and its pushes are dedup-tagged with
+    this worker's id, so a push replayed after a drop is never applied
+    twice.  After a PS *restart*, the param store is empty until the chief
+    re-seeds it — the worker waits for a republished snapshot instead of
+    training on zeros.
     """
     from . import ps_service
 
-    client = ps_service.PSClient(host, port)
+    client = ps_service.PSClient(
+        host, port,
+        op_timeout_s=cfg.ps_op_timeout_s,
+        reconnect_deadline_s=cfg.ps_reconnect_deadline_s,
+        worker_tag=wid,
+        role=faults.current_role() or f"worker{wid}",
+    )
     template = init_fn(jax.random.key(0))
     leaves, treedef = jax.tree.flatten(template)
     shapes = [l.shape for l in leaves]
@@ -601,6 +722,21 @@ def remote_worker_loop(
         return loss, grads
 
     grad_fn = jax.jit(_grad)
+
+    def await_params():
+        """Latest published snapshot, waiting out the window where a
+        restarted PS has an empty (step = -1) param store until the chief's
+        reseed lands; None when the chief never returns within the
+        reconnect budget."""
+        deadline = time.monotonic() + max(cfg.ps_reconnect_deadline_s, 5.0)
+        step, flat = pstore.get()
+        while step < 0:
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.05)
+            step, flat = pstore.get()
+        return step, flat
+
     contributed = 0
     it = 0
     while True:
@@ -612,9 +748,14 @@ def remote_worker_loop(
                 if token is None:
                     break
                 local_step = token
-                step, flat = pstore.get()
+                got = await_params()
             else:
-                step, flat = pstore.get()
+                got = await_params()
+            if got is None:
+                log.warning("worker %d: no republished params; exiting", wid)
+                break
+            step, flat = got
+            if cfg.mode != "sync_replicas":
                 if step >= cfg.train_steps:
                     break
                 local_step = max(step, 0)

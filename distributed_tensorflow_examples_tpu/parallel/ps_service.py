@@ -10,6 +10,36 @@ hosting the service (the PS task role) and each worker process connecting.
 One socket per client; requests are serialized on it (a worker's op
 sequence is sequential anyway, and blocking ops — token pop, accumulator
 take, gradient pop — tie up only that client's server-side thread).
+
+Fault tolerance (r6): the reference's fault model lost the whole job when a
+PS task died (a stalled session torn down and crash-restarted, SURVEY.md
+section 5.3).  Here the client itself heals the connection:
+
+- every op takes a DEADLINE (``op_timeout_s``); blocking ops are issued as
+  bounded server-side waits the client re-issues, so a dead peer surfaces
+  as a timeout instead of an eternal hang;
+- a transport failure triggers exponential-backoff RECONNECT (bounded by
+  ``reconnect_deadline_s``), after which the op is REPLAYED.  Gradient
+  WRITES are exactly-once: applies/pushes are dedup-tagged with a
+  per-worker monotone sequence number the server remembers, so a gradient
+  that DID land before the drop is answered "duplicate", never applied
+  twice.  Drain ops (take / token pop / gradient pop) are at-most-once:
+  a response lost after the server commits loses that drained
+  average/token/gradient.  Token pushes are at-LEAST-once: a replayed
+  push may add extra same-step tokens, whose extra gradients are averaged
+  in or staleness-dropped — the same effect (and tolerance) as the
+  chief's stall-triggered token re-push
+  (``AsyncPSTrainer.sync_stall_repush_s``), which heals the lost
+  tokens/aggregations of the at-most-once drains.  A lost async gradient
+  is equivalent to a stale-drop (harmless);
+- on reconnect the client compares the server's INCARNATION id: a changed
+  id means the PS restarted and lost all state, so the client re-issues
+  its object-creation ops and runs registered ``on_reincarnation``
+  callbacks (the chief republishes params and re-seeds step/tokens).
+
+Every recovery action logs one structured ``dtx.faults`` line; fault
+INJECTION (the ``DTX_FAULT_PLAN`` env var) hooks in at ``call()`` — see
+``utils/faults.py``.
 """
 
 from __future__ import annotations
@@ -17,10 +47,12 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
 from .. import native
+from ..utils import faults
 
 # Op codes (must match native/ps_server.cc).
 _ACC_GET, _ACC_APPLY, _ACC_TAKE, _ACC_SET_STEP, _ACC_DROPPED = 1, 2, 3, 4, 5
@@ -28,6 +60,27 @@ _TQ_GET, _TQ_PUSH, _TQ_POP = 6, 7, 8
 _GQ_GET, _GQ_PUSH, _GQ_POP, _GQ_SET_MIN, _GQ_DROPPED = 9, 10, 11, 12, 13
 _CANCEL_ALL, _PING = 14, 15
 _PSTORE_GET_OBJ, _PSTORE_SET, _PSTORE_GET = 16, 17, 18
+_INCARNATION, _ACC_APPLY_TAGGED, _GQ_PUSH_TAGGED = 19, 20, 21
+_ACC_DEDUPED, _GQ_DEDUPED = 22, 23
+_ACC_RESET_WORKER, _GQ_RESET_WORKER = 24, 25
+
+#: Deadline sentinel for bounded blocking ops (take/pop with ``timeout_s``).
+TIMED_OUT = native.TIMED_OUT
+
+#: How long a tagged gradient push keeps polling a FULL queue before the
+#: stall is treated as a dead/wedged chief (PSDeadlineError) rather than
+#: ordinary backpressure.
+_PUSH_STALL_LIMIT_S = 600.0
+
+
+class PSError(RuntimeError):
+    """A PS op failed terminally (transport down and unrecoverable, or the
+    server rejected the request)."""
+
+
+class PSDeadlineError(PSError):
+    """Reconnect budget exhausted: the PS stayed unreachable past
+    ``reconnect_deadline_s``."""
 
 
 def start_server(port: int = 0, *, loopback_only: bool = True) -> int:
@@ -36,35 +89,135 @@ def start_server(port: int = 0, *, loopback_only: bool = True) -> int:
     ``loopback_only=False`` binds all interfaces — required when workers on
     OTHER hosts dial this PS task (the protocol is unauthenticated, so only
     do this on a trusted cluster network, as with the reference's gRPC)."""
-    lib = native._load()
-    import ctypes
-
-    lib.ps_server_start.restype = ctypes.c_int
-    lib.ps_server_start.argtypes = [ctypes.c_int, ctypes.c_int]
-    p = lib.ps_server_start(port, 1 if loopback_only else 0)
+    p = native._load().ps_server_start(port, 1 if loopback_only else 0)
     if p < 0:
         raise RuntimeError("ps_server_start failed")
     return p
 
 
 def stop_server() -> None:
-    lib = native._load()
-    lib.ps_server_stop()
+    native._load().ps_server_stop()
+
+
+def server_incarnation() -> int:
+    """This process's live server incarnation id (-1 when none runs)."""
+    return int(native._load().ps_server_incarnation())
+
+
+def server_request_count() -> int:
+    """Requests served by this process's live server (-1 when none runs) —
+    the trigger for ``die:after_reqs`` fault specs."""
+    return int(native._load().ps_server_requests())
 
 
 class PSClient:
-    """One TCP connection to the PS server; thread-safe via a lock."""
+    """One TCP connection to the PS server; thread-safe via a lock.
 
-    def __init__(self, host: str, port: int, *, timeout_s: float | None = None):
-        self._sock = socket.create_connection((host, port), timeout=timeout_s)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._lock = threading.Lock()
+    ``timeout_s``            connect timeout AND the default op deadline
+                             (pre-r6 compatible: None = block forever).
+    ``op_timeout_s``         per-op deadline; overrides ``timeout_s`` for
+                             ops.  Blocking ops get this ON TOP of their
+                             bounded server-side wait.
+    ``reconnect_deadline_s`` > 0 enables recovery: on a transport failure
+                             the client reconnects (exponential backoff,
+                             giving up — ``PSDeadlineError`` — after this
+                             many seconds of unreachability) and replays
+                             the op.  0 = pre-r6 fail-fast behavior.
+    ``worker_tag``           this client's worker id: non-None makes
+                             accumulator applies / gradient pushes
+                             dedup-tagged (replay-safe).  Plain applies on
+                             a recovering client are refused instead of
+                             risking a double apply.
+    ``role``                 fault-plan role for DTX_FAULT_PLAN matching
+                             (defaults to the process role).
+    """
+
+    #: Server-side wait per blocking-op round trip when the client has a
+    #: deadline/recovery configured; each expiry just re-issues, so this
+    #: only bounds how fast a dead peer is noticed.
+    block_chunk_s = 2.0
+
+    def __init__(
+        self, host: str, port: int, *, timeout_s: float | None = None,
+        op_timeout_s: float | None = None, reconnect_deadline_s: float = 0.0,
+        backoff_s: float = 0.25, worker_tag: int | None = None,
+        role: str | None = None,
+    ):
+        self._host, self._port = host, port
+        self._connect_timeout = timeout_s
+        self._op_timeout = op_timeout_s if op_timeout_s is not None else timeout_s
+        self._reconnect_deadline = reconnect_deadline_s
+        self._backoff = backoff_s
+        self.worker_tag = worker_tag
+        self.role = role if role is not None else faults.current_role()
+        self._lock = threading.RLock()
+        self._in_recovery = False
+        self._ensures: list[tuple[int, str, int, int]] = []
+        self._callbacks: list = []
+        self._injector = faults.client_injector(self.role)
+        self._sock: socket.socket | None = None
+        try:
+            self._connect()
+            # The baseline incarnation: reconnects compare against this to
+            # tell a transient drop from a restarted (state-lost) server.
+            # Bounded by the configured deadlines so a stalled server fails
+            # the ctor instead of hanging it.
+            self._incarnation, _ = self._attempt(
+                self._frame(_INCARNATION),
+                self._op_timeout
+                if self._op_timeout is not None
+                else self._connect_timeout,
+            )
+        except OSError:
+            if self._reconnect_deadline <= 0:
+                raise
+            # Construction during a PS outage (e.g. mid supervised restart)
+            # gets the same recovery budget as any op: retry with backoff;
+            # the sentinel makes the first contact look like a fresh
+            # incarnation, which replays the (empty) ensure list and sets
+            # the real id.
+            self._incarnation = object()
+            self._recover(time.monotonic() + self._reconnect_deadline)
+
+    # -- transport ----------------------------------------------------------
+
+    def _connect(self) -> None:
+        sock = socket.create_connection(
+            (self._host, self._port), timeout=self._connect_timeout
+        )
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+
+    def _sever(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        # Also revoke the reconnect budget: an op issued after close (leaked
+        # reference, teardown-ordered thread) must fail fast, not silently
+        # resurrect a connection to the PS.
+        self._reconnect_deadline = 0.0
+        self._sever()
+
+    @staticmethod
+    def _frame(
+        op: int, name: str = "", a: int = 0, b: int = 0,
+        payload: np.ndarray | None = None,
+    ) -> bytes:
+        nm = name.encode()
+        pl = (
+            np.ascontiguousarray(payload, np.float32).tobytes()
+            if payload is not None
+            else b""
+        )
+        return (
+            struct.pack("<BB", op, len(nm)) + nm
+            + struct.pack("<qqI", a, b, len(pl) // 4) + pl
+        )
 
     def _recv_n(self, n: int) -> bytes:
         buf = b""
@@ -75,21 +228,13 @@ class PSClient:
             buf += chunk
         return buf
 
-    def call(
-        self, op: int, name: str = "", a: int = 0, b: int = 0,
-        payload: np.ndarray | None = None,
-    ) -> tuple[int, np.ndarray]:
-        nm = name.encode()
-        pl = (
-            np.ascontiguousarray(payload, np.float32).tobytes()
-            if payload is not None
-            else b""
-        )
-        req = (
-            struct.pack("<BB", op, len(nm)) + nm
-            + struct.pack("<qqI", a, b, len(pl) // 4) + pl
-        )
-        with self._lock:
+    def _attempt(self, req: bytes, deadline_s: float | None) -> tuple[int, np.ndarray]:
+        """One send/recv round trip; severs the socket on ANY failure (the
+        framing is broken mid-stream, so the connection is unusable)."""
+        if self._sock is None:
+            raise ConnectionError("not connected")
+        try:
+            self._sock.settimeout(deadline_s)
             self._sock.sendall(req)
             status, plen = struct.unpack("<qI", self._recv_n(12))
             out = (
@@ -97,12 +242,206 @@ class PSClient:
                 if plen
                 else np.empty((0,), np.float32)
             )
-        return status, out
+            return status, out
+        except OSError:
+            self._sever()
+            raise
+
+    # -- recovery -----------------------------------------------------------
+
+    def _register_ensure(self, op: int, name: str, a: int, b: int) -> None:
+        self._ensures.append((op, name, a, b))
+
+    def ensure_object(self, op: int, name: str, a: int = 0, b: int = 0) -> int:
+        """Issue a get-or-create op AND remember it, so a reincarnated
+        server (restart lost every object) gets them re-created on
+        reconnect.  Returns the status.  Only a SUCCESSFUL create is
+        remembered — a rejected one (type/name clash) must not poison the
+        reincarnation replay for the client's healthy objects."""
+        status, _ = self.call(op, name, a, b)
+        if status >= 0:
+            self._register_ensure(op, name, a, b)
+        return status
+
+    def on_reincarnation(self, fn) -> None:
+        """Register a callback run (after object re-creation) whenever a
+        reconnect lands on a NEW server incarnation — the chief re-seeds
+        volatile state here (republish params, reset step, re-push
+        tokens).  Callbacks may use this client; their ops run
+        single-attempt (no nested recovery)."""
+        self._callbacks.append(fn)
+
+    def _recover(self, t_end: float) -> None:
+        """Reconnect with exponential backoff until ``t_end``; on success,
+        detect a server restart via the incarnation id and rebuild state."""
+        attempt = 0
+        while True:
+            if attempt:  # first attempt is immediate — the common drop is
+                # transient with a healthy server; backoff paces retries.
+                delay = min(self._backoff * (2 ** min(attempt - 1, 6)), 2.0)
+                time.sleep(min(delay, max(0.0, t_end - time.monotonic())))
+            if time.monotonic() >= t_end:
+                faults.log_event(
+                    "reconnect_gave_up", role=self.role, host=self._host,
+                    port=self._port, attempts=attempt,
+                )
+                raise PSDeadlineError(
+                    f"PS at {self._host}:{self._port} unreachable for "
+                    f"{self._reconnect_deadline:.0f}s ({attempt} attempts)"
+                )
+            attempt += 1
+            try:
+                self._connect()
+            except OSError:
+                continue
+            try:
+                self._post_reconnect(attempt)
+                return
+            except (OSError, PSError):
+                # PSError: a transport failure inside a reincarnation
+                # callback (callbacks run single-attempt and wrap their
+                # OSError) — same fault as a raw drop, same retry, same
+                # deadline.
+                self._sever()
+                continue
+
+    def _post_reconnect(self, attempts: int) -> None:
+        inc, _ = self._attempt(self._frame(_INCARNATION), self._op_timeout or 10.0)
+        changed = inc != self._incarnation
+        faults.log_event(
+            "reconnected", role=self.role, attempts=attempts,
+            incarnation_changed=changed,
+        )
+        if not changed:
+            return
+        # Server restarted: every object is gone.  Re-create them in
+        # creation order, then let the owner re-seed volatile state.
+        self._in_recovery = True
+        try:
+            for op, name, a, b in list(self._ensures):
+                status, _ = self._attempt(
+                    self._frame(op, name, a, b), self._op_timeout or 10.0
+                )
+                if status < 0:
+                    raise ConnectionError(
+                        f"object re-create op {op} {name!r} rejected ({status})"
+                    )
+            for fn in list(self._callbacks):
+                fn()
+        finally:
+            self._in_recovery = False
+        self._incarnation = inc
+        faults.log_event(
+            "state_rebuilt", role=self.role, objects=len(self._ensures),
+            callbacks=len(self._callbacks),
+        )
+
+    # -- ops ----------------------------------------------------------------
+
+    def call(
+        self, op: int, name: str = "", a: int = 0, b: int = 0,
+        payload: np.ndarray | None = None, *, replay_safe: bool = True,
+        server_wait_s: float = 0.0, fault_point: bool = True,
+    ) -> tuple[int, np.ndarray]:
+        """One request/response; recovers + replays on transport failure
+        when recovery is enabled and the op is ``replay_safe`` (idempotent
+        or dedup-tagged).  ``server_wait_s``: how long the server may
+        legitimately block on this op — added to the op deadline so a
+        bounded wait is never mistaken for a dead peer.  ``fault_point``:
+        whether this call advances the fault-injection op counter — the
+        chunked re-issues of one logical blocking op pass False so plan
+        indices count LOGICAL ops, not timing-dependent chunks."""
+        req = self._frame(op, name, a, b, payload)
+        deadline = (
+            self._op_timeout + server_wait_s
+            if self._op_timeout is not None
+            else None
+        )
+        with self._lock:
+            if (
+                fault_point
+                and self._injector is not None
+                and self._injector.before_op(op)
+            ):
+                self._sever()  # injected drop_conn: fail this op's transport
+            t_end = None
+            while True:
+                if self._sock is not None:
+                    try:
+                        return self._attempt(req, deadline)
+                    except OSError as e:
+                        if self._in_recovery or self._reconnect_deadline <= 0:
+                            raise PSError(f"PS op {op} failed: {e!r}") from e
+                        if not replay_safe:
+                            raise PSError(
+                                f"PS op {op} not replay-safe; connection lost "
+                                f"mid-op: {e!r}"
+                            ) from e
+                        faults.log_event(
+                            "conn_lost", role=self.role, op_code=op,
+                            error=type(e).__name__,
+                        )
+                elif self._in_recovery or self._reconnect_deadline <= 0:
+                    raise PSError(f"PS op {op} failed: not connected")
+                if t_end is None:
+                    t_end = time.monotonic() + self._reconnect_deadline
+                self._recover(t_end)
+
+    def block_wait_s(self, t_end: float | None = None) -> float:
+        """Server-side wait for the next blocking-op round trip: chunked
+        (``block_chunk_s``) when this client has a deadline or recovery to
+        honor, else 0 (= block forever, the pre-r6 wire behavior)."""
+        chunk = (
+            self.block_chunk_s
+            if (self._op_timeout is not None or self._reconnect_deadline > 0)
+            else 0.0
+        )
+        if t_end is None:
+            return chunk
+        remaining = max(0.05, t_end - time.monotonic())
+        return min(chunk, remaining) if chunk else remaining
+
+    def timed_blocking(
+        self, op: int, name: str, make_ab, timeout_s: float | None = None
+    ):
+        """One LOGICAL blocking op issued as bounded server-side waits that
+        are re-issued on expiry (-3) until data, cancellation, or
+        ``timeout_s``.  ``make_ab(wait_ms) -> (a, b)`` builds the operands
+        for each chunk.  Returns ``(status, payload)``, or ``(TIMED_OUT,
+        None)`` when the caller deadline expires.  Only the first chunk is
+        a fault-injection point — plan op indices count logical ops."""
+        t_end = (
+            time.monotonic() + timeout_s if timeout_s is not None else None
+        )
+        first = True
+        while True:
+            wait_s = self.block_wait_s(t_end)
+            a, b = make_ab(int(wait_s * 1000))
+            status, out = self.call(
+                op, name, a, b, server_wait_s=wait_s, fault_point=first
+            )
+            first = False
+            if status == -3:
+                if t_end is not None and time.monotonic() >= t_end:
+                    return TIMED_OUT, None
+                continue
+            return status, out
+
+    def fail_fast(self) -> None:
+        """Disable reconnect/recovery for all subsequent ops on this
+        client.  Teardown-time best-effort signals (e.g. the chief's
+        ``ps_shutdown`` push) must not spend the reconnect budget on a
+        peer that may already be gone."""
+        self._reconnect_deadline = 0.0
 
     def ping(self) -> None:
         status, _ = self.call(_PING)
         if status != 0:
             raise RuntimeError("PS server ping failed")
+
+    def incarnation(self) -> int:
+        status, _ = self.call(_INCARNATION)
+        return status
 
     def cancel_all(self) -> None:
         self.call(_CANCEL_ALL)
@@ -114,19 +453,61 @@ def _check(status: int, what: str) -> int:
     return status
 
 
+# Wire packing of the (worker, seq) dedup tag — one definition, shared with
+# the in-process ctypes wrappers (ps_server.cc layout, 15-bit worker).
+_pack_tag = native._tag
+
+
+
+
 class RemoteAccumulator:
-    """API-compatible with native.GradientAccumulator, over the socket."""
+    """API-compatible with native.GradientAccumulator, over the socket.
+
+    On a client with a ``worker_tag``, applies are dedup-tagged: each
+    logical apply gets the next per-object sequence number, retries of it
+    replay the SAME number, and the server drops anything it has already
+    processed — zero duplicate applications across reconnects."""
 
     def __init__(self, client: PSClient, name: str, num_elems: int):
         self._c, self._name, self._n = client, name, num_elems
-        _check(client.call(_ACC_GET, name, num_elems)[0], "acc_get")
+        self._seq = 0
+        _check(client.ensure_object(_ACC_GET, name, num_elems), "acc_get")
+        if client.worker_tag is not None:
+            # Announce this (possibly restarted) worker: the server forgets
+            # the dead incarnation's sequences so our fresh 0-based stream
+            # is not answered "duplicate".  Idempotent, replay-safe.
+            _check(
+                client.call(_ACC_RESET_WORKER, name, client.worker_tag)[0],
+                "acc_reset_worker",
+            )
 
     def apply(self, local_step: int, grad: np.ndarray) -> bool:
-        s, _ = self._c.call(_ACC_APPLY, self._name, local_step, payload=grad)
-        return _check(s, "acc_apply") == 1
+        if self._c.worker_tag is None:
+            s, _ = self._c.call(
+                _ACC_APPLY, self._name, local_step, payload=grad,
+                replay_safe=False,
+            )
+            return _check(s, "acc_apply") == 1
+        self._seq += 1
+        s, _ = self._c.call(
+            _ACC_APPLY_TAGGED, self._name, local_step,
+            _pack_tag(self._c.worker_tag, self._seq), payload=grad,
+        )
+        # 1 = freshly accepted; 0 = stale-dropped; 2 = duplicate replay —
+        # the first delivery's outcome (accepted OR dropped) is unknown, so
+        # report False ("did not newly count"), matching
+        # native.GradientAccumulator.apply_tagged.
+        return _check(s, "acc_apply_tagged") == 1
 
-    def take(self, num_required: int) -> np.ndarray | None:
-        s, out = self._c.call(_ACC_TAKE, self._name, num_required)
+    def take(self, num_required: int, timeout_s: float | None = None):
+        """Blocking average; None when cancelled, ``TIMED_OUT`` when
+        ``timeout_s`` expires.  Issued as bounded server-side waits so a
+        dead PS surfaces between chunks and the reconnect path heals it."""
+        s, out = self._c.timed_blocking(
+            _ACC_TAKE, self._name, lambda w: (num_required, w), timeout_s
+        )
+        if s is TIMED_OUT:
+            return TIMED_OUT
         return out if _check(s, "acc_take") >= 0 else None
 
     def set_global_step(self, step: int) -> None:
@@ -135,6 +516,10 @@ class RemoteAccumulator:
     @property
     def dropped(self) -> int:
         return _check(self._c.call(_ACC_DROPPED, self._name)[0], "acc_dropped")
+
+    @property
+    def deduped(self) -> int:
+        return _check(self._c.call(_ACC_DEDUPED, self._name)[0], "acc_deduped")
 
     def cancel(self) -> None:
         self._c.cancel_all()
@@ -145,13 +530,19 @@ class RemoteTokenQueue:
 
     def __init__(self, client: PSClient, name: str):
         self._c, self._name = client, name
-        _check(client.call(_TQ_GET, name)[0], "tq_get")
+        _check(client.ensure_object(_TQ_GET, name), "tq_get")
 
     def push(self, step: int, n: int = 1) -> None:
         _check(self._c.call(_TQ_PUSH, self._name, step, n)[0], "tq_push")
 
-    def pop(self) -> int | None:
-        s, _ = self._c.call(_TQ_POP, self._name)
+    def pop(self, timeout_s: float | None = None):
+        """Blocking; token step, None when cancelled, ``TIMED_OUT`` when
+        ``timeout_s`` expires first."""
+        s, _ = self._c.timed_blocking(
+            _TQ_POP, self._name, lambda w: (w, 0), timeout_s
+        )
+        if s is TIMED_OUT:
+            return TIMED_OUT
         return s if s >= 0 else None
 
     def cancel(self) -> None:
@@ -159,20 +550,63 @@ class RemoteTokenQueue:
 
 
 class RemoteGradientQueue:
-    """API-compatible with native.GradientQueue."""
+    """API-compatible with native.GradientQueue (tagged pushes on clients
+    with a ``worker_tag`` — see RemoteAccumulator)."""
 
     def __init__(self, client: PSClient, name: str, num_elems: int, capacity: int = 16):
         self._c, self._name, self._n = client, name, num_elems
-        _check(client.call(_GQ_GET, name, num_elems, capacity)[0], "gq_get")
+        self._seq = 0
+        _check(client.ensure_object(_GQ_GET, name, num_elems, capacity), "gq_get")
+        if client.worker_tag is not None:
+            # See RemoteAccumulator: restarted-worker announcement.
+            _check(
+                client.call(_GQ_RESET_WORKER, name, client.worker_tag)[0],
+                "gq_reset_worker",
+            )
 
     def push(self, local_step: int, grad: np.ndarray) -> bool | None:
         """Tri-state like native.GradientQueue.push: True enqueued, False
         stale-dropped, None cancelled (termination signal)."""
-        s, _ = self._c.call(_GQ_PUSH, self._name, local_step, payload=grad)
-        return None if _check(s, "gq_push") < 0 else s == 1
+        if self._c.worker_tag is None:
+            s, _ = self._c.call(
+                _GQ_PUSH, self._name, local_step, payload=grad,
+                replay_safe=False,
+            )
+            return None if _check(s, "gq_push") < 0 else s == 1
+        self._seq += 1
+        tag = _pack_tag(self._c.worker_tag, self._seq)
+        # Backpressure on a full queue becomes a dedup-safe ~2 s poll (the
+        # server bounds its own space wait and answers -3).  Each re-issue
+        # re-sends the payload, so the poll period is deliberately coarse;
+        # the overall stall is bounded — a chief wedged this long is a job
+        # failure, not backpressure.
+        t_end = time.monotonic() + _PUSH_STALL_LIMIT_S
+        first = True
+        while True:
+            s, _ = self._c.call(
+                _GQ_PUSH_TAGGED, self._name, local_step, tag, payload=grad,
+                server_wait_s=2.5, fault_point=first,
+            )
+            first = False
+            if s == -3:
+                if time.monotonic() >= t_end:
+                    raise PSDeadlineError(
+                        f"gradient queue {self._name!r} full for "
+                        f"{_PUSH_STALL_LIMIT_S:.0f}s (chief stalled?)"
+                    )
+                continue
+            _check(s, "gq_push_tagged")
+            # 1 enqueued / 2 duplicate-of-enqueued -> True; 0 stale -> False.
+            return None if s < 0 else s != 0
 
-    def pop(self) -> tuple[int, np.ndarray] | None:
-        s, out = self._c.call(_GQ_POP, self._name, self._n)
+    def pop(self, timeout_s: float | None = None):
+        """Blocking; (local_step, grad), None when cancelled+drained, or
+        ``TIMED_OUT`` when ``timeout_s`` expires first."""
+        s, out = self._c.timed_blocking(
+            _GQ_POP, self._name, lambda w: (self._n, w), timeout_s
+        )
+        if s is TIMED_OUT:
+            return TIMED_OUT
         return (s, out) if s >= 0 else None
 
     def set_min_step(self, step: int) -> None:
@@ -181,6 +615,10 @@ class RemoteGradientQueue:
     @property
     def dropped(self) -> int:
         return _check(self._c.call(_GQ_DROPPED, self._name)[0], "gq_dropped")
+
+    @property
+    def deduped(self) -> int:
+        return _check(self._c.call(_GQ_DEDUPED, self._name)[0], "gq_deduped")
 
     def cancel(self) -> None:
         self._c.cancel_all()
@@ -193,9 +631,11 @@ class RemoteParamStore:
 
     def __init__(self, client: PSClient, name: str, num_elems: int):
         self._c, self._name, self._n = client, name, num_elems
-        _check(client.call(_PSTORE_GET_OBJ, name, num_elems)[0], "pstore_get_obj")
+        _check(client.ensure_object(_PSTORE_GET_OBJ, name, num_elems), "pstore_get_obj")
 
     def set(self, step: int, flat: np.ndarray) -> None:
+        # Replay-safe: single-writer (the chief), so a replayed set can
+        # never be reordered against a newer one on the same connection.
         _check(self._c.call(_PSTORE_SET, self._name, step, payload=flat)[0],
                "pstore_set")
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import sys
 import time
 from typing import Any, Callable, Iterator
 
@@ -123,7 +124,11 @@ def run_ps_emulation(
     _print_final(
         step=trainer.global_step, dt=dt, local_bs=local_bs, mode=mode,
         metrics=metrics,
-        eps_per_chip=sps * local_bs / max(1, len(jax.devices())),
+        # Sync mode consumes replicas_to_aggregate worker batches per
+        # applied step — count them all, not just the chief's one
+        # (ADVICE r5: the old definition undercounted by ~n_workers).
+        eps_per_chip=sps * local_bs * (r2a if mode == "sync_replicas" else 1)
+        / max(1, len(jax.devices())),
         extra={
             "stale_dropped": trainer.total_dropped,
             "first_loss": f"{losses[0]:.4f}",
@@ -209,7 +214,10 @@ def _probe_ps(host: str, port: int, deadline_s: float) -> bool:
             c.ping()
             c.close()
             return True
-        except OSError:
+        except (OSError, ps_service.PSError):
+            # PSError covers a PS that accepts the connection but drops it
+            # mid-ping (e.g. mid-restart under the supervisor) — keep
+            # polling, exactly like a refused connection.
             time.sleep(0.2)
     return False
 
@@ -236,11 +244,19 @@ def run_ps_cluster_task(
     - ``worker``: gradient computation against the published snapshots
                   (``remote_worker_loop``), data-sharded by ``task_index``.
 
+    Fault posture (r6): each task gets a fault role (``ps0``, ``chief0``,
+    ``worker<i>``) for ``DTX_FAULT_PLAN`` matching, and the PS task runs
+    under ``utils.supervisor.supervise()`` (``--ps_restarts``), so a PS
+    crash is healed by PS restart + client reconnect/reseed instead of the
+    whole-job crash-restart path — see RUNBOOK.md "Fault injection &
+    recovery".
+
     Launch recipe: RUNBOOK.md "Cross-process PS".
     """
     import jax
 
     from ..parallel import async_ps
+    from ..utils import faults
 
     entries = FLAGS.ps_hosts.split(",")
     host, port_s = entries[0].rsplit(":", 1)
@@ -260,6 +276,8 @@ def run_ps_cluster_task(
         acfg = dataclasses.replace(acfg, fixed_interleave=False)
     job = FLAGS.job_name
     chief_hosts_service = FLAGS.ps_tasks == 0
+    if not faults.current_role():
+        faults.set_role(f"{job}{FLAGS.task_index}")
 
     if job == "ps":
         if chief_hosts_service:
@@ -271,11 +289,57 @@ def run_ps_cluster_task(
             min(FLAGS.task_index, len(entries) - 1)
         ].rsplit(":", 1)
         listen_all = _resolve_listen_all(FLAGS, my_host)
+        restarts = int(getattr(FLAGS, "ps_restarts", 0) or 0)
+        launcher = os.path.abspath(sys.argv[0]) if sys.argv else ""
+        if restarts > 0 and not (launcher.endswith(".py") and os.path.isfile(launcher)):
+            # Supervision re-execs the launch script; a programmatic or
+            # embedded caller whose argv does not reproduce this config
+            # would supervise the WRONG thing — host unsupervised instead.
+            log.warning(
+                "--ps_restarts=%d: launcher %r is not a re-executable "
+                "script; hosting the PS service unsupervised (a PS crash "
+                "falls back to whole-job restart)", restarts, sys.argv[:1],
+            )
+            restarts = 0
+        if restarts > 0 and os.environ.get("DTX_PS_SUPERVISED") != "1":
+            # Run the actual hosting in a supervised CHILD: a PS crash
+            # (injected or organic) is healed by a fresh incarnation on the
+            # same port, which the chief/worker clients reconnect into —
+            # partial recovery instead of whole-job crash-restart.
+            from ..utils import supervisor
+
+            env = dict(os.environ)
+            env["DTX_PS_SUPERVISED"] = "1"
+
+            def heal_fault_plan(env: dict, attempt: int, returncode: int) -> dict:
+                # A fault-INJECTED death must not re-fire in the healing
+                # incarnation (the plan is inherited through the env);
+                # organic crashes keep the plan untouched.
+                if returncode == faults.FAULT_EXIT_CODE and env.get("DTX_FAULT_PLAN"):
+                    env["DTX_FAULT_PLAN"] = faults.plan_without(
+                        env["DTX_FAULT_PLAN"], "die", faults.current_role()
+                    )
+                    faults.log_event(
+                        "supervisor_healed_plan", role=faults.current_role(),
+                        attempt=attempt,
+                    )
+                return env
+
+            rc = supervisor.supervise(
+                [sys.executable, os.path.abspath(sys.argv[0]), *sys.argv[1:]],
+                max_restarts=restarts,
+                env=env,
+                mutate_env=heal_fault_plan,
+            )
+            if rc != 0:
+                raise SystemExit(rc)
+            return None
         bound = async_ps.host_ps_task(int(my_port), loopback_only=not listen_all)
         print(f"PS_DONE port={bound}")
         return None
 
     if job == "chief":
+        faults.arm_process_faults()
         params = init_fn(jax.random.key(FLAGS.seed))
         if isinstance(params, tuple):
             params, model_state = params
@@ -311,17 +375,25 @@ def run_ps_cluster_task(
         metrics = eval_fn(final_params) if eval_fn is not None else {}
         # Same examples_per_sec_per_chip DEFINITION as the thread-emulation
         # path: divide by the chief's device count (ADVICE r4 — one scrapable
-        # field name must not carry two definitions across the PS modes).
+        # field name must not carry two definitions across the PS modes), and
+        # count all replicas_to_aggregate worker batches per sync step
+        # (ADVICE r5).
         sps = trainer.global_step / dt if dt > 0 else 0.0
+        r2a = (
+            (acfg.replicas_to_aggregate or n_workers)
+            if mode == "sync_replicas"
+            else 1
+        )
         _print_final(
             step=trainer.global_step, dt=dt, local_bs=local_bs,
             mode=f"{mode}_cluster", metrics=metrics,
-            eps_per_chip=sps * local_bs / max(1, len(jax.devices())),
+            eps_per_chip=sps * local_bs * r2a / max(1, len(jax.devices())),
             extra={"workers": n_workers, "stale_dropped": trainer.total_dropped},
         )
         return final_params
 
     # job == "worker"
+    faults.arm_process_faults()
     wid = FLAGS.task_index
     if not _probe_ps(host, port, 120.0):
         raise ConnectionError(f"no PS service at {host}:{port} after 120 s")

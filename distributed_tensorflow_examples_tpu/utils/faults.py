@@ -1,0 +1,317 @@
+"""Deterministic, seedable fault-injection layer for the PS path.
+
+The reference inherits TF's fault model: a lost PS task stalls every worker
+until the runtime tears the session down and the whole job crash-restarts
+(SURVEY.md section 5.3).  This module makes faults *injectable, survivable
+and tested* instead: a fault plan — activated via the ``DTX_FAULT_PLAN``
+env var, so every child process of a ``utils.multiprocess`` cluster (or a
+``--job_name`` launch) inherits it — scripts exactly which process drops a
+connection, delays an op, or dies, and when.  The recovery machinery under
+test lives in ``parallel/ps_service.py`` (deadline/backoff/reconnect/replay)
+and ``train/ps_experiment.py`` (PS task under ``supervise()``).
+
+Plan syntax (semicolon-separated specs, ``kind:key=val,key=val``)::
+
+    DTX_FAULT_PLAN='drop_conn:role=worker0,op=25;die:role=ps,after_reqs=120'
+
+Kinds:
+
+- ``drop_conn`` — the matching process's ``PSClient`` closes its socket
+  right before its ``op``-th call (1-based, counted per client), forcing
+  the reconnect+replay path.  ``count`` (default 1) repeats the fault on
+  the following calls too.
+- ``delay`` — sleep ``ms`` milliseconds before the ``op``-th call (and the
+  next ``count-1`` calls): the slow-PS / slow-network fault.
+- ``die`` — the matching PROCESS exits with code ``FAULT_EXIT_CODE`` (43)
+  either ``after_s`` seconds after :func:`arm_process_faults`, or once the
+  in-process PS server has served ``after_reqs`` requests (the "kill PS at
+  step K" fault).  The request count tracks the coordination traffic but
+  is not exactly reproducible across machines — idle shutdown-queue polls
+  and bounded-wait chunk re-issues add timing-dependent requests — so
+  pick triggers with margin (well above startup chatter, well below the
+  run's total).  One-shot: a supervisor restarting the task strips the
+  spec via :func:`plan_without` so the incarnation that heals is not
+  re-killed.
+
+Every spec takes ``role=`` (fnmatch glob, default ``*``) matched against
+the process role — set by launchers via the ``DTX_FAULT_ROLE`` env var or
+:func:`set_role` (``ps0``, ``chief0``, ``worker1``, ``task2``...).  Client
+faults additionally take ``p=``/``seed=`` for probabilistic injection: the
+RNG is seeded from ``(seed, role, op-kind)``, and op indices count LOGICAL
+client ops (chunk re-issues of one blocking op don't advance the counter),
+so a given plan fires at the same logical operation in every run —
+deterministic AND seedable.  (``after_reqs`` is the exception: see above.)
+
+Observability: every injected fault and every recovery action logs one
+structured line through the ``dtx.faults`` logger (``dtx.faults
+event=<name> k=v ...``), so tests — and operators grepping task logs —
+can assert the recovery path actually ran.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import logging
+import os
+import sys
+import threading
+import time
+import zlib
+
+log = logging.getLogger("dtx.faults")
+
+#: Exit code of a fault-injected process death ("die" spec).  Distinctive so
+#: supervisors/tests can tell an injected kill from an organic crash.
+FAULT_EXIT_CODE = 43
+
+_CLIENT_KINDS = ("drop_conn", "delay")
+_KINDS = _CLIENT_KINDS + ("die",)
+
+_role_lock = threading.Lock()
+_role: str | None = None
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    kind: str
+    role: str = "*"  # fnmatch glob against the process role
+    op: int = 0  # client faults: 1-based call index the fault fires at
+    count: int = 1  # client faults: consecutive calls affected
+    ms: float = 0.0  # delay: sleep duration
+    after_s: float = 0.0  # die: seconds after arming
+    after_reqs: int = 0  # die: server requests served (PS-side step analog)
+    p: float = 1.0  # client faults: per-eligible-op probability
+    seed: int = 0  # seeds the probabilistic RNG (with role+kind)
+
+    def matches_role(self, role: str) -> bool:
+        return fnmatch.fnmatchcase(role, self.role)
+
+
+def parse_plan(plan: str) -> list[FaultSpec]:
+    """Parse a ``DTX_FAULT_PLAN`` string; raises ValueError on bad syntax so
+    a typo'd plan fails the launch instead of silently injecting nothing."""
+    specs: list[FaultSpec] = []
+    for raw in plan.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        kind, _, rest = raw.partition(":")
+        kind = kind.strip()
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} in {raw!r}")
+        kw: dict = {}
+        for item in filter(None, (s.strip() for s in rest.split(","))):
+            key, has_eq, val = item.partition("=")
+            if not has_eq:
+                raise ValueError(f"bad fault field {item!r} in {raw!r}")
+            if key == "role":
+                kw[key] = val
+            elif key in ("op", "count", "after_reqs", "seed"):
+                kw[key] = int(val)
+            elif key in ("ms", "after_s", "p"):
+                kw[key] = float(val)
+            else:
+                raise ValueError(f"unknown fault field {key!r} in {raw!r}")
+        spec = FaultSpec(kind=kind, **kw)
+        if spec.kind in _CLIENT_KINDS and spec.op <= 0:
+            raise ValueError(f"{kind} fault needs op=<n> (1-based): {raw!r}")
+        if spec.kind == "die" and not (spec.after_s > 0 or spec.after_reqs > 0):
+            raise ValueError(f"die fault needs after_s or after_reqs: {raw!r}")
+        specs.append(spec)
+    return specs
+
+
+def format_plan(specs: list[FaultSpec]) -> str:
+    """Inverse of :func:`parse_plan` (used to strip fired specs on restart)."""
+    out = []
+    for s in specs:
+        fields = []
+        defaults = FaultSpec(kind=s.kind)
+        for f in dataclasses.fields(FaultSpec):
+            if f.name == "kind":
+                continue
+            v = getattr(s, f.name)
+            if v != getattr(defaults, f.name):
+                fields.append(f"{f.name}={v}")
+        out.append(s.kind + (":" + ",".join(fields) if fields else ""))
+    return ";".join(out)
+
+
+def plan_without(plan: str, kind: str, role: str) -> str:
+    """The plan minus specs of ``kind`` whose role glob matches ``role`` —
+    how a supervisor avoids re-killing the incarnation that heals the
+    fault it just injected."""
+    return format_plan(
+        [s for s in parse_plan(plan) if not (s.kind == kind and s.matches_role(role))]
+    )
+
+
+def set_role(role: str) -> None:
+    """Set this process's fault role (launchers call this; also exported to
+    children via ``DTX_FAULT_ROLE``)."""
+    global _role
+    with _role_lock:
+        _role = role
+    os.environ["DTX_FAULT_ROLE"] = role
+
+
+def current_role() -> str:
+    with _role_lock:
+        if _role is not None:
+            return _role
+    return os.environ.get("DTX_FAULT_ROLE", "")
+
+
+def active_plan() -> str:
+    return os.environ.get("DTX_FAULT_PLAN", "")
+
+
+def log_event(event: str, **fields) -> None:
+    """One structured ``dtx.faults`` line per fault/recovery action.  A
+    stderr handler (and an INFO level) is attached on first use when the
+    ambient logging config would swallow the event — recovery evidence
+    must reach per-task log files even in processes whose root logger sits
+    at the WARNING default.  Propagation stays on, so pytest's caplog (and
+    any operator-configured root handler) still sees every event."""
+    if not log.handlers and not log.isEnabledFor(logging.INFO):
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter("%(message)s"))
+        log.addHandler(h)
+        log.setLevel(logging.INFO)
+    kv = " ".join(f"{k}={fields[k]}" for k in sorted(fields))
+    log.info("dtx.faults event=%s%s", event, (" " + kv) if kv else "")
+
+
+class ClientFaultInjector:
+    """Per-``PSClient`` hook: consults the plan before every client op.
+    Deterministic — the op counter is per client, and the probabilistic RNG
+    is seeded from (seed, role, kind)."""
+
+    def __init__(self, role: str | None = None, plan: str | None = None):
+        self.role = role if role is not None else current_role()
+        raw = plan if plan is not None else active_plan()
+        self._specs = [
+            s
+            for s in (parse_plan(raw) if raw else [])
+            if s.kind in _CLIENT_KINDS and s.matches_role(self.role)
+        ]
+        self._op = 0
+        self._rngs: dict[int, "_DetRng"] = {}
+
+    def _fires(self, i: int, spec: FaultSpec) -> bool:
+        if not (spec.op <= self._op < spec.op + spec.count):
+            return False
+        if spec.p >= 1.0:
+            return True
+        rng = self._rngs.setdefault(i, _DetRng(spec.seed, self.role, spec.kind))
+        return rng.uniform() < spec.p
+
+    def before_op(self, op_code: int) -> bool:
+        """Advance the op counter; sleep for matching delays.  Returns True
+        when a drop_conn fault fires (the caller must sever its socket)."""
+        if not self._specs:
+            return False
+        self._op += 1
+        drop = False
+        for i, spec in enumerate(self._specs):
+            if not self._fires(i, spec):
+                continue
+            if spec.kind == "delay":
+                log_event(
+                    "inject_delay", role=self.role, op=self._op,
+                    op_code=op_code, ms=spec.ms,
+                )
+                time.sleep(spec.ms / 1000.0)
+            elif spec.kind == "drop_conn":
+                log_event(
+                    "inject_drop_conn", role=self.role, op=self._op,
+                    op_code=op_code,
+                )
+                drop = True
+        return drop
+
+
+class _DetRng:
+    """Tiny deterministic uniform stream (no numpy import on the hot path):
+    xorshift64* seeded from (seed, role, kind)."""
+
+    def __init__(self, seed: int, role: str, kind: str):
+        self._s = (
+            (seed * 0x9E3779B97F4A7C15)
+            ^ zlib.crc32(f"{role}/{kind}".encode())
+        ) & 0xFFFFFFFFFFFFFFFF or 0x2545F4914F6CDD1D
+
+    def uniform(self) -> float:
+        x = self._s
+        x ^= (x >> 12) & 0xFFFFFFFFFFFFFFFF
+        x = (x ^ (x << 25)) & 0xFFFFFFFFFFFFFFFF
+        x ^= x >> 27
+        self._s = x
+        return ((x * 0x2545F4914F6CDD1D) & 0xFFFFFFFFFFFFFFFF) / 2**64
+
+
+def client_injector(role: str | None = None) -> ClientFaultInjector | None:
+    """A ``ClientFaultInjector`` for this process, or None when the plan has
+    no client faults for the role (keeps the no-faults hot path at zero
+    cost: one None check per op)."""
+    inj = ClientFaultInjector(role=role)
+    return inj if inj._specs else None
+
+
+def _die(spec: FaultSpec, role: str, **fields) -> None:
+    log_event("inject_die", role=role, exit=FAULT_EXIT_CODE, **fields)
+    for h in log.handlers:
+        try:
+            h.flush()
+        except Exception:
+            pass
+    os._exit(FAULT_EXIT_CODE)
+
+
+def arm_process_faults(
+    role: str | None = None, *, request_count_fn=None
+) -> list[threading.Thread]:
+    """Arm matching ``die`` specs for this process.  ``after_s`` specs start
+    a timer thread; ``after_reqs`` specs need ``request_count_fn`` (e.g.
+    ``ps_service.server_request_count`` in a PS task) and poll it.  Returns
+    the watcher threads (daemonic; tests may join on a dead process)."""
+    role = role if role is not None else current_role()
+    raw = active_plan()
+    if not raw:
+        return []
+    threads: list[threading.Thread] = []
+    for spec in parse_plan(raw):
+        if spec.kind != "die" or not spec.matches_role(role):
+            continue
+        if spec.after_s > 0:
+
+            def timer(spec=spec):
+                time.sleep(spec.after_s)
+                _die(spec, role, after_s=spec.after_s)
+
+            t = threading.Thread(target=timer, daemon=True, name="dtx-fault-die")
+            t.start()
+            threads.append(t)
+        if spec.after_reqs > 0:
+            if request_count_fn is None:
+                # Only a PS-server-hosting process has a request counter; a
+                # broad role glob (e.g. the '*' default) must not take down
+                # chief/worker tasks that merely match it — skip, loudly.
+                log_event(
+                    "fault_unarmed", role=role, kind="die",
+                    reason="after_reqs_without_request_counter",
+                )
+                continue
+
+            def poller(spec=spec):
+                while True:
+                    n = request_count_fn()
+                    if n >= spec.after_reqs:
+                        _die(spec, role, after_reqs=spec.after_reqs, reqs=n)
+                    time.sleep(0.02)
+
+            t = threading.Thread(target=poller, daemon=True, name="dtx-fault-die")
+            t.start()
+            threads.append(t)
+    return threads

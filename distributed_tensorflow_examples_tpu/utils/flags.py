@@ -143,6 +143,17 @@ def define_legacy_cluster_flags():
     )
     _define(
         "integer",
+        "ps_restarts",
+        3,
+        "Cross-process PS launch: run the --job_name=ps task under "
+        "utils.supervisor.supervise() with this restart budget, so a PS "
+        "crash is healed by PS restart + client reconnect (partial "
+        "recovery) instead of the whole-job crash-restart path.  0 "
+        "disables supervision (a PS crash then fails the job once the "
+        "clients' reconnect budget runs out).",
+    )
+    _define(
+        "integer",
         "replicas_to_aggregate",
         0,
         "(legacy, sync_replicas) gradients to aggregate per update; 0 = "

@@ -62,10 +62,17 @@ class MultiProcessRunner:
         timeout: float = 120.0,
         prelude: bool = True,
         pin_cpu: bool = True,
+        fault_plan: str | None = None,
     ):
         """``prelude=False`` skips the ``dist.initialize()`` header: the task
         script manages (or delegates) cluster bootstrap itself — e.g. a
         supervisor task whose *child* joins the coordination service.
+
+        ``fault_plan`` sets ``DTX_FAULT_PLAN`` for every task (see
+        ``utils.faults``); each task additionally gets a default fault role
+        ``task<i>`` via ``DTX_FAULT_ROLE`` (overridable through ``env``),
+        so a plan can target one task of the cluster.  The harness's own
+        ``kill_task`` remains the out-of-band SIGKILL fault.
 
         ``pin_cpu`` (default): every task pins the CPU platform via
         ``jax.config`` before the task body runs — this runner IS the fake
@@ -95,6 +102,8 @@ class MultiProcessRunner:
         with open(self.script_path, "w") as f:
             f.write(script)
         self.extra_env = dict(env or {})
+        if fault_plan is not None:
+            self.extra_env.setdefault("DTX_FAULT_PLAN", fault_plan)
         self.procs: list[subprocess.Popen] = []
         self.log_paths: list[str] = []
         self._log_files: list = []
@@ -120,6 +129,7 @@ class MultiProcessRunner:
                 # fake-cluster task cannot even touch the tunnel.
                 env.pop("PALLAS_AXON_POOL_IPS", None)
             env["TF_CONFIG"] = self._tf_config(i)
+            env["DTX_FAULT_ROLE"] = f"task{i}"
             env.update(self.extra_env)
             log_path = os.path.join(self._dir, f"task_{i}.log")
             self.log_paths.append(log_path)

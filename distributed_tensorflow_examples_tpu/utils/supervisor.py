@@ -44,6 +44,7 @@ def supervise(
     max_restarts: int = 3,
     backoff_s: float = 1.0,
     env: dict[str, str] | None = None,
+    mutate_env=None,
 ) -> int:
     """Run ``argv`` as a child process, restarting it on nonzero exit.
 
@@ -51,33 +52,91 @@ def supervise(
     code once ``max_restarts`` is exhausted.  Each restart logs the incident
     and waits ``backoff_s`` (linearly growing) so all tasks of a job have
     time to die before the new incarnation forms.
+
+    ``mutate_env(env, attempt, returncode) -> env`` runs before each
+    restart — e.g. the PS supervisor strips a fired ``die`` fault spec from
+    ``DTX_FAULT_PLAN`` so the healing incarnation is not re-killed by the
+    plan that killed its predecessor.
+
+    SIGTERM/SIGINT to the supervisor are forwarded to the child and end
+    supervision (no restart): killing the supervised task's visible pid
+    must kill the real server underneath, not orphan it.
     """
+    import signal as _signal
+
+    child: list[subprocess.Popen | None] = [None]
+    terminated = [False]
+
+    def _forward(signum, frame):
+        terminated[0] = True
+        p = child[0]
+        if p is not None and p.poll() is None:
+            try:
+                p.send_signal(signum)
+            except (ProcessLookupError, OSError):
+                pass
+
+    old_handlers = {}
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        try:
+            old_handlers[sig] = _signal.signal(sig, _forward)
+        except (ValueError, OSError):  # non-main thread: keep defaults
+            pass
+
     attempt = 0
-    while True:
-        proc = subprocess.run(argv, env=env)
-        if proc.returncode == 0:
-            if attempt:
-                log.info("supervise: child succeeded after %d restart(s)", attempt)
-            return 0
-        if attempt >= max_restarts:
-            log.error(
-                "supervise: child exited %d; restart budget (%d) exhausted",
-                proc.returncode,
+    returncode = 0
+    try:
+        while True:
+            if terminated[0]:
+                # Signal landed while no child was running (backoff window):
+                # honor it instead of spawning an incarnation it can't reach.
+                log.info("supervise: terminated by signal; not restarting")
+                return returncode or 130
+            proc = subprocess.Popen(argv, env=env)
+            child[0] = proc
+            if terminated[0] and proc.poll() is None:
+                # Signal raced the spawn (before child[0] was visible to
+                # the handler): forward it by hand.
+                proc.terminate()
+            returncode = proc.wait()
+            child[0] = None
+            if terminated[0]:
+                log.info("supervise: terminated by signal; not restarting")
+                return returncode
+            if returncode == 0:
+                if attempt:
+                    log.info(
+                        "supervise: child succeeded after %d restart(s)", attempt
+                    )
+                return 0
+            if attempt >= max_restarts:
+                log.error(
+                    "supervise: child exited %d; restart budget (%d) exhausted",
+                    returncode,
+                    max_restarts,
+                )
+                return returncode
+            attempt += 1
+            if mutate_env is not None:
+                env = mutate_env(dict(env if env is not None else os.environ),
+                                 attempt, returncode)
+            delay = backoff_s * attempt
+            log.warning(
+                "supervise: child exited %d; restart %d/%d in %.1fs "
+                "(whole-job crash-restart — training auto-resumes from the "
+                "last checkpoint)",
+                returncode,
+                attempt,
                 max_restarts,
+                delay,
             )
-            return proc.returncode
-        attempt += 1
-        delay = backoff_s * attempt
-        log.warning(
-            "supervise: child exited %d; restart %d/%d in %.1fs "
-            "(whole-job crash-restart — training auto-resumes from the last "
-            "checkpoint)",
-            proc.returncode,
-            attempt,
-            max_restarts,
-            delay,
-        )
-        time.sleep(delay)
+            time.sleep(delay)
+    finally:
+        for sig, handler in old_handlers.items():
+            try:
+                _signal.signal(sig, handler)
+            except (ValueError, OSError):
+                pass
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -42,6 +42,9 @@ def test_campaign_plan_is_well_formed():
         assert os.path.exists(os.path.join(ROOT, target)), (s["name"], target)
         for v in s.get("env", {}).values():
             assert v == "{FUSED}" or v.isdigit(), (s["name"], v)
+        # `optional` is consumed by the failure accounting — only True (or
+        # absent) is meaningful.
+        assert s.get("optional", True) is True, (s["name"], s.get("optional"))
     # flash_parity must run FIRST: it resolves the fused gate for the rest.
     assert names[0] == "flash_parity"
 
@@ -120,6 +123,13 @@ def test_campaign_report_renders(tmp_path, capsys):
             {"name": "flash_bench_t8192_f1", "cmd": "tools/flash_bench.py ...", "env": {},
              "rc": -9, "timed_out": True, "seconds": 1200.0, "json": None,
              "stdout_tail": "| row |", "stderr_tail": ""},
+            # Failed bench with a STALE json line: must render as FAILED,
+            # not as a clean measurement (ADVICE r5).
+            {"name": "bench_moe", "cmd": "bench.py ...", "env": {},
+             "rc": 1, "timed_out": False, "seconds": 90.0,
+             "json": {"metric": "moe_tokens", "value": 123.0, "unit": "tok/s",
+                      "vs_baseline": 0.5, "detail": {}},
+             "stdout_tail": "", "stderr_tail": ""},
         ],
     }
     p = tmp_path / "c.json"
@@ -135,4 +145,7 @@ def test_campaign_report_renders(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "parity_ok=True" in out
     assert "70000.0 tokens/sec/chip" in out and "42.0% MFU" in out
+    assert "`bench_t8192_fused` [ok]" in out
     assert "FAILED rc=-9 (timeout)" in out
+    # A failed bench step renders its status tag even with stale JSON.
+    assert "`bench_moe` [FAILED rc=1]" in out

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import tempfile
+import threading
 import time
 
 import pytest
@@ -211,9 +212,13 @@ def test_ps_protocol_rejects_bad_requests():
         with _pytest.raises(RuntimeError):
             acc.apply(0, np.zeros(8, np.float32))
         assert acc.apply(0, np.zeros(16, np.float32))
-        # Same name, different type -> rejected.
+        # Same name, different type -> rejected — and NOT remembered for
+        # the reincarnation replay (a poisoned ensure list would brick
+        # recovery for the client's healthy objects).
+        n_ensures = len(c._ensures)
         with _pytest.raises(RuntimeError):
             ps_service.RemoteTokenQueue(c, "a1")
+        assert len(c._ensures) == n_ensures
         # Unknown op code -> bad-request status, not a dead server.
         status, _ = c.call(99, "whatever")
         assert status == -2
@@ -227,6 +232,222 @@ def test_ps_protocol_rejects_bad_requests():
         step.set(3, np.arange(16, dtype=np.float32))
         got_step, vals = step.get()
         assert got_step == 3 and vals.shape == (16,)
+        c.close()
+    finally:
+        ps_service.stop_server()
+
+
+class _StallServer(threading.Thread):
+    """Protocol-shaped fake PS: answers the first ``replies_per_conn``
+    requests of each connection (status = ``incarnation``), then reads and
+    DISCARDS everything — the stalled-peer fault the client's deadlines
+    must bound.  Keeps accepting, so reconnects succeed while ops keep
+    hanging."""
+
+    def __init__(self, replies_per_conn: int = 1, incarnation: int = 7):
+        super().__init__(daemon=True)
+        import socket as _socket
+
+        self.replies_per_conn = replies_per_conn
+        self.incarnation = incarnation
+        self._sock = _socket.socket()
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._conns: list = []
+        self._stopped = False
+
+    def _serve_conn(self, c) -> None:
+        import struct as _struct
+
+        replies = self.replies_per_conn
+        try:
+            while True:
+                hdr = c.recv(2)
+                if len(hdr) < 2:
+                    return
+                op, name_len = hdr[0], hdr[1]
+                need = name_len + 20
+                body = b""
+                while len(body) < need:
+                    chunk = c.recv(need - len(body))
+                    if not chunk:
+                        return
+                    body += chunk
+                plen = _struct.unpack("<I", body[-4:])[0]
+                to_drain = plen * 4
+                while to_drain:
+                    chunk = c.recv(min(65536, to_drain))
+                    if not chunk:
+                        return
+                    to_drain -= len(chunk)
+                if replies > 0:
+                    replies -= 1
+                    c.sendall(_struct.pack("<qI", self.incarnation, 0))
+                # else: stall — read the next request, answer nothing.
+                del op
+        except OSError:
+            return
+
+    def run(self):
+        while not self._stopped:
+            try:
+                c, _ = self._sock.accept()
+            except OSError:
+                return
+            self._conns.append(c)
+            threading.Thread(target=self._serve_conn, args=(c,), daemon=True).start()
+
+    def stop(self):
+        self._stopped = True
+        for s in [self._sock, *self._conns]:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def test_client_op_deadline_bounds_a_stalled_server():
+    """Satellite (r6): a PS that accepts but never answers must surface as
+    a bounded failure, not an eternal hang — PSError within ~the op
+    deadline on a fail-fast client, PSDeadlineError once the reconnect
+    budget is exhausted on a recovering client (each reconnect lands, the
+    replayed op stalls again, the budget expires)."""
+    from distributed_tensorflow_examples_tpu.parallel import ps_service
+
+    srv = _StallServer(replies_per_conn=1)
+    srv.start()
+    try:
+        # Fail-fast client: ctor's incarnation query is answered, the next
+        # op stalls and times out promptly.
+        c = ps_service.PSClient("127.0.0.1", srv.port, timeout_s=0.4)
+        t0 = time.monotonic()
+        with pytest.raises(ps_service.PSError):
+            c.ping()
+        assert time.monotonic() - t0 < 5.0
+        c.close()
+
+        # Recovering client: reconnects DO succeed (the fake keeps
+        # accepting and answers each connection's first request), but the
+        # replayed op stalls every time — the reconnect deadline converts
+        # that into PSDeadlineError instead of an infinite retry loop.
+        c2 = ps_service.PSClient(
+            "127.0.0.1", srv.port, op_timeout_s=0.3,
+            reconnect_deadline_s=1.5, backoff_s=0.05,
+        )
+        t0 = time.monotonic()
+        with pytest.raises(ps_service.PSDeadlineError):
+            c2.ping()
+        dt = time.monotonic() - t0
+        assert 1.0 < dt < 30.0, dt
+        c2.close()
+    finally:
+        srv.stop()
+
+
+def test_client_reconnects_replays_and_dedups():
+    """Satellite (r6): transport drop mid-run against the REAL server —
+    the op is replayed transparently (same incarnation: no object rebuild),
+    and a deliberately duplicated tagged apply is suppressed by the
+    server's dedup table (the zero-duplicate-application mechanism)."""
+    import numpy as np
+
+    from distributed_tensorflow_examples_tpu.parallel import ps_service
+    from distributed_tensorflow_examples_tpu.parallel.ps_service import (
+        _ACC_APPLY_TAGGED,
+        _pack_tag,
+    )
+
+    port = ps_service.start_server(0)
+    try:
+        c = ps_service.PSClient(
+            "127.0.0.1", port, op_timeout_s=5.0, reconnect_deadline_s=10.0,
+            backoff_s=0.05, worker_tag=3,
+        )
+        inc0 = c.incarnation()
+        acc = ps_service.RemoteAccumulator(c, "a", 4)
+        assert acc.apply(0, np.ones(4))
+        # Sever the transport under the client; the next op must reconnect
+        # and succeed against the SAME incarnation (no state rebuild).
+        c._sock.close()
+        assert acc.apply(0, np.ones(4))
+        assert c.incarnation() == inc0
+        # A replayed delivery of an ALREADY-PROCESSED tagged apply (the
+        # response-lost-after-commit case) is deduped, not double-applied.
+        s, _ = c.call(_ACC_APPLY_TAGGED, "a", 0, _pack_tag(3, 2), payload=np.ones(4))
+        assert s == 2
+        assert acc.deduped == 1
+        out = acc.take(2)
+        np.testing.assert_allclose(out, np.ones(4))  # mean of exactly 2 applies
+        c.close()
+    finally:
+        ps_service.stop_server()
+
+
+def test_restarted_worker_same_tag_is_not_falsely_deduped():
+    """Satellite (r6): the server's dedup table is keyed by worker id and
+    outlives any one client, so a RESTARTED worker (same worker_tag, fresh
+    0-based sequence counter) must not have its fresh gradients answered
+    'duplicate' — object construction announces the new incarnation via
+    the reset-worker op, which forgets the dead stream's sequences."""
+    import numpy as np
+
+    from distributed_tensorflow_examples_tpu.parallel import ps_service
+
+    port = ps_service.start_server(0)
+    try:
+        c1 = ps_service.PSClient("127.0.0.1", port, timeout_s=5.0, worker_tag=5)
+        acc1 = ps_service.RemoteAccumulator(c1, "a", 2)
+        gq1 = ps_service.RemoteGradientQueue(c1, "g", 2, capacity=8)
+        for _ in range(3):
+            assert acc1.apply(0, np.ones(2))
+            assert gq1.push(0, np.ones(2)) is True
+        c1.close()
+        c2 = ps_service.PSClient("127.0.0.1", port, timeout_s=5.0, worker_tag=5)
+        acc2 = ps_service.RemoteAccumulator(c2, "a", 2)
+        gq2 = ps_service.RemoteGradientQueue(c2, "g", 2, capacity=8)
+        assert acc2.apply(0, np.ones(2))  # fresh gradient, NOT a duplicate
+        assert gq2.push(0, np.ones(2)) is True
+        assert acc2.deduped == 0 and gq2.deduped == 0
+        c2.close()
+    finally:
+        ps_service.stop_server()
+
+
+def test_client_rebuilds_state_across_server_restart():
+    """Satellite (r6): a reconnect landing on a NEW incarnation re-creates
+    every registered object and fires the on_reincarnation callbacks —
+    the client half of the PS-restart recovery the e2e fault matrix
+    (tests/test_faults.py) drives end to end."""
+    import numpy as np
+
+    from distributed_tensorflow_examples_tpu.parallel import ps_service
+
+    port = ps_service.start_server(0)
+    c = None
+    try:
+        c = ps_service.PSClient(
+            "127.0.0.1", port, op_timeout_s=5.0, reconnect_deadline_s=20.0,
+            backoff_s=0.05, worker_tag=1,
+        )
+        inc0 = c.incarnation()
+        acc = ps_service.RemoteAccumulator(c, "a", 2)
+        pstore = ps_service.RemoteParamStore(c, "p", 2)
+        pstore.set(5, np.ones(2))
+        fired = []
+        c.on_reincarnation(lambda: fired.append(pstore.get()[0]))
+        ps_service.stop_server()
+        assert ps_service.start_server(port) == port  # same address, new state
+        # Next op heals: reconnect -> incarnation change -> objects
+        # re-created -> callback ran against the FRESH (empty) store.
+        assert acc.apply(0, np.ones(2))
+        assert c.incarnation() != inc0
+        assert fired == [-1]  # the callback saw the empty re-created store
+        step, _ = pstore.get()
+        assert step == -1  # volatile state is gone until an owner reseeds
+        # Timed blocking ops still bound waits on the new incarnation.
+        tq = ps_service.RemoteTokenQueue(c, "t")
+        assert tq.pop(timeout_s=0.2) is ps_service.TIMED_OUT
         c.close()
     finally:
         ps_service.stop_server()

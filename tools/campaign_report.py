@@ -16,16 +16,19 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def fmt_bench(rec: dict) -> str:
+def fmt_bench(rec: dict, ok: str) -> str:
+    # The status tag renders like every other step type: a failed bench
+    # whose stdout still held a stale JSON line must read as FAILED, not
+    # as a clean measurement (ADVICE r5).
     j = rec.get("json") or {}
     d = j.get("detail", {})
     if not j:
-        return f"- `{rec['name']}`: NO JSON (rc={rec['rc']}, {rec['seconds']}s)"
+        return f"- `{rec['name']}` [{ok}]: NO JSON ({rec['seconds']}s)"
     mfu = d.get("mfu")
     mfu_s = f", {mfu*100:.1f}% MFU" if isinstance(mfu, (int, float)) else ""
     env = " ".join(f"{k}={v}" for k, v in rec.get("env", {}).items())
     return (
-        f"- `{rec['name']}`: **{j.get('value')} {j.get('unit')}**{mfu_s} "
+        f"- `{rec['name']}` [{ok}]: **{j.get('value')} {j.get('unit')}**{mfu_s} "
         f"(vs_baseline {j.get('vs_baseline')}; {env or 'default env'}; "
         f"{rec['seconds']}s wall)"
     )
@@ -41,7 +44,7 @@ def main():
         name = rec["name"]
         ok = "ok" if rec["rc"] == 0 else f"FAILED rc={rec['rc']}" + (" (timeout)" if rec.get("timed_out") else "")
         if name.startswith("bench_"):
-            print(fmt_bench(rec))
+            print(fmt_bench(rec, ok))
         elif name == "flash_parity":
             j = rec.get("json") or {}
             print(f"- `flash_parity` [{ok}]: parity_ok={j.get('parity_ok')} platform={j.get('platform')}")

@@ -238,8 +238,19 @@ def main():
     state["status"] = "running"
     flush()
 
-    # Step 1 resolves the fused gate for everything after it.
+    # Step 1 resolves the fused gate for everything after it.  On --resume
+    # the gate is recomputed from the kept steps — record it immediately so
+    # the out-file header never reports '?' for a gate the downstream steps
+    # actually ran with (ADVICE r5).
     fused_env = "1" if "flash_parity" in succeeded else "0"
+    if succeeded:
+        state["fused_gate"] = fused_env
+        flush()
+    # Failure accounting honors each step's `optional` flag: optional rows
+    # (deep-regime/segmented extras) may fail without demoting the campaign
+    # from "complete" — their failures are still recorded per step.
+    failed_required: list[str] = []
+    failed_optional: list[str] = []
     only = {s for s in args.only.split(",") if s}
     for step in steps_plan():
         if only and step["name"] not in only:
@@ -249,6 +260,12 @@ def main():
         print(f"[campaign] step {step['name']} ...", flush=True)
         rec = run_step(step, fused_env)
         state["steps"].append(rec)
+        if rec["rc"] != 0:
+            (failed_optional if step.get("optional") else failed_required).append(
+                step["name"]
+            )
+            state["failed_steps"] = failed_required
+            state["failed_optional"] = failed_optional
         flush()
         print(f"[campaign]   rc={rec['rc']} {rec['seconds']}s", flush=True)
         if step["name"] == "flash_parity":
@@ -269,9 +286,16 @@ def main():
                 flush()
                 print("[campaign] tunnel wedged; partial results kept", flush=True)
                 sys.exit(85)
-    state["status"] = "complete"
+    state["status"] = (
+        "complete" if not failed_required else "complete_with_failures"
+    )
     flush()
-    print("[campaign] complete", flush=True)
+    print(
+        f"[campaign] {state['status']}"
+        + (f" (required failures: {failed_required})" if failed_required else "")
+        + (f" (optional failures: {failed_optional})" if failed_optional else ""),
+        flush=True,
+    )
 
 
 if __name__ == "__main__":

@@ -73,13 +73,23 @@ def main():
         f"--log_dir={log_dir}",
     ]
 
+    # Each process writes its output to a file under log_dir: serially
+    # communicate()-ing four PIPE'd processes deadlocks once a later
+    # process fills its 64 KB pipe buffer while an earlier one is being
+    # drained (ADVICE r5) — files have no backpressure, and they survive
+    # for debugging when a step fails.
+    log_files = {}
+
     def spawn(job: str, idx: int, env: dict, extra=()):
         cmd = [
             sys.executable, os.path.join(ROOT, "examples", "mnist_mlp.py"),
             f"--job_name={job}", f"--task_index={idx}", *extra, *common,
         ]
+        name = f"{job}{idx}"
+        logf = open(os.path.join(log_dir, f"{name}.log"), "w")
+        log_files[name] = logf
         return subprocess.Popen(
-            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            cmd, stdout=logf, stderr=subprocess.STDOUT,
             text=True, env=env, cwd=ROOT,
         )
 
@@ -89,18 +99,26 @@ def main():
     procs["chief"] = spawn("chief", 0, tpu_env)
     procs["w0"] = spawn("worker", 0, cpu_env, ("--platform=cpu",))
     procs["w1"] = spawn("worker", 1, cpu_env, ("--platform=cpu",))
-    outs = {}
+    name_of = {"ps": "ps0", "chief": "chief0", "w0": "worker0", "w1": "worker1"}
     ok = True
+    deadline = time.time() + 900
     try:
         for name, p in procs.items():
-            out, _ = p.communicate(timeout=900)
-            outs[name] = out
-    except subprocess.TimeoutExpired:
-        ok = False
+            try:
+                p.wait(timeout=max(1.0, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                ok = False
     finally:
         for p in procs.values():
             if p.poll() is None:
                 p.kill()
+                p.wait()
+        for f in log_files.values():
+            f.close()
+    outs = {}
+    for name in procs:
+        with open(os.path.join(log_dir, f"{name_of[name]}.log")) as f:
+            outs[name] = f.read()
     for name, p in procs.items():
         if p.returncode != 0:
             ok = False
