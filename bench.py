@@ -20,18 +20,88 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
+def _ps_transport_fallback(timeout_s: int, stand_down=None):
+    """The tunnel-is-dead measurement (r7): run the host-side PS transport
+    microbench in a clean subprocess and return its record wrapped as the
+    round's headline — a real number for the bench trajectory instead of an
+    error-only row.  Returns None when even the fallback fails, or when
+    ``stand_down`` (an Event) is set mid-run — backend init completing late
+    means the REAL benchmarks are starting, and the fallback must stop
+    hammering the host's memory bandwidth under them, not just stay quiet."""
+    import subprocess
+    import time as _time
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        p = subprocess.Popen(
+            [sys.executable, os.path.join(here, "tools", "ps_transport_bench.py")],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            cwd=here,
+        )
+        t_end = _time.monotonic() + 900
+        while p.poll() is None:
+            if stand_down is not None and stand_down.is_set():
+                p.kill()
+                p.communicate()
+                return None
+            if _time.monotonic() >= t_end:
+                p.kill()
+                p.communicate()
+                return None
+            _time.sleep(0.5)
+        out = p.communicate()[0] or ""
+        rec = (
+            json.loads(out.strip().splitlines()[-1]) if p.returncode == 0 else None
+        )
+    except (OSError, json.JSONDecodeError, IndexError):
+        rec = None
+    if not isinstance(rec, dict) or "metric" not in rec or "value" not in rec:
+        return None
+    rec["vs_baseline"] = _vs_baseline(rec["metric"], rec["value"])
+    rec.setdefault("detail", {})["fallback_reason"] = (
+        f"jax backend init exceeded {timeout_s}s — accelerator tunnel "
+        "unresponsive; host-side PS transport metric recorded instead"
+    )
+    return rec
+
+
 def _require_devices(timeout_s: int = 480):
     """jax backend init with a hang watchdog: a dead TPU tunnel makes
     ``jax.devices()`` block FOREVER in a fresh process (r4 observed a
     multi-hour outage), which would hang the whole bench run silently.
-    Normal init is seconds; if it exceeds ``timeout_s`` print the one
-    scrapable JSON line as an explicit error record and exit 84."""
+    Normal init is seconds; if it exceeds ``timeout_s``, fall back to the
+    CPU-runnable PS transport microbench so the round still records a REAL
+    metric line (exit 0), and only emit the error-record/exit-84 path when
+    even that fails."""
     import threading
 
     done = threading.Event()
 
     def _watch():
         if not done.wait(timeout_s):
+            # The fallback bench takes minutes — if backend init completes
+            # meanwhile (tunnel slow but alive), the REAL benchmarks are
+            # starting: the fallback is killed (stand_down) and this thread
+            # stands down without printing a second headline.  Drivers that
+            # handle tunnel death themselves (measure_campaign has its own
+            # transport step and wedge accounting — a model step must fail
+            # visibly, not "succeed" with a transport number under the
+            # model's name) opt out via DTX_BENCH_NO_FALLBACK=1.
+            rec = None
+            if os.environ.get("DTX_BENCH_NO_FALLBACK") != "1":
+                try:
+                    rec = _ps_transport_fallback(timeout_s, stand_down=done)
+                except Exception:
+                    # The watchdog IS the hang protection: any surprise
+                    # here must still reach the error-record/exit-84 path,
+                    # never die silently and leave the process blocked in
+                    # jax.devices().
+                    rec = None
+            if done.is_set():
+                return
+            if rec is not None:
+                print(json.dumps(rec), flush=True)
+                os._exit(0)
             print(
                 json.dumps(
                     {

@@ -461,4 +461,26 @@ int64_t pstore_get(void* h, float* out) {
   return p->step;
 }
 
+// The published step without touching the data (-1 = never set): the
+// server peeks this before sizing a response buffer, so an unchanged-step
+// pull never allocates (or zero-fills) an O(params) vector.
+int64_t pstore_step(void* h) {
+  auto* p = static_cast<ParamStore*>(h);
+  std::lock_guard<std::mutex> lock(p->mu);
+  return p->step;
+}
+
+// Versioned pull: copies the snapshot into `out` ONLY when its step is
+// newer than `have_step`; returns the current step either way.  The caller
+// holding a cached copy of step `have_step` learns "unchanged" for the
+// price of the returned step — the transport layer turns that into a
+// header-only response (the PSTORE_GET_IF_NEWER wire op).
+int64_t pstore_get_if_newer(void* h, int64_t have_step, float* out) {
+  auto* p = static_cast<ParamStore*>(h);
+  std::lock_guard<std::mutex> lock(p->mu);
+  if (p->step > have_step)
+    std::memcpy(out, p->data.data(), p->data.size() * sizeof(float));
+  return p->step;
+}
+
 }  // extern "C"

@@ -14,15 +14,26 @@
 //
 // Protocol (little-endian, one request -> one response per frame):
 //   request : u8 op | u8 name_len | name | i64 a | i64 b | u32 plen |
-//             f32 payload[plen]
-//   response: i64 status | u32 plen | f32 payload[plen]
+//             payload[plen elements]
+//   response: i64 status | u32 plen | payload[plen elements]
 // Blocking ops (ACC_TAKE, TQ_POP, GQ_POP) block only their connection's
 // thread; CANCEL_ALL unblocks every waiter (shutdown / fail-fast path).
+//
+// Wire v2 (r7): plen counts ELEMENTS, and the element encoding is a
+// per-connection property set by the HELLO op — f32 (the default, and the
+// only encoding a v1 peer can speak: v1 framing is byte-identical to a
+// v2/f32 connection) or bf16 (halves payload bytes both ways; the server
+// stores f32 and up/down-converts at the socket boundary).  A client that
+// needs a non-default encoding MUST negotiate: HELLO carries the client's
+// wire version and desired dtype, the server echoes its version (or -4),
+// so a mismatched pair fails loudly at connect instead of misparsing
+// frames mid-stream.
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -70,6 +81,8 @@ void gq_cancel(void*);
 void* pstore_new(int64_t);
 void pstore_set(void*, int64_t, const float*);
 int64_t pstore_get(void*, float*);
+int64_t pstore_step(void*);
+int64_t pstore_get_if_newer(void*, int64_t, float*);
 int64_t pstore_num_elems(void*);
 }
 
@@ -107,7 +120,41 @@ enum Op : uint8_t {
   // dead incarnation's sequences.  a = worker id.  Idempotent.
   ACC_RESET_WORKER = 24,
   GQ_RESET_WORKER = 25,
+  // Wire v2 (r7).  HELLO: a = client wire version, b = payload dtype code
+  // (0 = f32, 1 = bf16); answers the server's wire version and switches
+  // THIS connection's payload encoding, or -4 on an unsupported
+  // version/dtype (the dtype is left untouched).  A v1 client never sends
+  // it; a v2 client requires the echoed version, so old/new pairs fail
+  // loudly instead of silently misparsing bf16-framed payloads.
+  HELLO = 26,
+  // Versioned param pull: a = caller's cached step.  Newer snapshot ->
+  // status = step + full payload; unchanged (or never published) ->
+  // status = current step with an EMPTY payload — an unchanged-step pull
+  // costs O(header), not O(params).
+  PSTORE_GET_IF_NEWER = 27,
 };
+
+constexpr int64_t kWireVersion = 2;
+
+// bf16 <-> f32 at the socket boundary (server-side storage stays f32).
+// Round-to-nearest-even, NaN kept quiet (the RNE carry would otherwise
+// round a NaN mantissa into infinity).  Branchless (select, not branch) so
+// the per-payload conversion loops auto-vectorize.
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  const uint32_t rounded = (bits + 0x7FFFu + ((bits >> 16) & 1u)) >> 16;
+  const uint32_t quiet_nan = (bits >> 16) | 0x0040u;
+  const bool is_nan = (bits & 0x7FFFFFFFu) > 0x7F800000u;
+  return static_cast<uint16_t>(is_nan ? quiet_nan : rounded);
+}
+
+inline float bf16_to_f32(uint16_t h) {
+  const uint32_t bits = static_cast<uint32_t>(h) << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
 
 // Tag operand layout for the *_TAGGED ops: worker in bits 48..62 (15 bits
 // — bit 63 stays clear, the operand travels as a signed i64), the
@@ -186,6 +233,33 @@ bool drain_n(int fd, size_t n) {
   return true;
 }
 
+// One response frame as a scatter/gather write: header + payload leave in
+// a single writev, so the payload is never copied into a contiguous
+// header+body buffer (the response-side half of the zero-copy framing).
+bool write_frame(int fd, int64_t status, uint32_t olen, const void* data,
+                 size_t nbytes) {
+  uint8_t hdr[12];
+  std::memcpy(hdr, &status, 8);
+  std::memcpy(hdr + 8, &olen, 4);
+  if (!nbytes) return write_n(fd, hdr, sizeof(hdr));
+  iovec iov[2] = {{hdr, sizeof(hdr)}, {const_cast<void*>(data), nbytes}};
+  size_t idx = 0;
+  while (idx < 2) {
+    ssize_t r = ::writev(fd, iov + idx, static_cast<int>(2 - idx));
+    if (r <= 0) return false;
+    size_t n = static_cast<size_t>(r);
+    while (idx < 2 && n >= iov[idx].iov_len) {
+      n -= iov[idx].iov_len;
+      ++idx;
+    }
+    if (idx < 2 && n) {
+      iov[idx].iov_base = static_cast<uint8_t*>(iov[idx].iov_base) + n;
+      iov[idx].iov_len -= n;
+    }
+  }
+  return true;
+}
+
 //: Payload cap (f32 count) — a lying/hostile client must not drive an
 //: allocation beyond ~1 GiB (matches the dataloader's header discipline).
 constexpr uint32_t kMaxPayload = 256u << 20;
@@ -230,6 +304,10 @@ void serve_conn_impl(Server* s, int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   std::vector<float> payload, out;
+  // Per-connection payload encoding (HELLO): 0 = f32 (v1-compatible),
+  // 1 = bf16.  scratch16 stages the half-width payloads both directions.
+  int wire_dtype = 0;
+  std::vector<uint16_t> scratch16;
   for (;;) {
     uint8_t op = 0, name_len = 0;
     if (!read_n(fd, &op, 1) || !read_n(fd, &name_len, 1)) break;
@@ -240,6 +318,7 @@ void serve_conn_impl(Server* s, int fd) {
     if (!read_n(fd, &a, 8) || !read_n(fd, &b, 8) || !read_n(fd, &plen, 4))
       break;
     if (plen > kMaxPayload) break;
+    const size_t esize = wire_dtype == 1 ? 2 : 4;
     // Allocation is sized from SERVER-side state only: the expected element
     // count of the named object (0 for payload-less ops or missing
     // objects).  A lying client's u32 therefore cannot drive a resize —
@@ -258,22 +337,49 @@ void serve_conn_impl(Server* s, int fd) {
     else if (op == PSTORE_SET && (payload_obj = find(s, name, 'p')))
       expected = static_cast<size_t>(pstore_num_elems(payload_obj->handle));
     if (plen != expected) {
-      if (plen && !drain_n(fd, static_cast<size_t>(plen) * sizeof(float)))
-        break;
-      int64_t status = -2;
-      uint32_t olen = 0;
-      if (!write_n(fd, &status, 8) || !write_n(fd, &olen, 4)) break;
+      if (plen && !drain_n(fd, static_cast<size_t>(plen) * esize)) break;
+      if (!write_frame(fd, -2, 0, nullptr, 0)) break;
       continue;
     }
-    payload.resize(plen);
-    if (plen && !read_n(fd, payload.data(), plen * sizeof(float))) break;
+    // Grow-only (like `out`): the payload is fully overwritten up to plen
+    // and consumers read exactly `expected` (== plen) elements, so the
+    // reused buffer never needs the resize-from-zero zero-fill.
+    if (payload.size() < plen) payload.resize(plen);
+    if (plen) {
+      if (wire_dtype == 0) {
+        if (!read_n(fd, payload.data(), plen * sizeof(float))) break;
+      } else {
+        if (scratch16.size() < plen) scratch16.resize(plen);  // grow-only
+        if (!read_n(fd, scratch16.data(), plen * sizeof(uint16_t))) break;
+        for (uint32_t i = 0; i < plen; ++i)
+          payload[i] = bf16_to_f32(scratch16[i]);
+      }
+    }
 
     int64_t status = -2;  // -2 = bad request/object
-    out.clear();
     Object* o = nullptr;
+    // Valid prefix of `out` for THIS response.  ensure_out grows the
+    // reused buffer without shrinking it, so payload-producing ops that
+    // fully overwrite their output skip the O(params) zero-fill a
+    // resize-from-zero would pay on every request (~14% of a large-pull's
+    // latency at the 64 MB acceptance payload).
+    size_t out_len = 0;
+    auto ensure_out = [&](size_t n) {
+      if (out.size() < n) out.resize(n);
+      out_len = n;
+      return out.data();
+    };
     switch (op) {
       case PING:
         status = 0;
+        break;
+      case HELLO:
+        if (a == kWireVersion && (b == 0 || b == 1)) {
+          wire_dtype = static_cast<int>(b);
+          status = kWireVersion;
+        } else {
+          status = -4;  // unsupported version/dtype: encoding unchanged
+        }
         break;
       case INCARNATION:
         status = s->incarnation;
@@ -305,10 +411,10 @@ void serve_conn_impl(Server* s, int fd) {
         break;
       case ACC_TAKE:
         if ((o = find(s, name, 'a'))) {
-          out.resize((size_t)acc_num_elems(o->handle));
           // b = client deadline in ms (0 = block forever, pre-r6 wire).
-          status = acc_take_timed(o->handle, a, b, out.data());
-          if (status < 0) out.clear();
+          status = acc_take_timed(
+              o->handle, a, b, ensure_out((size_t)acc_num_elems(o->handle)));
+          if (status < 0) out_len = 0;
         }
         break;
       case ACC_DEDUPED:
@@ -355,10 +461,10 @@ void serve_conn_impl(Server* s, int fd) {
         if ((o = find(s, name, 'g'))) {
           // Output sized from the server-side queue, NEVER from client
           // input (a client-controlled size here was a heap overflow).
-          out.resize((size_t)gq_num_elems(o->handle));
           // b = client deadline in ms (0 = block forever, pre-r6 wire).
-          status = gq_pop_timed(o->handle, b, out.data());
-          if (status < 0) out.clear();
+          status = gq_pop_timed(
+              o->handle, b, ensure_out((size_t)gq_num_elems(o->handle)));
+          if (status < 0) out_len = 0;
         }
         break;
       case GQ_DEDUPED:
@@ -387,16 +493,43 @@ void serve_conn_impl(Server* s, int fd) {
         break;
       case PSTORE_GET:
         if ((o = find(s, name, 'p'))) {
-          out.resize((size_t)pstore_num_elems(o->handle));
-          status = pstore_get(o->handle, out.data());
+          status = pstore_get(
+              o->handle, ensure_out((size_t)pstore_num_elems(o->handle)));
+        }
+        break;
+      case PSTORE_GET_IF_NEWER:
+        if ((o = find(s, name, 'p'))) {
+          // Peek the step first: the unchanged case must answer in
+          // O(header), never touching an O(params) buffer.  The peeked
+          // value is ANSWERED in the unchanged branch (not re-read): a
+          // publish racing between two reads would otherwise produce a
+          // "newer step, empty payload" response that costs the client a
+          // spurious full refetch.
+          const int64_t cur = pstore_step(o->handle);
+          if (cur > a) {
+            status = pstore_get_if_newer(
+                o->handle, a, ensure_out((size_t)pstore_num_elems(o->handle)));
+            if (status <= a) out_len = 0;  // lost a publish race: unchanged
+          } else {
+            status = cur;
+          }
         }
         break;
       default:
         break;
     }
-    uint32_t olen = static_cast<uint32_t>(out.size());
-    if (!write_n(fd, &status, 8) || !write_n(fd, &olen, 4)) break;
-    if (olen && !write_n(fd, out.data(), olen * sizeof(float))) break;
+    const uint32_t olen = static_cast<uint32_t>(out_len);
+    if (wire_dtype == 0 || olen == 0) {
+      if (!write_frame(fd, status, olen, out.data(), olen * sizeof(float)))
+        break;
+    } else {
+      if (scratch16.size() < out_len) scratch16.resize(out_len);
+      for (uint32_t i = 0; i < olen; ++i)
+        scratch16[i] = f32_to_bf16(out[i]);
+      if (!write_frame(fd, status, olen, scratch16.data(),
+                       olen * sizeof(uint16_t)))
+        break;
+    }
   }
 }
 

@@ -87,6 +87,22 @@ class AsyncPSConfig:
     #: restart: supervise() backoff + process relaunch + import.  0 = the
     #: pre-r6 fail-fast client.
     ps_reconnect_deadline_s: float = 60.0
+    #: Cross-process mode only — payload encoding on the PS wire (r7):
+    #: "f32" (exact) or "bf16" (half the param/grad bytes; the server
+    #: stores f32 and converts at the socket boundary).  bf16 pays a
+    #: host-side conversion per transfer, so it wins on real networks where
+    #: bytes are the bottleneck, not on loopback — see RUNBOOK "PS
+    #: transport tuning" for when it is accuracy-safe.
+    ps_wire_dtype: str = "f32"
+    #: Cross-process ASYNC workers only — double-buffer param pulls on a
+    #: dedicated background connection: the next step's pull runs under the
+    #: current step's gradient compute, so an unchanged snapshot costs a
+    #: header-sized round trip of latency and a fresh one streams while the
+    #: chip is busy.  Adds at most one step of parameter staleness (the
+    #: same +1 the fixed interleave schedules deliberately).  Sync mode
+    #: never prefetches: a pre-token snapshot would be guaranteed-stale and
+    #: the staleness gate would starve the worker.
+    ps_prefetch: bool = True
 
 
 class AsyncPSTrainer:
@@ -484,6 +500,7 @@ class RemotePSChief(AsyncPSTrainer):
             op_timeout_s=cfg.ps_op_timeout_s,
             reconnect_deadline_s=cfg.ps_reconnect_deadline_s,
             role=faults.current_role() or "chief0",
+            wire_dtype=cfg.ps_wire_dtype,
         )
         if ps_addr is not None:
             self.port = ps_addr[1]
@@ -653,6 +670,108 @@ def host_ps_task(port: int, *, loopback_only: bool = True) -> int:
     return bound
 
 
+def _await_published(pstore, wait_budget_s: float):
+    """Latest published snapshot from ``pstore``, waiting out the window
+    where a restarted PS has an empty (step = -1) param store until the
+    owner's reseed lands; None when the budget expires first.  The ONE
+    definition both the direct worker pull and the prefetch path use."""
+    deadline = time.monotonic() + wait_budget_s
+    step, flat = pstore.get()
+    while step < 0:
+        if time.monotonic() >= deadline:
+            return None
+        time.sleep(0.05)
+        step, flat = pstore.get()
+    return step, flat
+
+
+class ParamPrefetcher:
+    """Double-buffered param pulls on a DEDICATED PS connection (r7): while
+    the worker computes the gradient for step k, the background thread
+    already runs the pull for step k+1 — communication overlapped under
+    compute, the TF-Replicator/parameter-server overlap the transport
+    bench prices (ISSUE 2).
+
+    Contract:
+
+    - ``kick()`` starts the next pull if none is pending (idempotent);
+      ``get()`` blocks for the pending pull (kicking one if needed),
+      re-raising any error the background fetch hit — a prefetch failure
+      surfaces on the CONSUMING step, never corrupts it.  After an error
+      the pstore cache is invalidated and the next ``get()`` starts fresh,
+      so a transient fault heals instead of wedging the worker.
+    - transient transport faults (drops/delays, ``DTX_FAULT_PLAN``) are
+      healed INSIDE the owned ``PSClient`` (reconnect/replay, cache
+      invalidated via its ``on_reconnect`` hook); only terminal errors
+      (``PSDeadlineError`` budget exhaustion) reach the caller.
+    - ``None`` from ``get()`` means the published snapshot never became
+      valid within the wait budget (the await_params contract).
+    """
+
+    def __init__(self, client, pstore, *, wait_budget_s: float):
+        self._client, self._pstore = client, pstore
+        self._wait_budget_s = wait_budget_s
+        self._lock = threading.Lock()
+        self._want = threading.Event()
+        self._have = threading.Event()
+        self._pending = False
+        self._result: tuple[int, np.ndarray] | None = None
+        self._exc: BaseException | None = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="dtx-ps-prefetch"
+        )
+        self._thread.start()
+
+    def _fetch(self):
+        return _await_published(self._pstore, self._wait_budget_s)
+
+    def _loop(self):
+        while True:
+            self._want.wait()
+            self._want.clear()
+            if self._closed:
+                return
+            try:
+                r, e = self._fetch(), None
+            except BaseException as exc:  # noqa: BLE001 — re-raised in get()
+                r, e = None, exc
+                self._pstore.invalidate_cache()
+            with self._lock:
+                self._result, self._exc = r, e
+            self._have.set()
+
+    def kick(self) -> None:
+        with self._lock:
+            if self._pending or self._closed:
+                return
+            self._pending = True
+        self._have.clear()
+        self._want.set()
+
+    def get(self):
+        self.kick()
+        # The fetch itself is bounded by the client's own deadlines
+        # (op timeout + reconnect budget) plus the unpublished-store wait;
+        # the margin only guards against a wedged prefetch thread.
+        if not self._have.wait(timeout=self._wait_budget_s * 2 + 60.0):
+            from . import ps_service
+
+            raise ps_service.PSDeadlineError("param prefetch thread stalled")
+        with self._lock:
+            r, e = self._result, self._exc
+            self._result, self._exc = None, None
+            self._pending = False
+        if e is not None:
+            raise e
+        return r
+
+    def close(self) -> None:
+        self._closed = True
+        self._want.set()
+        self._client.close()
+
+
 def remote_worker_loop(
     host: str,
     port: int,
@@ -681,12 +800,14 @@ def remote_worker_loop(
     """
     from . import ps_service
 
+    role = faults.current_role() or f"worker{wid}"
     client = ps_service.PSClient(
         host, port,
         op_timeout_s=cfg.ps_op_timeout_s,
         reconnect_deadline_s=cfg.ps_reconnect_deadline_s,
         worker_tag=wid,
-        role=faults.current_role() or f"worker{wid}",
+        role=role,
+        wire_dtype=cfg.ps_wire_dtype,
     )
     template = init_fn(jax.random.key(0))
     leaves, treedef = jax.tree.flatten(template)
@@ -706,12 +827,31 @@ def remote_worker_loop(
 
     pstore = ps_service.RemoteParamStore(client, "params", total)
     tq = ps_service.RemoteTokenQueue(client, "tokens")
+    prefetcher = None
     if cfg.mode == "sync_replicas":
         acc = ps_service.RemoteAccumulator(client, "acc", total)
     else:
         gq = ps_service.RemoteGradientQueue(
             client, "gq", total, capacity=max(4, 2 * cfg.num_workers)
         )
+        if cfg.ps_prefetch:
+            # Async only: double-buffer the pull on a dedicated connection
+            # so the next snapshot streams while this step's gradient
+            # computes.  Distinct fault role ("<role>_pf") so plans can
+            # target the prefetch connection specifically; "worker*" globs
+            # still match both.
+            pf_client = ps_service.PSClient(
+                host, port,
+                op_timeout_s=cfg.ps_op_timeout_s,
+                reconnect_deadline_s=cfg.ps_reconnect_deadline_s,
+                role=f"{role}_pf",
+                wire_dtype=cfg.ps_wire_dtype,
+            )
+            prefetcher = ParamPrefetcher(
+                pf_client,
+                ps_service.RemoteParamStore(pf_client, "params", total),
+                wait_budget_s=max(cfg.ps_reconnect_deadline_s, 5.0),
+            )
     model_state = model_state if model_state is not None else {}
     rng = rng if rng is not None else jax.random.key(0)
 
@@ -724,18 +864,7 @@ def remote_worker_loop(
     grad_fn = jax.jit(_grad)
 
     def await_params():
-        """Latest published snapshot, waiting out the window where a
-        restarted PS has an empty (step = -1) param store until the chief's
-        reseed lands; None when the chief never returns within the
-        reconnect budget."""
-        deadline = time.monotonic() + max(cfg.ps_reconnect_deadline_s, 5.0)
-        step, flat = pstore.get()
-        while step < 0:
-            if time.monotonic() >= deadline:
-                return None
-            time.sleep(0.05)
-            step, flat = pstore.get()
-        return step, flat
+        return _await_published(pstore, max(cfg.ps_reconnect_deadline_s, 5.0))
 
     contributed = 0
     it = 0
@@ -750,7 +879,7 @@ def remote_worker_loop(
                 local_step = token
                 got = await_params()
             else:
-                got = await_params()
+                got = prefetcher.get() if prefetcher else await_params()
             if got is None:
                 log.warning("worker %d: no republished params; exiting", wid)
                 break
@@ -759,6 +888,11 @@ def remote_worker_loop(
                 if step >= cfg.train_steps:
                     break
                 local_step = max(step, 0)
+                if prefetcher:
+                    # Overlap the NEXT pull with this step's gradient
+                    # compute (the communication/compute overlap the
+                    # transport fast path exists for).
+                    prefetcher.kick()
         except (RuntimeError, ConnectionError, OSError):
             break
         params = unflatten(flat)
@@ -782,5 +916,7 @@ def remote_worker_loop(
             break  # chief finished and tore the service down
         contributed += 1
         it += 1
+    if prefetcher is not None:
+        prefetcher.close()
     client.close()
     return contributed

@@ -40,6 +40,19 @@ section 5.3).  Here the client itself heals the connection:
 Every recovery action logs one structured ``dtx.faults`` line; fault
 INJECTION (the ``DTX_FAULT_PLAN`` env var) hooks in at ``call()`` — see
 ``utils/faults.py``.
+
+Transport fast path (r7): the framing is zero-copy in both directions —
+requests leave as a scatter/gather ``sendmsg`` (header bytes + a
+``memoryview`` over the caller's contiguous array; no ``tobytes()``, no
+concat) and responses land via ``recv_into`` straight into the output
+array (the old ``bytes +=`` accumulation was O(n²) in the payload size).
+Payload encoding is a per-connection property negotiated at connect (wire
+v2 ``HELLO``): f32 — byte-identical to the v1 framing — or bf16
+(``wire_dtype="bf16"``), which halves param/grad bytes on the wire while
+the server keeps storing f32.  ``RemoteParamStore.get`` is versioned: a
+client-side cache plus the ``PSTORE_GET_IF_NEWER`` op make an
+unchanged-step pull cost one header-sized round trip instead of re-shipping
+the whole flat vector.
 """
 
 from __future__ import annotations
@@ -63,6 +76,37 @@ _PSTORE_GET_OBJ, _PSTORE_SET, _PSTORE_GET = 16, 17, 18
 _INCARNATION, _ACC_APPLY_TAGGED, _GQ_PUSH_TAGGED = 19, 20, 21
 _ACC_DEDUPED, _GQ_DEDUPED = 22, 23
 _ACC_RESET_WORKER, _GQ_RESET_WORKER = 24, 25
+_HELLO, _PSTORE_GET_IF_NEWER = 26, 27
+
+#: Wire protocol version this client speaks (ps_server.cc kWireVersion).
+WIRE_VERSION = 2
+
+#: Payload encodings (HELLO dtype codes).  f32 framing is byte-identical
+#: to wire v1; bf16 halves payload bytes and REQUIRES a negotiated peer.
+WIRE_DTYPES = {"f32": 0, "bf16": 1}
+
+
+def _f32_to_bf16(a: np.ndarray) -> np.ndarray:
+    """f32 -> bf16 (as uint16 bit patterns), round-to-nearest-even, NaN
+    kept quiet — bit-exact with the server's ``f32_to_bf16``.  In-place
+    arithmetic plus a cheap ``any()``-guarded NaN fixup: measured ~2x
+    faster than a branchless ``np.where`` select, whose extra full-size
+    temporaries cost more than the rare-NaN reduction saves."""
+    bits = np.ascontiguousarray(a, np.float32).view(np.uint32)
+    out32 = bits + np.uint32(0x7FFF)
+    out32 += (bits >> np.uint32(16)) & np.uint32(1)
+    out32 >>= np.uint32(16)
+    out = out32.astype(np.uint16)
+    nan = (bits & np.uint32(0x7FFFFFFF)) > np.uint32(0x7F800000)
+    if nan.any():
+        out[nan] = ((bits[nan] >> np.uint32(16)) | np.uint32(0x0040)).astype(
+            np.uint16
+        )
+    return out
+
+
+def _bf16_to_f32(u16: np.ndarray) -> np.ndarray:
+    return (u16.astype(np.uint32) << np.uint32(16)).view(np.float32)
 
 #: Deadline sentinel for bounded blocking ops (take/pop with ``timeout_s``).
 TIMED_OUT = native.TIMED_OUT
@@ -130,6 +174,12 @@ class PSClient:
                              risking a double apply.
     ``role``                 fault-plan role for DTX_FAULT_PLAN matching
                              (defaults to the process role).
+    ``wire_dtype``           payload encoding on this connection: "f32"
+                             (default; v1-compatible framing, no handshake
+                             needed) or "bf16" (half the payload bytes both
+                             ways; negotiated at connect via HELLO, so a
+                             peer that can't speak wire v2 fails the
+                             connection loudly instead of misparsing).
     """
 
     #: Server-side wait per blocking-op round trip when the client has a
@@ -141,8 +191,12 @@ class PSClient:
         self, host: str, port: int, *, timeout_s: float | None = None,
         op_timeout_s: float | None = None, reconnect_deadline_s: float = 0.0,
         backoff_s: float = 0.25, worker_tag: int | None = None,
-        role: str | None = None,
+        role: str | None = None, wire_dtype: str = "f32",
     ):
+        if wire_dtype not in WIRE_DTYPES:
+            raise ValueError(
+                f"wire_dtype {wire_dtype!r} not in {sorted(WIRE_DTYPES)}"
+            )
         self._host, self._port = host, port
         self._connect_timeout = timeout_s
         self._op_timeout = op_timeout_s if op_timeout_s is not None else timeout_s
@@ -150,12 +204,16 @@ class PSClient:
         self._backoff = backoff_s
         self.worker_tag = worker_tag
         self.role = role if role is not None else faults.current_role()
+        self.wire_dtype = wire_dtype
+        self._wire_code = WIRE_DTYPES[wire_dtype]
         self._lock = threading.RLock()
         self._in_recovery = False
         self._ensures: list[tuple[int, str, int, int]] = []
         self._callbacks: list = []
+        self._reconnect_callbacks: list = []
         self._injector = faults.client_injector(self.role)
         self._sock: socket.socket | None = None
+        self._hdr = bytearray(12)  # reusable response-header buffer
         try:
             self._connect()
             # The baseline incarnation: reconnects compare against this to
@@ -163,8 +221,8 @@ class PSClient:
             # Bounded by the configured deadlines so a stalled server fails
             # the ctor instead of hanging it.
             self._incarnation, _ = self._attempt(
-                self._frame(_INCARNATION),
-                self._op_timeout
+                _INCARNATION,
+                deadline_s=self._op_timeout
                 if self._op_timeout is not None
                 else self._connect_timeout,
             )
@@ -187,6 +245,35 @@ class PSClient:
         )
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock
+        if self._wire_code != WIRE_DTYPES["f32"]:
+            # Encoding differs from the v1 framing: HELLO per connection
+            # (the server's dtype is per-connection state), BEFORE any
+            # payload op can be misparsed.  f32 connections skip it — their
+            # framing is byte-identical to v1, so nothing can misparse and
+            # the connect stays one round trip cheaper.
+            self._negotiate()
+
+    def _negotiate(self) -> None:
+        """HELLO on the fresh socket.  Transport failures raise OSError
+        (retryable, like any connect failure); a peer that answers the
+        wrong version — or doesn't know the op — raises PSError, which is
+        PERMANENT and must not be retried by the reconnect loop."""
+        # HELLO carries no payload either way, so it frames identically
+        # under every encoding — safe to send before the answer arrives.
+        status, _ = self._attempt(
+            _HELLO, a=WIRE_VERSION, b=self._wire_code,
+            deadline_s=self._connect_timeout
+            if self._connect_timeout is not None
+            else 10.0,
+        )
+        if status != WIRE_VERSION:
+            self._sever()
+            raise PSError(
+                f"wire negotiation with {self._host}:{self._port} failed: "
+                f"asked v{WIRE_VERSION}/{self.wire_dtype}, peer answered "
+                f"{status} (pre-v2 server, or unsupported dtype) — both ends "
+                "must speak wire v2 for a non-f32 encoding"
+            )
 
     def _sever(self) -> None:
         sock, self._sock = self._sock, None
@@ -203,45 +290,76 @@ class PSClient:
         self._reconnect_deadline = 0.0
         self._sever()
 
-    @staticmethod
-    def _frame(
-        op: int, name: str = "", a: int = 0, b: int = 0,
-        payload: np.ndarray | None = None,
-    ) -> bytes:
-        nm = name.encode()
-        pl = (
-            np.ascontiguousarray(payload, np.float32).tobytes()
-            if payload is not None
-            else b""
-        )
-        return (
-            struct.pack("<BB", op, len(nm)) + nm
-            + struct.pack("<qqI", a, b, len(pl) // 4) + pl
-        )
+    def _encode_payload(self, payload: np.ndarray | None) -> np.ndarray | None:
+        """The wire form of a payload: a contiguous f32 array (no copy when
+        the caller's array already is one — the hot path) or its bf16 bit
+        patterns (one vectorized conversion, the only data touch before the
+        scatter/gather send)."""
+        if payload is None:
+            return None
+        if self._wire_code == 1:
+            return _f32_to_bf16(np.asarray(payload).reshape(-1))
+        return np.ascontiguousarray(payload, np.float32).reshape(-1)
 
-    def _recv_n(self, n: int) -> bytes:
-        buf = b""
-        while len(buf) < n:
-            chunk = self._sock.recv(n - len(buf))
-            if not chunk:
+    def _send_frame(self, header: bytes, payload: np.ndarray | None) -> None:
+        """Scatter/gather send: header + payload leave via ``sendmsg`` with
+        a memoryview over the array — the payload bytes are never copied
+        into a concatenated request buffer."""
+        if payload is None or payload.size == 0:
+            self._sock.sendall(header)
+            return
+        bufs = [memoryview(header), memoryview(payload).cast("B")]
+        while bufs:
+            sent = self._sock.sendmsg(bufs)
+            while bufs and sent >= len(bufs[0]):
+                sent -= len(bufs[0])
+                bufs.pop(0)
+            if bufs and sent:
+                bufs[0] = bufs[0][sent:]
+
+    def _recv_exact(self, view: memoryview) -> None:
+        """Fill ``view`` from the socket via ``recv_into`` — no chunk
+        accumulation (the old ``bytes +=`` loop was O(n²) in payload size),
+        no staging copy: responses land directly in their final buffer."""
+        pos, n = 0, len(view)
+        while pos < n:
+            r = self._sock.recv_into(view[pos:])
+            if r == 0:
                 raise ConnectionError("PS server closed the connection")
-            buf += chunk
-        return buf
+            pos += r
 
-    def _attempt(self, req: bytes, deadline_s: float | None) -> tuple[int, np.ndarray]:
+    def _attempt(
+        self, op: int, name: str = "", a: int = 0, b: int = 0,
+        payload: np.ndarray | None = None, *, deadline_s: float | None = None,
+    ) -> tuple[int, np.ndarray]:
         """One send/recv round trip; severs the socket on ANY failure (the
-        framing is broken mid-stream, so the connection is unusable)."""
+        framing is broken mid-stream, so the connection is unusable).
+        ``payload`` must already be wire-encoded (``_encode_payload``)."""
         if self._sock is None:
             raise ConnectionError("not connected")
+        nm = name.encode()
+        header = struct.pack(
+            "<BB", op, len(nm)
+        ) + nm + struct.pack("<qqI", a, b, 0 if payload is None else payload.size)
         try:
             self._sock.settimeout(deadline_s)
-            self._sock.sendall(req)
-            status, plen = struct.unpack("<qI", self._recv_n(12))
-            out = (
-                np.frombuffer(self._recv_n(plen * 4), np.float32).copy()
-                if plen
-                else np.empty((0,), np.float32)
-            )
+            self._send_frame(header, payload)
+            hdr = memoryview(self._hdr)
+            self._recv_exact(hdr)
+            status, plen = struct.unpack("<qI", self._hdr)
+            if not plen:
+                return status, np.empty((0,), np.float32)
+            # Receive straight into the result array (f32) or its bf16
+            # staging array (upconverted in one vectorized pass).  Freshly
+            # allocated per response, so callers own it outright — the old
+            # frombuffer().copy() double-touch is gone.
+            if self._wire_code == 0:
+                out = np.empty((plen,), np.float32)
+                self._recv_exact(memoryview(out).cast("B"))
+            else:
+                raw = np.empty((plen,), np.uint16)
+                self._recv_exact(memoryview(raw).cast("B"))
+                out = _bf16_to_f32(raw)
             return status, out
         except OSError:
             self._sever()
@@ -270,6 +388,14 @@ class PSClient:
         tokens).  Callbacks may use this client; their ops run
         single-attempt (no nested recovery)."""
         self._callbacks.append(fn)
+
+    def on_reconnect(self, fn) -> None:
+        """Register a callback run on EVERY successful reconnect (same or
+        new incarnation, before any reincarnation handling) — cache
+        invalidation hooks: anything a client mirrors locally (e.g. the
+        param-pull cache) must be re-validated against the server after a
+        transport gap.  Must be cheap and must not issue remote ops."""
+        self._reconnect_callbacks.append(fn)
 
     def _recover(self, t_end: float) -> None:
         """Reconnect with exponential backoff until ``t_end``; on success,
@@ -306,12 +432,16 @@ class PSClient:
                 continue
 
     def _post_reconnect(self, attempts: int) -> None:
-        inc, _ = self._attempt(self._frame(_INCARNATION), self._op_timeout or 10.0)
+        inc, _ = self._attempt(
+            _INCARNATION, deadline_s=self._op_timeout or 10.0
+        )
         changed = inc != self._incarnation
         faults.log_event(
             "reconnected", role=self.role, attempts=attempts,
             incarnation_changed=changed,
         )
+        for fn in list(self._reconnect_callbacks):
+            fn()
         if not changed:
             return
         # Server restarted: every object is gone.  Re-create them in
@@ -320,7 +450,7 @@ class PSClient:
         try:
             for op, name, a, b in list(self._ensures):
                 status, _ = self._attempt(
-                    self._frame(op, name, a, b), self._op_timeout or 10.0
+                    op, name, a, b, deadline_s=self._op_timeout or 10.0
                 )
                 if status < 0:
                     raise ConnectionError(
@@ -351,7 +481,9 @@ class PSClient:
         whether this call advances the fault-injection op counter — the
         chunked re-issues of one logical blocking op pass False so plan
         indices count LOGICAL ops, not timing-dependent chunks."""
-        req = self._frame(op, name, a, b, payload)
+        # Encode once, outside the retry loop: a replay re-sends the same
+        # wire bytes without re-converting (bf16) or re-checking layout.
+        wire_payload = self._encode_payload(payload)
         deadline = (
             self._op_timeout + server_wait_s
             if self._op_timeout is not None
@@ -368,7 +500,9 @@ class PSClient:
             while True:
                 if self._sock is not None:
                     try:
-                        return self._attempt(req, deadline)
+                        return self._attempt(
+                            op, name, a, b, wire_payload, deadline_s=deadline
+                        )
                     except OSError as e:
                         if self._in_recovery or self._reconnect_deadline <= 0:
                             raise PSError(f"PS op {op} failed: {e!r}") from e
@@ -627,11 +761,32 @@ class RemoteGradientQueue:
 class RemoteParamStore:
     """Published (step, flat params) snapshot — the PS variable-hosting
     role; chief sets after every applied update, workers get before every
-    gradient computation (SURVEY.md section 3.1 hot path)."""
+    gradient computation (SURVEY.md section 3.1 hot path).
 
-    def __init__(self, client: PSClient, name: str, num_elems: int):
+    Versioned pulls (r7): ``get`` keeps a client-side (step, params) cache
+    and issues ``PSTORE_GET_IF_NEWER`` with the cached step — when the
+    published step hasn't advanced the server answers status-only (~12
+    bytes) and the cached array is returned, so an unchanged-step pull
+    costs O(header), not O(params).  The cache is invalidated on every
+    reconnect (transport gap => local mirror unproven) and a reincarnated
+    server re-fills it on the next pull.  Callers must treat the returned
+    array as READ-ONLY: repeated unchanged-step gets share one buffer.
+    ``cache_pulls=False`` restores the always-full-fetch behavior."""
+
+    def __init__(
+        self, client: PSClient, name: str, num_elems: int, *,
+        cache_pulls: bool = True,
+    ):
         self._c, self._name, self._n = client, name, num_elems
+        self._cache_step = -1
+        self._cache: np.ndarray | None = None
+        self._cache_enabled = cache_pulls
         _check(client.ensure_object(_PSTORE_GET_OBJ, name, num_elems), "pstore_get_obj")
+        if cache_pulls:
+            client.on_reconnect(self.invalidate_cache)
+
+    def invalidate_cache(self) -> None:
+        self._cache_step, self._cache = -1, None
 
     def set(self, step: int, flat: np.ndarray) -> None:
         # Replay-safe: single-writer (the chief), so a replayed set can
@@ -639,6 +794,42 @@ class RemoteParamStore:
         _check(self._c.call(_PSTORE_SET, self._name, step, payload=flat)[0],
                "pstore_set")
 
-    def get(self) -> tuple[int, np.ndarray]:
+    def _get_full(self) -> tuple[int, np.ndarray]:
         s, out = self._c.call(_PSTORE_GET, self._name)
         return _check(s, "pstore_get"), out
+
+    def get(self) -> tuple[int, np.ndarray]:
+        if not self._cache_enabled:
+            return self._get_full()
+        # Empty cache pulls with have_step=-1: a published store answers
+        # with the full payload (same as a full get), an UNPUBLISHED one
+        # answers status-only — so the poll loop waiting out a PS-restart
+        # recovery window costs O(header) per probe, not a full zero-vector
+        # ship per 50 ms from every worker connection.
+        have = self._cache_step if self._cache is not None else -1
+        s, out = self._c.call(_PSTORE_GET_IF_NEWER, self._name, have)
+        if s == -2:
+            # Pre-v2 server (op unknown): fall back to full pulls for the
+            # life of this store rather than failing the caller.
+            self._cache_enabled = False
+            return self._get_full()
+        _check(s, "pstore_get_if_newer")
+        if out.size == 0:
+            # The reconnect hook may have cleared the cache while this
+            # very call was being replayed (_cache_step is then -1,
+            # matching an empty store's step) — only a LIVE cache
+            # satisfies the unchanged-step fast path.
+            if s == self._cache_step and self._cache is not None:
+                return s, self._cache
+            if s < 0:
+                # Never published: status-only, payload deliberately empty
+                # (callers gate on step < 0 before touching the array).
+                return s, out
+            # Step moved without a payload (republished at a lower step,
+            # e.g. a reseed the reconnect hook didn't see): distrust the
+            # mirror and refetch in full.
+            self.invalidate_cache()
+            s, out = self._get_full()
+        if s >= 0 and out.size:
+            self._cache_step, self._cache = s, out
+        return s, out

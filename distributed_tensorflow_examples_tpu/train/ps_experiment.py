@@ -176,6 +176,11 @@ def _ps_cfg(FLAGS, mode: str, n_workers: int):
         train_steps=FLAGS.train_steps,
         ckpt_dir=os.path.join(FLAGS.log_dir, "ps_ckpt") if FLAGS.log_dir else None,
         checkpoint_every=FLAGS.checkpoint_every_steps,
+        # r7 transport knobs (getattr: embedded callers' FLAGS namespaces
+        # predate them).  PSClient validates the dtype, so a typo'd
+        # --ps_wire_dtype fails the launch loudly.
+        ps_wire_dtype=getattr(FLAGS, "ps_wire_dtype", "f32") or "f32",
+        ps_prefetch=bool(getattr(FLAGS, "ps_prefetch", True)),
     )
 
 

@@ -153,6 +153,25 @@ def define_legacy_cluster_flags():
         "clients' reconnect budget runs out).",
     )
     _define(
+        "string",
+        "ps_wire_dtype",
+        "f32",
+        "Cross-process PS wire encoding: f32 (exact) or bf16 (half the "
+        "param/grad bytes; PS stores f32 and converts at the socket "
+        "boundary — a bandwidth knob for real networks, negotiated at "
+        "connect so mismatched peers fail loudly).  See RUNBOOK 'PS "
+        "transport tuning' for when bf16 is accuracy-safe.",
+    )
+    _define(
+        "bool",
+        "ps_prefetch",
+        True,
+        "Async cross-process workers: double-buffer param pulls on a "
+        "dedicated background connection so the next step's pull overlaps "
+        "the current step's gradient compute (adds at most one step of "
+        "parameter staleness; sync mode never prefetches).",
+    )
+    _define(
         "integer",
         "replicas_to_aggregate",
         0,

@@ -136,6 +136,12 @@ def steps_plan() -> list[dict]:
             "--batch-per-chip", "1", "--loss-chunks", "32",
         ], env={"DTX_FUSED_BWD": "{FUSED}"}, timeout=2400, optional=True),
         dict(name="ps_tpu_smoke", cmd=[PY, "tools/ps_tpu_smoke.py"], timeout=1100),
+        # Host-side PS transport microbench (r7): needs NO accelerator —
+        # ``cpu_ok`` steps run BEFORE the tunnel wait, so even a campaign
+        # that never sees hardware records at least this measurement.
+        dict(name="ps_transport_bench",
+             cmd=[PY, "tools/ps_transport_bench.py"], timeout=900,
+             cpu_ok=True),
     ]
     return plan
 
@@ -148,6 +154,11 @@ def run_step(step: dict, fused_env: str) -> dict:
     }
     env = dict(os.environ)
     env.update(step["env"])
+    # A campaign model step must FAIL visibly on a dead tunnel (rc=84 ->
+    # failure accounting), not silently record bench.py's host-side
+    # transport fallback as the model's metric — the campaign runs the
+    # transport bench once as its own cpu_ok step.
+    env.setdefault("DTX_BENCH_NO_FALLBACK", "1")
     t0 = time.time()
     timed_out = False
     # Own session per step so a timeout kills the WHOLE process group —
@@ -223,6 +234,42 @@ def main():
         os.replace(tmp, args.out)
 
     flush()
+    failed_required: list[str] = []
+    failed_optional: list[str] = []
+
+    def record_step(step: dict, fused_env: str) -> dict:
+        """Run one step and fold it into the shared accounting — the ONE
+        run/record/failure/flush block both loops (cpu pre-steps and the
+        tunnel agenda) use, so their campaign JSON can never diverge."""
+        print(f"[campaign] step {step['name']} ...", flush=True)
+        rec = run_step(step, fused_env)
+        state["steps"].append(rec)
+        if rec["rc"] == 0:
+            succeeded.add(step["name"])
+        else:
+            (failed_optional if step.get("optional") else failed_required).append(
+                step["name"]
+            )
+            state["failed_steps"] = failed_required
+            state["failed_optional"] = failed_optional
+        flush()
+        print(f"[campaign]   rc={rec['rc']} {rec['seconds']}s", flush=True)
+        return rec
+
+    # CPU-runnable steps first — they need no tunnel, so they run while (or
+    # before) --wait polls, and a hardware-less campaign still produces a
+    # measurement instead of an empty tunnel_dead record.  One attempt only:
+    # a failed cpu step is accounted here and SKIPPED by the main loop (a
+    # deterministic failure would just repeat and double-record the step).
+    attempted_cpu: set[str] = set()
+    only = {s for s in args.only.split(",") if s}
+    for step in steps_plan():
+        if not step.get("cpu_ok") or step["name"] in succeeded:
+            continue
+        if only and step["name"] not in only:
+            continue
+        attempted_cpu.add(step["name"])
+        record_step(step, "0")
     deadline = time.time() + args.max_wait_h * 3600
     alive = probe()
     while not alive and args.wait and time.time() < deadline:
@@ -241,33 +288,22 @@ def main():
     # Step 1 resolves the fused gate for everything after it.  On --resume
     # the gate is recomputed from the kept steps — record it immediately so
     # the out-file header never reports '?' for a gate the downstream steps
-    # actually ran with (ADVICE r5).
+    # actually ran with (ADVICE r5).  Keyed on flash_parity specifically:
+    # the cpu pre-steps also populate `succeeded`, and a fresh campaign
+    # must not stamp "parity failed" for a gate never yet determined.
     fused_env = "1" if "flash_parity" in succeeded else "0"
-    if succeeded:
+    if "flash_parity" in succeeded:
         state["fused_gate"] = fused_env
         flush()
     # Failure accounting honors each step's `optional` flag: optional rows
     # (deep-regime/segmented extras) may fail without demoting the campaign
     # from "complete" — their failures are still recorded per step.
-    failed_required: list[str] = []
-    failed_optional: list[str] = []
-    only = {s for s in args.only.split(",") if s}
     for step in steps_plan():
         if only and step["name"] not in only:
             continue
-        if step["name"] in succeeded:
+        if step["name"] in succeeded or step["name"] in attempted_cpu:
             continue
-        print(f"[campaign] step {step['name']} ...", flush=True)
-        rec = run_step(step, fused_env)
-        state["steps"].append(rec)
-        if rec["rc"] != 0:
-            (failed_optional if step.get("optional") else failed_required).append(
-                step["name"]
-            )
-            state["failed_steps"] = failed_required
-            state["failed_optional"] = failed_optional
-        flush()
-        print(f"[campaign]   rc={rec['rc']} {rec['seconds']}s", flush=True)
+        rec = record_step(step, fused_env)
         if step["name"] == "flash_parity":
             fused_env = "1" if rec["rc"] == 0 else "0"
             state["fused_gate"] = fused_env

@@ -1,0 +1,105 @@
+"""Transport perf regression gate (r7 satellite).
+
+Compares a ``tools/ps_transport_bench.py`` result against the checked-in
+host baseline (``tools/ps_transport_baseline.json``) and flags
+regressions, so a future PR cannot silently re-introduce the
+copy-per-send / O(n²)-receive framing this round removed.
+
+Two kinds of checks, both deliberately host-portable:
+
+1. **Normalized throughput** — every ``*_frac_memcpy`` row (socket MB/s as
+   a fraction of the host's own memcpy bandwidth) must stay above
+   ``tolerance`` x the baseline fraction.  Raw MB/s differs 10x across
+   boxes; the memcpy fraction is stable, and a copy-per-send regression
+   halves it no matter the host.
+2. **if-newer ratio** — an unchanged-step ``get_if_newer`` round trip must
+   be at least ``--if-newer-ratio`` x faster than a full large pull,
+   computed entirely from the RESULT file (no cross-host compare at all):
+   the check that the versioned pull still moves O(header), not O(params).
+
+The default tolerance is generous (0.25: flag only when a normalized row
+drops below a QUARTER of baseline) — this is a tripwire for structural
+regressions, not a micro-perf ratchet.
+
+Usage:
+  python tools/ps_transport_bench.py --json /tmp/t.json
+  python tools/perf_gate.py /tmp/t.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _detail(rec: dict) -> dict:
+    return rec.get("detail", rec)
+
+
+def gate(
+    result: dict, baseline: dict, *, tolerance: float, if_newer_ratio: float
+) -> list[str]:
+    """Returns a list of human-readable regression lines (empty = pass)."""
+    res, base = _detail(result), _detail(baseline)
+    failures: list[str] = []
+    for dtype, brow in base.items():
+        if not isinstance(brow, dict):
+            continue
+        rrow = res.get(dtype)
+        if not isinstance(rrow, dict):
+            if any(k.endswith("_frac_memcpy") for k in brow):
+                failures.append(f"{dtype}: row missing from result")
+            continue
+        for key, bval in brow.items():
+            if not key.endswith("_frac_memcpy"):
+                continue
+            rval = rrow.get(key)
+            if rval is None:
+                failures.append(f"{dtype}.{key}: missing from result")
+            elif rval < tolerance * bval:
+                failures.append(
+                    f"{dtype}.{key}: {rval:.4f} < {tolerance} x baseline "
+                    f"{bval:.4f} (copy-per-send regression?)"
+                )
+        # The O(header) contract, from the result alone.
+        if "if_newer_rtt_us" in rrow and rrow.get("get_mbs_large"):
+            full_pull_us = res["large_mb"] / rrow["get_mbs_large"] * 1e6
+            ratio = full_pull_us / max(rrow["if_newer_rtt_us"], 1e-9)
+            if ratio < if_newer_ratio:
+                failures.append(
+                    f"{dtype}.if_newer_rtt_us: unchanged-step pull only "
+                    f"{ratio:.1f}x faster than a full pull (< "
+                    f"{if_newer_ratio}x) — get_if_newer moving O(params)?"
+                )
+    return failures
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("result", help="ps_transport_bench JSON record")
+    ap.add_argument(
+        "--baseline",
+        default=__file__.rsplit("/", 1)[0] + "/ps_transport_baseline.json",
+    )
+    ap.add_argument("--tolerance", type=float, default=0.25)
+    ap.add_argument("--if-newer-ratio", type=float, default=20.0)
+    args = ap.parse_args()
+    with open(args.result) as f:
+        result = json.load(f)
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+    failures = gate(
+        result, baseline,
+        tolerance=args.tolerance, if_newer_ratio=args.if_newer_ratio,
+    )
+    if failures:
+        print("PERF_GATE FAIL")
+        for line in failures:
+            print("  " + line)
+        sys.exit(1)
+    print("PERF_GATE PASS")
+
+
+if __name__ == "__main__":
+    main()
