@@ -14,8 +14,12 @@ prefetch.  The reference's pipeline machinery (``Dataset.shard/batch/prefetch``,
 - ``filestream`` — ``FileStreamPipeline``: the out-of-core path (datasets
   larger than host RAM stream from shard files with a reader thread + decode
   worker pool — tf.data's interleave/map/shard roles).
+- ``data_service`` — the DISAGGREGATED path (tf.data service analog):
+  dedicated input-worker processes own shards, decode and split assignment,
+  and stream ready batches to training workers over the PS wire
+  (``--data_dir=dsvc://host:port``).
 """
 
 from .pipeline import InMemoryPipeline, prefetch_to_mesh  # noqa: F401
 from .filestream import FileStreamPipeline  # noqa: F401
-from . import datasets, filestream, native_loader, streams  # noqa: F401
+from . import data_service, datasets, filestream, native_loader, streams  # noqa: F401

@@ -1,0 +1,1158 @@
+"""Disaggregated data service: remote input workers streaming ready batches
+over the PS wire (r8 tentpole).
+
+The reference stack does ALL input processing in-process on each training
+worker, which caps accelerator utilization the moment preprocessing outruns
+one host — the problem tf.data service solves by moving the input pipeline
+onto separate serving processes ("tf.data service: A Case for Disaggregating
+ML Input Data Processing").  This module is that third leg of the training
+stack (PS compute / transport / INPUT):
+
+- :class:`DataServiceServer` — a dispatcher+worker-style data server: it
+  owns **shard assignment** (first-come-first-served splits, per-epoch
+  at-least-once visitation tracking) and streams decoded, batched data to
+  training workers over the PR 2 wire machinery (``parallel/wire.py``:
+  same framing, HELLO version negotiation, scatter/gather ``sendmsg`` out,
+  ``recv_into`` straight into the destination arrays on the client).
+- :class:`DataServiceClient` — the resilient transport: per-op deadlines,
+  exponential-backoff reconnect bounded by ``reconnect_deadline_s`` (PR 1
+  semantics extended to input), fault injection via ``DTX_FAULT_PLAN``
+  (client role ``<role>_ds``), and incarnation tracking so a RESTARTED data
+  server is detected and healed.
+- :class:`RemoteDatasetSource` — the ``dsvc://host:port`` source that plugs
+  into ``data/streams.py``'s resolution (fourth branch next to
+  ``.dtxr``/``.npz``/fallback), with double-buffered prefetch modeled on
+  ``async_ps.ParamPrefetcher`` and split re-claim on reconnect, so a data
+  server kill+restart heals mid-epoch.
+
+Wire notes (vs the PS wire): frame layout and HELLO are shared
+(``parallel/wire.py``), but payload lengths count **bytes**, not elements —
+batches carry mixed-dtype fields (uint8 images, int32 labels, f32 floats)
+as raw bytes after a small JSON schema header, so the bf16 payload encoding
+is unsound here and HELLO accepts only the f32 code.  The HELLO answer
+carries a ``dsvc`` service tag so a client dialing the wrong service fails
+loudly instead of misparsing op codes.
+
+Split protocol (the dispatcher role):
+
+- A **split** is one shard file (or in-RAM chunk): the unit of assignment.
+  Batches within a split are deterministic in ``(seed, split)`` — NOT the
+  epoch — so a worker resuming a re-claimed split after a server restart
+  gets byte-identical batches at the same indices.
+- ``GET_SPLIT(worker, ack)`` first acknowledges the worker's previous split
+  (idempotent), then assigns the next pending split first-come-first-served.
+  The op is **replay-safe**: a worker that already holds an unacknowledged
+  split is handed THAT split again, so a response lost to a connection drop
+  cannot strand an assignment.  ``-3`` = nothing pending right now (peers
+  still draining) — poll; ``-4`` = the requested epoch is over.
+- ``CLAIM_SPLIT(worker, split)`` re-requests a specific split after a
+  reconnect lands on a new server incarnation (assignment state lost):
+  answered claimed / already-completed / taken-by-another-worker.
+- The epoch **rolls only when every split is acknowledged** — per-epoch
+  at-least-once visitation by construction.  In steady state a split is
+  never assigned to two workers; assignments are reassigned only when the
+  assignee's liveness (any op naming it) goes stale, and duplicates can
+  occur only across a failover (at-least-once, like the PS path's token
+  re-push).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import socket
+import struct
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Callable, Iterator, Sequence
+
+import numpy as np
+
+from ..parallel import wire
+from ..utils import faults
+from . import filestream
+
+log = logging.getLogger("dtx.data_service")
+
+# Op codes (DSVC_*).  Disjoint from the PS server's 1..27 range except the
+# shared HELLO code point, so a frame sent to the wrong service is refused,
+# never misinterpreted.
+DSVC_HELLO = wire.HELLO_OP
+DSVC_REGISTER = 64
+DSVC_GET_SPLIT = 65
+DSVC_CLAIM_SPLIT = 66
+DSVC_GET_BATCH = 67
+DSVC_HEARTBEAT = 68
+DSVC_STATS = 69
+DSVC_GET_EVAL = 70
+DSVC_SHUTDOWN = 71
+
+#: HELLO answer payload: the service tag a client must verify.
+SERVICE_TAG = b"dsvc"
+
+# Response statuses (non-assignment ops: 0 ok, >0 op-specific, <0 error).
+OK = 0
+END_OF_SPLIT = 1  # GET_BATCH index past the split; GET_EVAL with no chunk
+CLAIM_DONE = 1  # CLAIM_SPLIT: already completed this epoch — skip it
+CLAIM_TAKEN = 2  # CLAIM_SPLIT: assigned to another live worker
+WAIT = -3  # GET_SPLIT: nothing pending right now — poll again
+EPOCH_ROLLED = -4  # GET_SPLIT: the epoch the client constrained to is over
+ERR = -2  # bad op / bad operands
+
+
+class DSVCError(RuntimeError):
+    """A data-service op failed terminally (transport unrecoverable or the
+    server rejected the request)."""
+
+
+class DSVCDeadlineError(DSVCError):
+    """Reconnect budget exhausted: the data server stayed unreachable past
+    ``reconnect_deadline_s``."""
+
+
+def parse_spec(spec: str) -> tuple[str, int]:
+    """``dsvc://host:port`` -> (host, port)."""
+    if not spec.startswith("dsvc://"):
+        raise ValueError(f"not a data-service spec: {spec!r}")
+    host, _, port = spec[len("dsvc://"):].rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"bad data-service spec {spec!r} (want dsvc://host:port)")
+    return host, int(port)
+
+
+# ----------------------------------------------------------------------------
+# Batch codec: JSON schema header + raw field bytes (zero-copy both ways)
+# ----------------------------------------------------------------------------
+
+
+def encode_batch(batch: dict[str, np.ndarray]) -> list:
+    """Wire form of a field-dict batch: ``<I`` schema length + JSON schema +
+    each field's raw bytes, returned as a BUFFER LIST for scatter/gather
+    ``sendmsg`` — field arrays are never copied into a concatenated
+    message.  Field order is sorted for determinism."""
+    fields, bufs = [], []
+    for k in sorted(batch):
+        src = np.asarray(batch[k])
+        a = np.ascontiguousarray(src)
+        # Record the SOURCE shape: ascontiguousarray promotes 0-d scalars
+        # to 1-d, and the decode side must reconstruct the original.
+        fields.append({"name": k, "dtype": a.dtype.str, "shape": list(src.shape)})
+        bufs.append(a)
+    meta = json.dumps(fields).encode()
+    return [struct.pack("<I", len(meta)) + meta] + bufs
+
+
+def encoded_nbytes(bufs: list) -> int:
+    return sum(
+        b.nbytes if isinstance(b, np.ndarray) else len(b) for b in bufs
+    )
+
+
+def read_batch(sock, nbytes: int) -> dict[str, np.ndarray]:
+    """Inverse of :func:`encode_batch`, receiving each field via
+    ``recv_into`` straight into its final freshly-allocated array — no
+    staging buffer, no per-field copy."""
+    head = bytearray(4)
+    wire.recv_exact(sock, memoryview(head))
+    (mlen,) = struct.unpack("<I", head)
+    meta = bytearray(mlen)
+    wire.recv_exact(sock, memoryview(meta))
+    consumed = 4 + mlen
+    out: dict[str, np.ndarray] = {}
+    for f in json.loads(bytes(meta)):
+        a = np.empty(f["shape"], np.dtype(f["dtype"]))
+        if a.nbytes:
+            # reshape(-1) view: a 0-d array's own memoryview can't cast.
+            wire.recv_exact(sock, memoryview(a.reshape(-1)).cast("B"))
+        out[f["name"]] = a
+        consumed += a.nbytes
+    if consumed != nbytes:
+        raise ConnectionError(
+            f"batch framing mismatch: {consumed} consumed != {nbytes} framed"
+        )
+    return out
+
+
+# ----------------------------------------------------------------------------
+# Server — dispatcher (split assignment) + worker (batch serving) in one
+# ----------------------------------------------------------------------------
+
+
+class DataServiceServer:
+    """Threaded TCP data server: one dispatcher state machine, one handler
+    thread per connection, batches decoded server-side (the disaggregation
+    point — preprocessing cost lives HERE, not on the training host).
+
+    ``splits``           shard file paths (``filestream`` formats) or in-RAM
+                         ``{field: array}`` chunks; one split per entry.
+    ``batch_size``       rows per served batch (the TRAINING worker's local
+                         batch).
+    ``decode_fn``        applied to every batch before serving ("ready
+                         batches": decode/normalize/augment run on the data
+                         server's cores).
+    ``shuffle``          shuffle rows within a split, keyed on ``(seed,
+                         split)`` only — deterministic across epochs AND
+                         server restarts, so a re-claimed split resumes
+                         byte-identically.  Epoch-to-epoch variation comes
+                         from the per-epoch split ORDER, keyed on ``(seed,
+                         epoch)``.
+    ``eval_chunk``       optional held-out chunk served raw via GET_EVAL.
+    ``reassign_after_s`` liveness window: an assigned split whose worker has
+                         issued no op for this long may be handed to another
+                         worker (at-least-once beats a lost worker wedging
+                         the epoch).
+    """
+
+    def __init__(
+        self,
+        splits: Sequence,
+        *,
+        batch_size: int,
+        decode_fn: Callable | None = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_remainder: bool = True,
+        eval_chunk: dict[str, np.ndarray] | None = None,
+        port: int = 0,
+        loopback_only: bool = True,
+        reassign_after_s: float = 60.0,
+        cache_splits: int = 4,
+        info_extra: dict | None = None,
+    ):
+        if not splits:
+            raise ValueError("data service needs at least one split")
+        # Extra fields merged into the REGISTER answer — how hosting code
+        # advertises pipeline settings clients should sanity-check (e.g.
+        # serve_from_dir's seed/augment).
+        self._info_extra = dict(info_extra or {})
+        self._splits = list(splits)
+        self._batch = batch_size
+        self._decode = decode_fn
+        self._shuffle = shuffle
+        self._seed = seed
+        self._drop_remainder = drop_remainder
+        self._eval_chunk = eval_chunk
+        self._reassign_after_s = reassign_after_s
+        # Distinct per process start: a client comparing incarnations across
+        # a reconnect detects a restarted (assignment-state-lost) server.
+        self._incarnation = int.from_bytes(os.urandom(4), "little") | 1
+        self._lock = threading.Lock()
+        self._epoch = 0
+        self._pending: deque[int] = deque(self._epoch_order(0))
+        self._assigned: dict[int, tuple[int, float]] = {}  # split -> (worker, t)
+        self._worker_split: dict[int, int] = {}  # worker -> unacked split
+        self._completed: set[int] = set()
+        self._visits = {i: 0 for i in range(len(self._splits))}
+        self._last_seen: dict[int, float] = {}
+        self._requests = 0
+        self._batches_served = 0
+        self._splits_completed = 0
+        self._reassigned = 0
+        self._epochs_completed = 0
+        self._last_epoch_min_visits = 0
+        self._registered: set[int] = set()
+        self._cache: OrderedDict[int, list] = OrderedDict()
+        self._cache_cap = max(1, cache_splits)
+        self._stop = threading.Event()
+        self.shutdown_requested = threading.Event()
+        self._conns: list[socket.socket] = []
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        bind_deadline = time.monotonic() + (5.0 if port else 0.0)
+        while True:
+            try:
+                self._listener.bind(("127.0.0.1" if loopback_only else "", port))
+                break
+            except OSError:
+                # A supervised restart rebinds the dead incarnation's FIXED
+                # port; lingering sockets can hold it briefly — retry within
+                # a short window instead of failing the healing restart.
+                if time.monotonic() >= bind_deadline:
+                    raise
+                time.sleep(0.2)
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="dsvc-accept"
+        )
+        self._accept_thread.start()
+        log.info(
+            "data service serving %d splits on port %d (incarnation %d)",
+            len(self._splits), self.port, self._incarnation,
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def request_count(self) -> int:
+        """Requests handled so far — the ``die:after_reqs`` fault trigger
+        for a data-service task (same contract as the PS server's)."""
+        return self._requests
+
+    def stop(self) -> None:
+        self._stop.set()
+        # shutdown() BEFORE close(): a close alone does not free the kernel
+        # socket while the accept thread is blocked in accept() on it (the
+        # syscall pins the open file description), which would leave the
+        # port in LISTEN and fail a same-port restart.  shutdown wakes the
+        # blocked accept; the join guarantees the port is released before
+        # stop() returns.
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._accept_thread.join(timeout=5.0)
+        with self._lock:
+            conns, self._conns = self._conns[:], []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    # -- split plumbing ------------------------------------------------------
+
+    def _epoch_order(self, epoch: int) -> list[int]:
+        order = np.arange(len(self._splits))
+        if self._shuffle:
+            order = np.random.default_rng(
+                (self._seed, epoch)
+            ).permutation(order)
+        return [int(i) for i in order]
+
+    def _split_batches(self, si: int) -> list:
+        """Ready (decoded, batch-sliced) batches of split ``si``, each
+        pre-encoded as a wire buffer list; LRU-cached so the per-connection
+        handlers share the decode work."""
+        with self._lock:
+            cached = self._cache.get(si)
+            if cached is not None:
+                self._cache.move_to_end(si)
+                return cached
+        src = self._splits[si]
+        chunk = filestream.load_chunk(src) if isinstance(src, str) else {
+            k: np.asarray(v) for k, v in src.items()
+        }
+        n = len(next(iter(chunk.values())))
+        if self._shuffle:
+            order = np.random.default_rng((self._seed, si)).permutation(n)
+            chunk = {k: v[order] for k, v in chunk.items()}
+        b = self._batch
+        nb = n // b if self._drop_remainder else -(-n // b)
+        batches = []
+        for s in range(nb):
+            raw = {k: v[s * b : (s + 1) * b] for k, v in chunk.items()}
+            batches.append(encode_batch(self._decode(raw) if self._decode else raw))
+        with self._lock:
+            self._cache[si] = batches
+            # Capacity adapts to the number of splits concurrently ASSIGNED:
+            # with more active workers than the configured floor, a fixed
+            # cap would thrash — every interleaved GET_BATCH re-decoding a
+            # whole shard to serve one batch.
+            cap = max(self._cache_cap, len(self._assigned) + 1)
+            while len(self._cache) > cap:
+                self._cache.popitem(last=False)
+        return batches
+
+    def _num_batches(self, si: int) -> int:
+        return len(self._split_batches(si))
+
+    # -- dispatcher state machine (all under self._lock) ---------------------
+
+    def _ack_locked(self, worker: int, split: int) -> None:
+        """Idempotent completion mark.  Also honors acks a RESTARTED server
+        never assigned (the old incarnation did): the split is pulled out of
+        pending so visited work is not re-served."""
+        if not (0 <= split < len(self._splits)) or split in self._completed:
+            return
+        holder = self._assigned.get(split)
+        if holder is not None and holder[0] != worker:
+            return  # someone else owns it now (post-failover): their ack counts
+        self._assigned.pop(split, None)
+        if self._worker_split.get(worker) == split:
+            del self._worker_split[worker]
+        try:
+            self._pending.remove(split)
+        except ValueError:
+            pass
+        self._completed.add(split)
+        self._visits[split] = max(self._visits[split], 1)
+        self._splits_completed += 1
+        self._maybe_roll_locked()
+
+    def _maybe_roll_locked(self) -> None:
+        if len(self._completed) < len(self._splits):
+            return
+        self._last_epoch_min_visits = min(self._visits.values())
+        self._epochs_completed += 1
+        self._epoch += 1
+        self._completed.clear()
+        self._assigned.clear()
+        self._worker_split.clear()
+        self._visits = {i: 0 for i in range(len(self._splits))}
+        self._pending = deque(self._epoch_order(self._epoch))
+        log.info("data service: epoch rolled to %d", self._epoch)
+
+    def _assign_locked(self, worker: int, split: int) -> None:
+        self._assigned[split] = (worker, time.monotonic())
+        self._worker_split[worker] = split
+        self._visits[split] += 1
+
+    def _handle_get_split(
+        self, worker: int, ack: int, client_epoch: int | None, strict: bool
+    ):
+        now = time.monotonic()
+        with self._lock:
+            self._last_seen[worker] = now
+            if ack >= 0 and (client_epoch is None or client_epoch == self._epoch):
+                # Epoch-tagged acks: an ack for a split assigned in a
+                # PREVIOUS epoch (a worker that stalled past reassignment
+                # while the epoch rolled) must not mark the NEW epoch's
+                # pending copy completed with zero deliveries — ignoring it
+                # re-serves the split instead (at-least-once preserved).
+                self._ack_locked(worker, ack)
+            if strict and client_epoch != self._epoch:
+                return EPOCH_ROLLED, {"epoch": self._epoch}
+            # Replay safety: an unacked assignment is re-answered, so a
+            # response lost mid-drop cannot strand a split on this worker.
+            held = self._worker_split.get(worker)
+            if held is not None and held not in self._completed:
+                return held, {"epoch": self._epoch, "num_batches": None, "split": held}
+            if self._pending:
+                s = self._pending.popleft()
+                self._assign_locked(worker, s)
+                return s, {"epoch": self._epoch, "num_batches": None, "split": s}
+            # Nothing pending: reassign only a STALE assignee's split (a
+            # lost worker must not wedge the epoch); otherwise wait.
+            for s, (w, t0) in self._assigned.items():
+                if now - max(self._last_seen.get(w, 0.0), t0) > self._reassign_after_s:
+                    if self._worker_split.get(w) == s:
+                        # The stale worker no longer holds it: were it to
+                        # come back, its GET_SPLIT must not re-answer s.
+                        del self._worker_split[w]
+                    self._assign_locked(worker, s)
+                    self._reassigned += 1
+                    faults.log_event(
+                        "dsvc_reassign", split=s, from_worker=w, to_worker=worker,
+                    )
+                    return s, {"epoch": self._epoch, "num_batches": None, "split": s}
+            return WAIT, {"epoch": self._epoch}
+
+    def _handle_claim(self, worker: int, split: int):
+        with self._lock:
+            self._last_seen[worker] = time.monotonic()
+            if not (0 <= split < len(self._splits)):
+                return ERR, {}
+            if split in self._completed:
+                return CLAIM_DONE, {"epoch": self._epoch}
+            holder = self._assigned.get(split)
+            if holder is not None and holder[0] != worker:
+                return CLAIM_TAKEN, {"epoch": self._epoch}
+            try:
+                self._pending.remove(split)
+            except ValueError:
+                pass
+            if holder is None:
+                self._assign_locked(worker, split)
+            return OK, {"epoch": self._epoch, "num_batches": None, "split": split}
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "incarnation": self._incarnation,
+                "epoch": self._epoch,
+                "num_splits": len(self._splits),
+                "pending": len(self._pending),
+                "assigned": len(self._assigned),
+                "completed": len(self._completed),
+                "registered_workers": len(self._registered),
+                "batches_served": self._batches_served,
+                "splits_completed": self._splits_completed,
+                "reassigned": self._reassigned,
+                "epochs_completed": self._epochs_completed,
+                "last_epoch_min_visits": self._last_epoch_min_visits,
+                "requests": self._requests,
+            }
+
+    # -- connection handling -------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.append(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True,
+                name="dsvc-conn",
+            ).start()
+
+    def _reply(self, conn, status: int, bufs: list | None) -> None:
+        bufs = bufs or []
+        hdr = wire.RESP_HDR.pack(status, encoded_nbytes(bufs))
+        wire.send_frames(conn, [hdr] + bufs)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        hdr2 = bytearray(2)
+        try:
+            while not self._stop.is_set():
+                req = wire.read_request(conn, hdr2)
+                if req is None:
+                    return
+                op, name, a, b, plen = req
+                if plen:  # no DSVC op carries a request payload: drain it
+                    sink = bytearray(min(plen, 1 << 20))
+                    left = plen
+                    while left:
+                        view = memoryview(sink)[: min(left, len(sink))]
+                        wire.recv_exact(conn, view)
+                        left -= len(view)
+                with self._lock:
+                    # Under the lock like all dispatcher state: a lost
+                    # increment would make die:after_reqs fault triggers
+                    # load-dependent.
+                    self._requests += 1
+                try:
+                    self._handle(conn, op, name, a, b)
+                except (OSError, ConnectionError):
+                    raise
+                except Exception:
+                    # A handler bug (e.g. a decode_fn that chokes on the
+                    # data) must surface as a LOUD per-op error on the
+                    # client, not a silent connection close the client
+                    # burns its whole reconnect budget retrying.  Handlers
+                    # compute before replying, so the framing is intact.
+                    log.exception("dsvc op %d (%s) failed server-side", op, name)
+                    self._reply(conn, ERR, None)
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            # Drop the tracking entry too: the fault-heal design makes
+            # reconnects ROUTINE, and a long-lived server must not keep one
+            # dead socket object per connection ever accepted.
+            with self._lock:
+                try:
+                    self._conns.remove(conn)
+                except ValueError:
+                    pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, conn, op: int, name: str, a: int, b: int) -> None:
+        if op == DSVC_HELLO:
+            # a=version, b=dtype code.  Batches carry mixed-dtype fields as
+            # raw bytes, so only the f32 (pass-through) code is sound here.
+            ok = a == wire.WIRE_VERSION and b == wire.WIRE_DTYPES["f32"]
+            self._reply(conn, wire.WIRE_VERSION if ok else -1,
+                        [SERVICE_TAG] if ok else None)
+            return
+        if op == DSVC_REGISTER:
+            if a >= 0:
+                # Negative worker ids are metadata-only probes (source
+                # resolution, tooling): they must not count as training
+                # workers in the dispatcher's liveness/stats tables.
+                with self._lock:
+                    self._registered.add(a)
+                    self._last_seen[a] = time.monotonic()
+            info = {
+                "incarnation": self._incarnation,
+                "epoch": self._epoch,
+                "num_splits": len(self._splits),
+                "batch_size": self._batch,
+                **self._info_extra,
+            }
+            self._reply(conn, OK, [json.dumps(info).encode()])
+            return
+        if op == DSVC_GET_SPLIT:
+            # name: "epoch=<n>[,strict]" — <n> is the epoch the CLIENT is
+            # in (the epoch its ack's split was assigned in); ",strict"
+            # additionally constrains assignment to that epoch
+            # (single-epoch iteration).
+            client_epoch, strict = None, False
+            if name.startswith("epoch="):
+                tail = name[len("epoch="):]
+                strict = tail.endswith(",strict")
+                client_epoch = int(tail[: -len(",strict")] if strict else tail)
+            status, info = self._handle_get_split(a, b, client_epoch, strict)
+            if status >= 0 and info.get("num_batches") is None:
+                info["num_batches"] = self._num_batches(status)
+            self._reply(conn, status, [json.dumps(info).encode()])
+            return
+        if op == DSVC_CLAIM_SPLIT:
+            status, info = self._handle_claim(a, b)
+            if status == OK and info.get("num_batches") is None:
+                info["num_batches"] = self._num_batches(b)
+            self._reply(conn, status, [json.dumps(info).encode()])
+            return
+        if op == DSVC_GET_BATCH:
+            if not (0 <= a < len(self._splits)):
+                self._reply(conn, ERR, None)
+                return
+            if name:
+                with self._lock:
+                    self._last_seen[int(name)] = time.monotonic()
+            batches = self._split_batches(a)
+            if b >= len(batches) or b < 0:
+                self._reply(conn, END_OF_SPLIT, None)
+                return
+            with self._lock:
+                self._batches_served += 1
+            self._reply(conn, OK, batches[b])
+            return
+        if op == DSVC_HEARTBEAT:
+            with self._lock:
+                self._last_seen[a] = time.monotonic()
+                epoch = self._epoch
+            self._reply(conn, epoch, None)
+            return
+        if op == DSVC_STATS:
+            self._reply(conn, OK, [json.dumps(self.stats()).encode()])
+            return
+        if op == DSVC_GET_EVAL:
+            if self._eval_chunk is None:
+                self._reply(conn, END_OF_SPLIT, None)
+            else:
+                self._reply(conn, OK, encode_batch(self._eval_chunk))
+            return
+        if op == DSVC_SHUTDOWN:
+            self.shutdown_requested.set()
+            self._reply(conn, OK, None)
+            return
+        self._reply(conn, ERR, None)
+
+
+# ----------------------------------------------------------------------------
+# Client transport — deadlines, backoff reconnect, incarnation healing
+# ----------------------------------------------------------------------------
+
+
+class DataServiceClient:
+    """One TCP connection to a data server (requests serialized on it).
+
+    The PR 1 fault posture, extended to input: every op takes the
+    ``op_timeout_s`` deadline; a transport failure triggers
+    exponential-backoff reconnect bounded by ``reconnect_deadline_s``
+    (``DSVCDeadlineError`` past it) and the op is replayed — every DSVC op
+    is idempotent or replay-safe by protocol (see the module docstring's
+    GET_SPLIT note).  On reconnect the client re-negotiates HELLO,
+    re-registers, and compares the server's incarnation: a change means a
+    RESTARTED server lost all assignment state, so registered
+    ``on_reincarnation`` callbacks run (the dataset source re-claims its
+    in-flight split there).
+
+    Fault-plan role: ``<process role>_ds`` by default, so ``DTX_FAULT_PLAN``
+    specs can target data connections specifically (``role=worker0_ds``)
+    while broad globs like ``worker0*`` still match both PS and data
+    clients of a worker.
+    """
+
+    def __init__(
+        self, host: str, port: int, *, worker_id: int = 0,
+        op_timeout_s: float | None = 30.0, reconnect_deadline_s: float = 60.0,
+        backoff_s: float = 0.25, role: str | None = None,
+    ):
+        self._host, self._port = host, port
+        self.worker_id = worker_id
+        self._op_timeout = op_timeout_s
+        self._reconnect_deadline = reconnect_deadline_s
+        self._backoff = backoff_s
+        self.role = role if role is not None else (
+            (faults.current_role() or "client") + "_ds"
+        )
+        self._injector = faults.client_injector(self.role)
+        self._lock = threading.RLock()
+        self._in_recovery = False
+        self._callbacks: list = []
+        self._sock: socket.socket | None = None
+        self._hdr = bytearray(wire.RESP_HDR.size)
+        self.incarnation: int | None = None
+        self.server_info: dict = {}
+        try:
+            self._connect()
+            self._register()
+        except OSError:
+            if self._reconnect_deadline <= 0:
+                raise
+            self._recover(time.monotonic() + self._reconnect_deadline)
+
+    # -- transport -----------------------------------------------------------
+
+    def _connect(self) -> None:
+        sock = socket.create_connection(
+            (self._host, self._port), timeout=self._op_timeout
+        )
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        status, tag = self._attempt(
+            DSVC_HELLO, a=wire.WIRE_VERSION, b=wire.WIRE_DTYPES["f32"]
+        )
+        if status != wire.WIRE_VERSION or tag != SERVICE_TAG:
+            self._sever()
+            raise DSVCError(
+                f"HELLO with {self._host}:{self._port} failed: asked "
+                f"v{wire.WIRE_VERSION}/dsvc, peer answered {status} "
+                f"{tag!r} — not a data service, or incompatible version"
+            )
+
+    def _register(self) -> None:
+        """REGISTER on the live socket (single attempt); detects a new
+        server incarnation and runs the reincarnation callbacks."""
+        status, raw = self._attempt(DSVC_REGISTER, name=self.role, a=self.worker_id)
+        if status != OK:
+            raise DSVCError(f"register rejected: {status}")
+        info = json.loads(raw)
+        changed = (
+            self.incarnation is not None
+            and info["incarnation"] != self.incarnation
+        )
+        self.server_info = info
+        if changed:
+            faults.log_event(
+                "dsvc_reincarnation", role=self.role, epoch=info["epoch"],
+            )
+            self._in_recovery = True
+            try:
+                for fn in list(self._callbacks):
+                    fn(info)
+            finally:
+                self._in_recovery = False
+        # Adopt the new incarnation only AFTER the callbacks completed: a
+        # transport fault inside a callback (e.g. a second drop during the
+        # re-claim) sends the recover loop around again, and the retried
+        # register must still see the incarnation as CHANGED so the
+        # callbacks re-run — callbacks are idempotent (claim re-claims).
+        self.incarnation = info["incarnation"]
+
+    def on_reincarnation(self, fn) -> None:
+        """Register ``fn(server_info)`` to run whenever a reconnect lands on
+        a NEW server incarnation (assignment state lost).  Callbacks may use
+        this client; their ops run single-attempt (no nested recovery)."""
+        self._callbacks.append(fn)
+
+    def _sever(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._reconnect_deadline = 0.0
+        self._sever()
+
+    def _attempt(
+        self, op: int, name: str = "", a: int = 0, b: int = 0, *,
+        batch: bool = False, deadline_s: float | None = None,
+    ):
+        """One send/recv round trip; severs the socket on ANY transport
+        failure (framing broken mid-stream).  Returns ``(status, payload)``
+        where payload is raw bytes, a decoded batch dict (``batch=True``),
+        or None when the response carries none."""
+        if self._sock is None:
+            raise ConnectionError("not connected")
+        try:
+            self._sock.settimeout(
+                deadline_s if deadline_s is not None else self._op_timeout
+            )
+            self._sock.sendall(wire.pack_request(op, name, a, b, 0))
+            hdr = memoryview(self._hdr)
+            wire.recv_exact(self._sock, hdr)
+            status, nbytes = wire.RESP_HDR.unpack(self._hdr)
+            if not nbytes:
+                return status, None
+            if batch:
+                return status, read_batch(self._sock, nbytes)
+            buf = bytearray(nbytes)
+            wire.recv_exact(self._sock, memoryview(buf))
+            return status, bytes(buf)
+        except OSError:
+            self._sever()
+            raise
+
+    def _recover(self, t_end: float) -> None:
+        attempt = 0
+        while True:
+            if attempt:
+                delay = min(self._backoff * (2 ** min(attempt - 1, 6)), 2.0)
+                time.sleep(min(delay, max(0.0, t_end - time.monotonic())))
+            if time.monotonic() >= t_end:
+                faults.log_event(
+                    "reconnect_gave_up", role=self.role, host=self._host,
+                    port=self._port, attempts=attempt,
+                )
+                raise DSVCDeadlineError(
+                    f"data service at {self._host}:{self._port} unreachable "
+                    f"for {self._reconnect_deadline:.0f}s ({attempt} attempts)"
+                )
+            attempt += 1
+            try:
+                self._connect()
+                self._register()
+            except OSError:
+                self._sever()
+                continue
+            except DSVCError:
+                # A callback's single-attempt op hit a transport fault: same
+                # as a raw drop — sever, retry, same deadline.  (A HELLO
+                # version/tag mismatch also lands here; retrying it is
+                # harmless and bounded by the deadline.)
+                self._sever()
+                continue
+            faults.log_event("reconnected", role=self.role, attempts=attempt)
+            return
+
+    def call(
+        self, op: int, name: str = "", a: int = 0, b: int = 0, *,
+        batch: bool = False,
+    ):
+        """One request/response; recovers + replays on transport failure
+        (every DSVC op is replay-safe — see class docstring)."""
+        with self._lock:
+            if self._injector is not None and self._injector.before_op(op):
+                self._sever()  # injected drop_conn
+            t_end = None
+            while True:
+                if self._sock is not None:
+                    try:
+                        return self._attempt(op, name, a, b, batch=batch)
+                    except OSError as e:
+                        if self._in_recovery or self._reconnect_deadline <= 0:
+                            raise DSVCError(f"dsvc op {op} failed: {e!r}") from e
+                        faults.log_event(
+                            "conn_lost", role=self.role, op_code=op,
+                            error=type(e).__name__,
+                        )
+                elif self._in_recovery or self._reconnect_deadline <= 0:
+                    raise DSVCError(f"dsvc op {op} failed: not connected")
+                if t_end is None:
+                    t_end = time.monotonic() + self._reconnect_deadline
+                self._recover(t_end)
+
+    # -- convenience ops -----------------------------------------------------
+
+    def heartbeat(self) -> int:
+        status, _ = self.call(DSVC_HEARTBEAT, a=self.worker_id)
+        return status
+
+    def stats(self) -> dict:
+        status, raw = self.call(DSVC_STATS)
+        if status != OK:
+            raise DSVCError(f"stats rejected: {status}")
+        return json.loads(raw)
+
+    def shutdown_server(self) -> None:
+        self.call(DSVC_SHUTDOWN)
+
+
+# ----------------------------------------------------------------------------
+# RemoteDatasetSource — the dsvc:// branch of data/streams.py
+# ----------------------------------------------------------------------------
+
+
+class _BatchPrefetcher:
+    """Double-buffered background prefetch (modeled on
+    ``async_ps.ParamPrefetcher``): while the trainer consumes batch k, the
+    fetch thread already pulls k+1 over the wire — transport latency hidden
+    under compute.  Errors surface on the CONSUMING side, never corrupt it;
+    a bounded queue (depth 2) caps both staleness and host RAM."""
+
+    _DONE = object()
+
+    def __init__(self, it: Iterator, *, depth: int = 2, stall_timeout_s: float = 300.0):
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._stall = stall_timeout_s
+        self._thread = threading.Thread(
+            target=self._loop, args=(it,), daemon=True, name="dsvc-prefetch"
+        )
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        while True:
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                if self._stop.is_set():
+                    return False
+
+    def _loop(self, it) -> None:
+        try:
+            for item in it:
+                if self._stop.is_set() or not self._put(item):
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised on the consumer
+            self._put(e)
+            return
+        self._put(self._DONE)
+
+    def __iter__(self):
+        while True:
+            try:
+                item = self._q.get(timeout=self._stall)
+            except queue.Empty:
+                raise DSVCDeadlineError("data-service prefetch thread stalled")
+            if item is self._DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+
+class RemoteDatasetSource:
+    """High-level consumer of one data server: owns a
+    :class:`DataServiceClient`, runs the split protocol, and yields ready
+    batches.  ``dsvc://host:port`` specs parse via :func:`parse_spec`.
+
+    Reconnect healing: the source registers an ``on_reincarnation`` hook
+    that RE-CLAIMS the unacknowledged in-flight split on the restarted
+    server, then resumes at the same batch index — exact, because split
+    batches are deterministic in ``(seed, split)``.  A split the restarted
+    epoch already completed (or another worker claimed first) is dropped
+    and the source moves on; duplicates are possible only across the
+    failover (at-least-once), never in steady state.
+    """
+
+    def __init__(
+        self, spec: str, *, worker_id: int = 0,
+        op_timeout_s: float | None = 30.0, reconnect_deadline_s: float = 60.0,
+        role: str | None = None, poll_s: float = 0.05,
+    ):
+        host, port = parse_spec(spec)
+        self.spec = spec
+        self._wid = worker_id
+        self._poll_s = poll_s
+        self._client = DataServiceClient(
+            host, port, worker_id=worker_id, op_timeout_s=op_timeout_s,
+            reconnect_deadline_s=reconnect_deadline_s, role=role,
+        )
+        self._client.on_reincarnation(self._reclaim)
+        self._epoch = int(self._client.server_info["epoch"])
+        self._ack = -1
+        self._cur: list | None = None  # [split, num_batches, next_index]
+
+    @property
+    def server_info(self) -> dict:
+        return self._client.server_info
+
+    @property
+    def num_splits(self) -> int:
+        return int(self._client.server_info["num_splits"])
+
+    def stats(self) -> dict:
+        return self._client.stats()
+
+    def eval_chunk(self) -> dict[str, np.ndarray] | None:
+        status, payload = self._client.call(DSVC_GET_EVAL, batch=True)
+        if status == END_OF_SPLIT:
+            return None
+        if status != OK:
+            raise DSVCError(f"get_eval rejected: {status}")
+        return payload
+
+    def close(self) -> None:
+        self._client.close()
+
+    # -- reincarnation healing ----------------------------------------------
+
+    def _reclaim(self, info: dict) -> None:
+        """Runs inside the client's reconnect path: re-request the
+        unacknowledged split from the restarted server (PR 1 semantics,
+        extended to input), adopt its epoch, and forget an ack addressed to
+        the dead incarnation only after handing it over."""
+        self._epoch = int(info["epoch"])
+        if self._cur is None:
+            return
+        split = self._cur[0]
+        status, raw = self._client._attempt(DSVC_CLAIM_SPLIT, a=self._wid, b=split)
+        if status == OK:
+            faults.log_event(
+                "dsvc_reclaimed", role=self._client.role, split=split,
+                index=self._cur[2],
+            )
+            return  # keep streaming the same split at the same index
+        # Completed already (an ack raced ahead) or taken by another worker:
+        # this split is no longer ours — drop it and move on.
+        faults.log_event(
+            "dsvc_reclaim_lost", role=self._client.role, split=split,
+            status=status,
+        )
+        self._cur = None
+
+    # -- the split/batch loop ------------------------------------------------
+
+    def _next_split(self, single_epoch: bool):
+        while True:
+            # The epoch always rides along: it tags the ack (so a stale ack
+            # from before an epoch roll is ignored server-side, never
+            # falsely completing the new epoch's copy) and, with ",strict",
+            # constrains assignment to it (single-epoch iteration).
+            sent_epoch = self._epoch
+            name = f"epoch={sent_epoch}" + (",strict" if single_epoch else "")
+            status, raw = self._client.call(
+                DSVC_GET_SPLIT, name=name, a=self._wid, b=self._ack
+            )
+            self._ack = -1
+            info = json.loads(raw) if raw else {}
+            if status >= 0:
+                self._epoch = int(info.get("epoch", self._epoch))
+                return status, int(info["num_batches"])
+            if status == WAIT:
+                time.sleep(self._poll_s)
+                continue
+            if status == EPOCH_ROLLED:
+                server_epoch = int(info.get("epoch", -1))
+                if single_epoch and (
+                    server_epoch < sent_epoch or self._epoch != sent_epoch
+                ):
+                    # Not a genuine roll: either the server RESTARTED into
+                    # an earlier epoch (state lost), or a mid-call recovery
+                    # already adopted the new incarnation's epoch while the
+                    # REPLAYED request still carried the stale constraint
+                    # (sent_epoch, not self._epoch, is what the server
+                    # answered).  Either way the epoch this client is
+                    # finishing IS the server's current one — adopt it and
+                    # keep going.
+                    self._epoch = server_epoch
+                    continue
+                return None, 0
+            raise DSVCError(f"get_split rejected: {status}")
+
+    def _iter_batches(self, repeat: bool) -> Iterator[dict[str, np.ndarray]]:
+        while True:
+            split, nb = self._next_split(single_epoch=not repeat)
+            if split is None:
+                return
+            self._cur = [split, nb, 0]
+            while True:
+                cur = self._cur
+                if cur is None:
+                    break  # lost to another worker across a failover
+                if cur[2] >= cur[1]:
+                    self._ack = cur[0]
+                    self._cur = None
+                    break
+                status, payload = self._client.call(
+                    DSVC_GET_BATCH, name=str(self._wid), a=cur[0], b=cur[2],
+                    batch=True,
+                )
+                if status == END_OF_SPLIT:
+                    self._ack = cur[0]
+                    self._cur = None
+                    break
+                if status != OK or payload is None:
+                    raise DSVCError(
+                        f"get_batch({cur[0]},{cur[2]}) rejected: {status}"
+                    )
+                if self._cur is cur:
+                    cur[2] += 1
+                yield payload
+
+    def batches(
+        self, *, repeat: bool = True, prefetch: bool = True,
+    ) -> Iterator[dict[str, np.ndarray]]:
+        """Ready batches from the server: this worker's FCFS share of each
+        epoch's splits.  ``repeat=False`` stops when the epoch the source
+        joined rolls over (every split acknowledged by someone).
+        ``prefetch`` double-buffers the next pull under the consumer's
+        compute."""
+        it = self._iter_batches(repeat)
+        if not prefetch:
+            return it
+        pf = _BatchPrefetcher(it)
+
+        def stream():
+            try:
+                yield from pf
+            finally:
+                pf.close()
+
+        return stream()
+
+
+# ----------------------------------------------------------------------------
+# Task-role hosting (the runner's `data_service` job)
+# ----------------------------------------------------------------------------
+
+
+def serve_from_dir(
+    data_dir: str, *, batch_size: int, seed: int = 0, augment: bool = True,
+    port: int = 0, loopback_only: bool = True, cache_splits: int = 4,
+) -> DataServiceServer:
+    """A server over a ``shard-*.npz`` directory: last shard held out as the
+    eval chunk (same convention as ``streams.resolve_image_source``), the
+    rest served as training splits with the standard image decode/augment
+    running server-side."""
+    shards = filestream.list_shards(data_dir)
+    if not shards:
+        raise ValueError(f"no shard files under {data_dir!r} to serve")
+    train = shards[:-1] if len(shards) > 1 else shards
+    if len(shards) == 1:
+        log.warning(
+            "data service: single shard — eval REUSES the train shard "
+            "(memorization!)"
+        )
+    return DataServiceServer(
+        train,
+        batch_size=batch_size,
+        decode_fn=filestream.image_decode_fn(augment=augment, seed=seed),
+        seed=seed,
+        eval_chunk=filestream.load_chunk(shards[-1]),
+        port=port,
+        loopback_only=loopback_only,
+        cache_splits=cache_splits,
+        # Advertised so consumers can sanity-check their own seed/augment
+        # request against what this pipeline actually runs (streams.py
+        # warns on mismatch — the server's settings win).
+        info_extra={"seed": seed, "augment": augment},
+    )
+
+
+def host_data_service_task(
+    data_dir: str, port: int, *, batch_size: int, seed: int = 0,
+    loopback_only: bool = True,
+) -> int:
+    """Dedicated data-service task body (``--job_name=data_service``): host
+    the server until a client signals DSVC_SHUTDOWN (or the supervisor
+    dies).  Arms ``die`` fault specs off the server's request counter —
+    the deterministic "kill the data server at request N" fault the
+    mid-epoch recovery tests inject; a supervisor restart plus the clients'
+    re-claim path heals it."""
+    server = serve_from_dir(
+        data_dir, batch_size=batch_size, seed=seed, port=port,
+        loopback_only=loopback_only,
+    )
+    faults.arm_process_faults(request_count_fn=server.request_count)
+    log.info(
+        "data service task on port %d (%d splits; blocking until shutdown)",
+        server.port, len(server._splits),
+    )
+    supervised = os.environ.get("DTX_DSVC_SUPERVISED") == "1"
+    ppid0 = os.getppid()
+    while not server.shutdown_requested.wait(timeout=2.0):
+        if supervised and os.getppid() != ppid0:
+            log.warning("data service task: supervisor died; exiting")
+            break
+    bound = server.port
+    server.stop()
+    return bound

@@ -1,8 +1,11 @@
 """Shared --data_dir resolution for the image CLIs (W2 cifar10, W3 resnet50).
 
-One place implements the three-way source selection every image example
-needs (SURVEY.md T7), so the CLIs cannot drift:
+One place implements the source selection every image example needs
+(SURVEY.md T7), so the CLIs cannot drift:
 
+- ``dsvc://host:port`` -> REMOTE disaggregated data service
+                       (data/data_service.py): ready batches streamed from
+                       dedicated input workers over the PS wire,
 - ``shard-*.dtxr``  -> NATIVE C++ loader (native/dataloader.cc),
 - ``shard-*.npz``   -> Python streaming pipeline (filestream),
 - anything else     -> in-RAM dataset from ``fallback()`` (real file or
@@ -29,9 +32,10 @@ log = logging.getLogger("dtx.data")
 
 @dataclasses.dataclass(frozen=True)
 class ImageSource:
-    kind: str  # "native" | "stream" | "memory"
+    kind: str  # "dsvc" | "native" | "stream" | "memory"
     ds: datasets.ArrayDataset  # .test always populated; .train only for memory
     train_shards: list[str]
+    remote_spec: str = ""  # "dsvc://host:port" for kind == "dsvc"
 
 
 def resolve_image_source(
@@ -42,6 +46,30 @@ def resolve_image_source(
     num_classes: int,
     name: str = "dataset",
 ) -> ImageSource:
+    if data_dir and data_dir.startswith("dsvc://"):
+        from . import data_service
+
+        # Remote disaggregated input: the server owns shards, decode and
+        # split assignment; the eval chunk is its held-out shard, served
+        # raw and decoded here like the on-disk branches.  worker_id=-1:
+        # a metadata-only probe must never count as a training worker in
+        # the dispatcher's liveness tables.
+        probe = data_service.RemoteDatasetSource(data_dir, worker_id=-1)
+        try:
+            raw_eval = probe.eval_chunk()
+            if raw_eval is None:
+                raise ValueError(f"data service {data_dir} serves no eval chunk")
+            n_splits = probe.num_splits
+        finally:
+            probe.close()
+        test = filestream.image_decode_fn(seed=seed)(raw_eval)
+        log.info("%s source: %s (%d remote splits)", name, data_dir, n_splits)
+        return ImageSource(
+            "dsvc",
+            datasets.ArrayDataset({}, test, data_dir, num_classes),
+            [],
+            remote_spec=data_dir,
+        )
     raw = native_loader.list_raw_shards(data_dir) if data_dir else []
     if raw:
         test = filestream.image_decode_fn(seed=seed)(
@@ -97,6 +125,41 @@ def train_iter(
     sample stream (memory), each with a worker-distinct seed.
     """
     w = 0 if worker is None else worker
+    if src.kind == "dsvc":
+        from . import data_service
+
+        # Batches arrive READY (decoded/augmented on the data server);
+        # double-buffered prefetch hides the wire under local compute.
+        # The SERVER's pipeline settings win over this call's arguments —
+        # every mismatch warns, none is silent.
+        remote = data_service.RemoteDatasetSource(src.remote_spec, worker_id=w)
+        info = remote.server_info
+        server_bs = int(info.get("batch_size", batch_size))
+        if server_bs != batch_size:
+            log.warning(
+                "data service serves batch_size=%d (requested %d): the "
+                "server's setting wins — relaunch it to change",
+                server_bs, batch_size,
+            )
+        if "seed" in info and int(info["seed"]) != seed:
+            log.warning(
+                "data service pipeline runs seed=%s (requested %d): batches "
+                "are NOT reproducible under the requested seed — relaunch "
+                "the data service to change", info["seed"], seed,
+            )
+        if "augment" in info and bool(info["augment"]) != augment:
+            log.warning(
+                "data service pipeline runs augment=%s (requested %s): the "
+                "server's decode_fn wins", info["augment"], augment,
+            )
+
+        def stream():
+            try:
+                yield from remote.batches(repeat=True)
+            finally:
+                remote.close()
+
+        return stream()
     decode = filestream.image_decode_fn(augment=augment, seed=seed)
     if src.kind == "native":
         shards = src.train_shards[w::n_workers]

@@ -53,6 +53,12 @@ the server keeps storing f32.  ``RemoteParamStore.get`` is versioned: a
 client-side cache plus the ``PSTORE_GET_IF_NEWER`` op make an
 unchanged-step pull cost one header-sized round trip instead of re-shipping
 the whole flat vector.
+
+The frame layout, HELLO negotiation, zero-copy send/recv and the bf16
+codec live in ``parallel/wire.py`` (r8), shared with the disaggregated
+data service (``data/data_service.py``) so the two wires cannot drift.
+On THIS wire, payload lengths count ELEMENTS of the negotiated dtype (the
+C++ server's contract); the data wire counts bytes.
 """
 
 from __future__ import annotations
@@ -66,6 +72,7 @@ import numpy as np
 
 from .. import native
 from ..utils import faults
+from . import wire
 
 # Op codes (must match native/ps_server.cc).
 _ACC_GET, _ACC_APPLY, _ACC_TAKE, _ACC_SET_STEP, _ACC_DROPPED = 1, 2, 3, 4, 5
@@ -76,37 +83,20 @@ _PSTORE_GET_OBJ, _PSTORE_SET, _PSTORE_GET = 16, 17, 18
 _INCARNATION, _ACC_APPLY_TAGGED, _GQ_PUSH_TAGGED = 19, 20, 21
 _ACC_DEDUPED, _GQ_DEDUPED = 22, 23
 _ACC_RESET_WORKER, _GQ_RESET_WORKER = 24, 25
-_HELLO, _PSTORE_GET_IF_NEWER = 26, 27
+_HELLO, _PSTORE_GET_IF_NEWER = wire.HELLO_OP, 27
 
 #: Wire protocol version this client speaks (ps_server.cc kWireVersion).
-WIRE_VERSION = 2
+WIRE_VERSION = wire.WIRE_VERSION
 
 #: Payload encodings (HELLO dtype codes).  f32 framing is byte-identical
 #: to wire v1; bf16 halves payload bytes and REQUIRES a negotiated peer.
-WIRE_DTYPES = {"f32": 0, "bf16": 1}
+WIRE_DTYPES = wire.WIRE_DTYPES
 
-
-def _f32_to_bf16(a: np.ndarray) -> np.ndarray:
-    """f32 -> bf16 (as uint16 bit patterns), round-to-nearest-even, NaN
-    kept quiet — bit-exact with the server's ``f32_to_bf16``.  In-place
-    arithmetic plus a cheap ``any()``-guarded NaN fixup: measured ~2x
-    faster than a branchless ``np.where`` select, whose extra full-size
-    temporaries cost more than the rare-NaN reduction saves."""
-    bits = np.ascontiguousarray(a, np.float32).view(np.uint32)
-    out32 = bits + np.uint32(0x7FFF)
-    out32 += (bits >> np.uint32(16)) & np.uint32(1)
-    out32 >>= np.uint32(16)
-    out = out32.astype(np.uint16)
-    nan = (bits & np.uint32(0x7FFFFFFF)) > np.uint32(0x7F800000)
-    if nan.any():
-        out[nan] = ((bits[nan] >> np.uint32(16)) | np.uint32(0x0040)).astype(
-            np.uint16
-        )
-    return out
-
-
-def _bf16_to_f32(u16: np.ndarray) -> np.ndarray:
-    return (u16.astype(np.uint32) << np.uint32(16)).view(np.float32)
+# The bf16 codec (round-to-nearest-even, bit-exact with the C++ server)
+# lives in parallel/wire.py; these module names stay as the stable import
+# point for tests and the bench.
+_f32_to_bf16 = wire.f32_to_bf16
+_bf16_to_f32 = wire.bf16_to_f32
 
 #: Deadline sentinel for bounded blocking ops (take/pop with ``timeout_s``).
 TIMED_OUT = native.TIMED_OUT
@@ -304,29 +294,15 @@ class PSClient:
     def _send_frame(self, header: bytes, payload: np.ndarray | None) -> None:
         """Scatter/gather send: header + payload leave via ``sendmsg`` with
         a memoryview over the array — the payload bytes are never copied
-        into a concatenated request buffer."""
-        if payload is None or payload.size == 0:
-            self._sock.sendall(header)
-            return
-        bufs = [memoryview(header), memoryview(payload).cast("B")]
-        while bufs:
-            sent = self._sock.sendmsg(bufs)
-            while bufs and sent >= len(bufs[0]):
-                sent -= len(bufs[0])
-                bufs.pop(0)
-            if bufs and sent:
-                bufs[0] = bufs[0][sent:]
+        into a concatenated request buffer (wire.send_frame)."""
+        wire.send_frame(self._sock, header, payload)
 
     def _recv_exact(self, view: memoryview) -> None:
         """Fill ``view`` from the socket via ``recv_into`` — no chunk
         accumulation (the old ``bytes +=`` loop was O(n²) in payload size),
-        no staging copy: responses land directly in their final buffer."""
-        pos, n = 0, len(view)
-        while pos < n:
-            r = self._sock.recv_into(view[pos:])
-            if r == 0:
-                raise ConnectionError("PS server closed the connection")
-            pos += r
+        no staging copy: responses land directly in their final buffer
+        (wire.recv_exact)."""
+        wire.recv_exact(self._sock, view)
 
     def _attempt(
         self, op: int, name: str = "", a: int = 0, b: int = 0,
@@ -337,10 +313,9 @@ class PSClient:
         ``payload`` must already be wire-encoded (``_encode_payload``)."""
         if self._sock is None:
             raise ConnectionError("not connected")
-        nm = name.encode()
-        header = struct.pack(
-            "<BB", op, len(nm)
-        ) + nm + struct.pack("<qqI", a, b, 0 if payload is None else payload.size)
+        header = wire.pack_request(
+            op, name, a, b, 0 if payload is None else payload.size
+        )
         try:
             self._sock.settimeout(deadline_s)
             self._send_frame(header, payload)

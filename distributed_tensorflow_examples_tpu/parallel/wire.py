@@ -1,0 +1,142 @@
+"""Shared wire machinery for the cross-process services (r8 satellite).
+
+Both socket services — the PS state service client (``parallel/ps_service.py``
+-> ``native/ps_server.cc``) and the disaggregated data service
+(``data/data_service.py``) — speak the same frame layout, the same HELLO
+version negotiation, and the same zero-copy send/receive discipline.  This
+module is the ONE definition of those pieces, factored out of ``ps_service``
+so the two services cannot drift:
+
+- **Frame layout** — request: ``<BB`` (op, name_len) + name bytes + ``<qqI``
+  (a, b, payload_len); response: ``<qI`` (status, payload_len).  The unit of
+  ``payload_len`` is per-service: the PS wire counts ELEMENTS of the
+  negotiated dtype (the C++ server's contract), the data wire counts BYTES
+  (batches carry mixed-dtype fields).  The layout and the zero-copy paths
+  are identical either way.
+- **HELLO** (op 26, shared code point) — version+dtype negotiation, sent
+  before any payload op can be misparsed.  The data service additionally
+  answers a service tag so a client dialing the wrong service fails loudly
+  instead of misinterpreting op codes.
+- **Zero-copy send** (:func:`send_frames`) — header + payload buffers leave
+  via scatter/gather ``sendmsg``; payload bytes are never copied into a
+  concatenated request buffer.
+- **Zero-copy receive** (:func:`recv_exact`) — ``recv_into`` straight into
+  the caller's buffer; no chunk accumulation (the pre-r7 ``bytes +=`` loop
+  was O(n²) in payload size), no staging copy.
+- **bf16 payload codec** — round-to-nearest-even f32<->bf16 bit-pattern
+  conversion, bit-exact with the C++ server's ``f32_to_bf16``.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+#: Wire protocol version (must match native/ps_server.cc kWireVersion).
+WIRE_VERSION = 2
+
+#: Payload encodings (HELLO dtype codes).  f32 framing is byte-identical
+#: to wire v1; bf16 halves payload bytes and REQUIRES a negotiated peer.
+WIRE_DTYPES = {"f32": 0, "bf16": 1}
+
+#: The shared HELLO op code (ps_server.cc op 26; the data service reserves
+#: the same code point so one negotiation routine serves both wires).
+HELLO_OP = 26
+
+#: Request tail after the name bytes: a, b, payload_len.
+REQ_TAIL = struct.Struct("<qqI")
+
+#: Response header: status, payload_len.
+RESP_HDR = struct.Struct("<qI")
+
+
+def pack_request(op: int, name: str, a: int, b: int, payload_len: int) -> bytes:
+    """The request frame header (everything but the payload)."""
+    nm = name.encode()
+    return struct.pack("<BB", op, len(nm)) + nm + REQ_TAIL.pack(a, b, payload_len)
+
+
+def f32_to_bf16(a: np.ndarray) -> np.ndarray:
+    """f32 -> bf16 (as uint16 bit patterns), round-to-nearest-even, NaN
+    kept quiet — bit-exact with the server's ``f32_to_bf16``.  In-place
+    arithmetic plus a cheap ``any()``-guarded NaN fixup: measured ~2x
+    faster than a branchless ``np.where`` select, whose extra full-size
+    temporaries cost more than the rare-NaN reduction saves."""
+    bits = np.ascontiguousarray(a, np.float32).view(np.uint32)
+    out32 = bits + np.uint32(0x7FFF)
+    out32 += (bits >> np.uint32(16)) & np.uint32(1)
+    out32 >>= np.uint32(16)
+    out = out32.astype(np.uint16)
+    nan = (bits & np.uint32(0x7FFFFFFF)) > np.uint32(0x7F800000)
+    if nan.any():
+        out[nan] = ((bits[nan] >> np.uint32(16)) | np.uint32(0x0040)).astype(
+            np.uint16
+        )
+    return out
+
+
+def bf16_to_f32(u16: np.ndarray) -> np.ndarray:
+    return (u16.astype(np.uint32) << np.uint32(16)).view(np.float32)
+
+
+def send_frames(sock, bufs) -> None:
+    """Scatter/gather send of a buffer list via ``sendmsg`` — no buffer is
+    ever copied into a concatenated message.  Accepts ``bytes``,
+    ``memoryview`` and contiguous ndarrays (cast to byte views here;
+    ``reshape(-1)`` keeps 0-d scalar arrays — unsized for ``len()`` —
+    valid)."""
+    out = []
+    for b in bufs:
+        if isinstance(b, np.ndarray):
+            if b.nbytes:
+                out.append(memoryview(b.reshape(-1)).cast("B"))
+        elif len(b):
+            out.append(memoryview(b))
+    while out:
+        sent = sock.sendmsg(out)
+        while out and sent >= len(out[0]):
+            sent -= len(out[0])
+            out.pop(0)
+        if out and sent:
+            out[0] = out[0][sent:]
+
+
+def send_frame(sock, header: bytes, payload: np.ndarray | None) -> None:
+    """Header + optional array payload (the PS client's request shape)."""
+    if payload is None or payload.size == 0:
+        sock.sendall(header)
+        return
+    send_frames(sock, [header, payload])
+
+
+def recv_exact(sock, view: memoryview) -> None:
+    """Fill ``view`` from the socket via ``recv_into`` — responses land
+    directly in their final buffer.  Raises ConnectionError on EOF."""
+    pos, n = 0, len(view)
+    while pos < n:
+        r = sock.recv_into(view[pos:])
+        if r == 0:
+            raise ConnectionError("peer closed the connection")
+        pos += r
+
+
+def read_request(sock, hdr2: bytearray | None = None):
+    """Server-side request parse: returns ``(op, name, a, b, payload_len)``
+    with the payload left unread on the socket (the handler decides the
+    receive buffer), or None on a clean EOF before a new frame."""
+    head = memoryview(hdr2 if hdr2 is not None else bytearray(2))
+    try:
+        recv_exact(sock, head)
+    except ConnectionError:
+        return None
+    op, nlen = head[0], head[1]
+    name = b""
+    if nlen:
+        nb = bytearray(nlen)
+        recv_exact(sock, memoryview(nb))
+        name = bytes(nb)
+    tail = bytearray(REQ_TAIL.size)
+    recv_exact(sock, memoryview(tail))
+    a, b, plen = REQ_TAIL.unpack(tail)
+    return op, name.decode(), a, b, plen

@@ -227,6 +227,58 @@ def _probe_ps(host: str, port: int, deadline_s: float) -> bool:
     return False
 
 
+def _supervised_reexec(FLAGS, *, child_env_flag: str) -> int | None:
+    """Re-exec this launch under ``utils.supervisor.supervise()`` — the
+    service-task crash-heal path shared by the ``ps`` and ``data_service``
+    roles.  Returns the supervisor's exit code when THIS process acted as
+    the supervisor (the caller exits with it), or None when the caller
+    should host the service itself: supervision disabled, a
+    non-re-executable launcher, or this process IS the supervised child
+    (``child_env_flag`` set).  A fault-INJECTED death is healed by
+    stripping the fired ``die`` spec from the restarted child's plan."""
+    from ..utils import faults
+
+    restarts = int(getattr(FLAGS, "ps_restarts", 0) or 0)
+    launcher = os.path.abspath(sys.argv[0]) if sys.argv else ""
+    if restarts > 0 and not (launcher.endswith(".py") and os.path.isfile(launcher)):
+        # Supervision re-execs the launch script; a programmatic or
+        # embedded caller whose argv does not reproduce this config
+        # would supervise the WRONG thing — host unsupervised instead.
+        log.warning(
+            "--ps_restarts=%d: launcher %r is not a re-executable "
+            "script; hosting the service unsupervised (a crash falls "
+            "back to whole-job restart)", restarts, sys.argv[:1],
+        )
+        restarts = 0
+    if restarts <= 0 or os.environ.get(child_env_flag) == "1":
+        return None
+    from ..utils import supervisor
+
+    env = dict(os.environ)
+    env[child_env_flag] = "1"
+
+    def heal_fault_plan(env: dict, attempt: int, returncode: int) -> dict:
+        # A fault-INJECTED death must not re-fire in the healing
+        # incarnation (the plan is inherited through the env);
+        # organic crashes keep the plan untouched.
+        if returncode == faults.FAULT_EXIT_CODE and env.get("DTX_FAULT_PLAN"):
+            env["DTX_FAULT_PLAN"] = faults.plan_without(
+                env["DTX_FAULT_PLAN"], "die", faults.current_role()
+            )
+            faults.log_event(
+                "supervisor_healed_plan", role=faults.current_role(),
+                attempt=attempt,
+            )
+        return env
+
+    return supervisor.supervise(
+        [sys.executable, os.path.abspath(sys.argv[0]), *sys.argv[1:]],
+        max_restarts=restarts,
+        env=env,
+        mutate_env=heal_fault_plan,
+    )
+
+
 def run_ps_cluster_task(
     *, init_fn, loss_fn, optimizer, batches_for_worker, FLAGS, mode, eval_fn=None,
     model_state=None,
@@ -248,9 +300,14 @@ def run_ps_cluster_task(
                   is expected at ``ps_hosts[0]`` and waited for (120 s).
     - ``worker``: gradient computation against the published snapshots
                   (``remote_worker_loop``), data-sharded by ``task_index``.
+    - ``data_service`` (r8): dedicated input worker — serves decoded,
+                  batched shards from its ``--data_dir`` at
+                  ``--data_service_hosts[task_index]``; training workers
+                  consume via ``--data_dir=dsvc://host:port``
+                  (``data/data_service.py``).  Needs no PS service.
 
     Fault posture (r6): each task gets a fault role (``ps0``, ``chief0``,
-    ``worker<i>``) for ``DTX_FAULT_PLAN`` matching, and the PS task runs
+    ``worker<i>``, ``data_service0``) for ``DTX_FAULT_PLAN`` matching, and the PS task runs
     under ``utils.supervisor.supervise()`` (``--ps_restarts``), so a PS
     crash is healed by PS restart + client reconnect/reseed instead of the
     whole-job crash-restart path — see RUNBOOK.md "Fault injection &
@@ -263,11 +320,47 @@ def run_ps_cluster_task(
     from ..parallel import async_ps
     from ..utils import faults
 
+    n_workers = worker_count(FLAGS)
+    local_bs = max(1, FLAGS.batch_size // n_workers)
+    job = FLAGS.job_name
+    if not faults.current_role():
+        faults.set_role(f"{job}{FLAGS.task_index}")
+
+    if job == "data_service":
+        # Disaggregated input worker (r8): serves ready batches from this
+        # task's --data_dir shards to training workers that resolve
+        # --data_dir=dsvc://host:port (data/data_service.py).  Same
+        # supervised-restart contract as the PS task — a killed data server
+        # comes back on the same port and the clients re-claim their
+        # in-flight splits mid-epoch.  Needs no PS service of its own.
+        from ..data import data_service as dsvc_lib
+
+        ds_hosts = getattr(FLAGS, "data_service_hosts", "") or ""
+        if not ds_hosts:
+            raise ValueError(
+                "--job_name=data_service needs --data_service_hosts "
+                "(host:port this task binds)"
+            )
+        ds_entries = ds_hosts.split(",")
+        my_host, my_port = ds_entries[
+            min(FLAGS.task_index, len(ds_entries) - 1)
+        ].rsplit(":", 1)
+        listen_all = _resolve_listen_all(FLAGS, my_host)
+        rc = _supervised_reexec(FLAGS, child_env_flag="DTX_DSVC_SUPERVISED")
+        if rc is not None:
+            if rc != 0:
+                raise SystemExit(rc)
+            return None
+        bound = dsvc_lib.host_data_service_task(
+            FLAGS.data_dir, int(my_port), batch_size=local_bs,
+            seed=FLAGS.seed, loopback_only=not listen_all,
+        )
+        print(f"DSVC_DONE port={bound}")
+        return None
+
     entries = FLAGS.ps_hosts.split(",")
     host, port_s = entries[0].rsplit(":", 1)
     port = int(port_s)
-    n_workers = worker_count(FLAGS)
-    local_bs = max(1, FLAGS.batch_size // n_workers)
     acfg = _ps_cfg(FLAGS, mode, n_workers)
     if acfg.fixed_interleave:
         # Real processes free-run — there is no scheduler to fix their
@@ -279,10 +372,7 @@ def run_ps_cluster_task(
             "ordering remains arrival-order nondeterministic."
         )
         acfg = dataclasses.replace(acfg, fixed_interleave=False)
-    job = FLAGS.job_name
     chief_hosts_service = FLAGS.ps_tasks == 0
-    if not faults.current_role():
-        faults.set_role(f"{job}{FLAGS.task_index}")
 
     if job == "ps":
         if chief_hosts_service:
@@ -294,48 +384,12 @@ def run_ps_cluster_task(
             min(FLAGS.task_index, len(entries) - 1)
         ].rsplit(":", 1)
         listen_all = _resolve_listen_all(FLAGS, my_host)
-        restarts = int(getattr(FLAGS, "ps_restarts", 0) or 0)
-        launcher = os.path.abspath(sys.argv[0]) if sys.argv else ""
-        if restarts > 0 and not (launcher.endswith(".py") and os.path.isfile(launcher)):
-            # Supervision re-execs the launch script; a programmatic or
-            # embedded caller whose argv does not reproduce this config
-            # would supervise the WRONG thing — host unsupervised instead.
-            log.warning(
-                "--ps_restarts=%d: launcher %r is not a re-executable "
-                "script; hosting the PS service unsupervised (a PS crash "
-                "falls back to whole-job restart)", restarts, sys.argv[:1],
-            )
-            restarts = 0
-        if restarts > 0 and os.environ.get("DTX_PS_SUPERVISED") != "1":
-            # Run the actual hosting in a supervised CHILD: a PS crash
-            # (injected or organic) is healed by a fresh incarnation on the
-            # same port, which the chief/worker clients reconnect into —
-            # partial recovery instead of whole-job crash-restart.
-            from ..utils import supervisor
-
-            env = dict(os.environ)
-            env["DTX_PS_SUPERVISED"] = "1"
-
-            def heal_fault_plan(env: dict, attempt: int, returncode: int) -> dict:
-                # A fault-INJECTED death must not re-fire in the healing
-                # incarnation (the plan is inherited through the env);
-                # organic crashes keep the plan untouched.
-                if returncode == faults.FAULT_EXIT_CODE and env.get("DTX_FAULT_PLAN"):
-                    env["DTX_FAULT_PLAN"] = faults.plan_without(
-                        env["DTX_FAULT_PLAN"], "die", faults.current_role()
-                    )
-                    faults.log_event(
-                        "supervisor_healed_plan", role=faults.current_role(),
-                        attempt=attempt,
-                    )
-                return env
-
-            rc = supervisor.supervise(
-                [sys.executable, os.path.abspath(sys.argv[0]), *sys.argv[1:]],
-                max_restarts=restarts,
-                env=env,
-                mutate_env=heal_fault_plan,
-            )
+        # Host in a supervised CHILD (--ps_restarts): a PS crash (injected
+        # or organic) is healed by a fresh incarnation on the same port,
+        # which the chief/worker clients reconnect into — partial recovery
+        # instead of whole-job crash-restart.
+        rc = _supervised_reexec(FLAGS, child_env_flag="DTX_PS_SUPERVISED")
+        if rc is not None:
             if rc != 0:
                 raise SystemExit(rc)
             return None

@@ -172,6 +172,17 @@ def define_legacy_cluster_flags():
         "parameter staleness; sync mode never prefetches).",
     )
     _define(
+        "string",
+        "data_service_hosts",
+        "",
+        "Disaggregated data service: host:port list where --job_name="
+        "data_service tasks listen (entry [task_index] is this task's bind "
+        "address).  Training workers reach the service via "
+        "--data_dir=dsvc://host:port; the task serves the shard files under "
+        "its own --data_dir.  Exposure rules follow --ps_listen_all; the "
+        "task restarts under --ps_restarts like the PS task.",
+    )
+    _define(
         "integer",
         "replicas_to_aggregate",
         0,
@@ -192,7 +203,12 @@ def is_cross_process_ps(FLAGS) -> bool:
     launch (SURVEY.md sections 3.1/3.2): a PS-emulation mode is selected,
     a PS service address is given, and this process was assigned a task
     role.  In that topology ``--ps_hosts`` is MEANINGFUL — it is where the
-    native state service (native/ps_server.cc) listens."""
+    native state service (native/ps_server.cc) listens.  The
+    ``data_service`` job is a task of the same launch pattern: a dedicated
+    input-worker process serving batches (data/data_service.py) — it needs
+    only ``--data_service_hosts``, not a PS service."""
+    if getattr(FLAGS, "job_name", "") == "data_service":
+        return bool(getattr(FLAGS, "data_service_hosts", ""))
     return (
         getattr(FLAGS, "job_name", "") in ("chief", "worker", "ps")
         and bool(getattr(FLAGS, "ps_hosts", ""))

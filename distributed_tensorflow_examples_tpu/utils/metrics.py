@@ -57,8 +57,23 @@ class MetricsWriter:
             self._tb.flush()
 
     def close(self) -> None:
-        self.flush()
-        if self._f is not None:
-            self._f.close()
-        if self._tb is not None:
-            self._tb.close()
+        """Flush + close both sinks.  Idempotent: teardown paths (context
+        exit, ``Experiment.finish``, test fixtures) may all call it."""
+        f, self._f = self._f, None
+        tb, self._tb = self._tb, None
+        if f is not None:
+            f.flush()
+            f.close()
+        if tb is not None:
+            tb.flush()
+            tb.close()
+
+    # Context manager: ``with MetricsWriter(d) as w: ...`` guarantees the
+    # TensorBoard event file is flushed — the JSONL sink is line-buffered,
+    # but TB events buffer in the writer thread and are LOST on an exit
+    # that skips close() (the abrupt-exit gap this closes).
+    def __enter__(self) -> "MetricsWriter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()

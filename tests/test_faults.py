@@ -391,6 +391,213 @@ def test_ps_kill_mid_run_heals_via_supervised_restart(tmp_path, caplog):
     assert ps_proc.returncode == 0, ps_log[-2000:]
 
 
+def _dsvc_splits(n=8, rows=16):
+    """Splits whose rows carry their split index (recoverable through the
+    image decode: marker = round((x + 0.5) * 255))."""
+    return [
+        {
+            "image": np.full((rows, 4), i, np.uint8),
+            "label": np.zeros(rows, np.int64),
+        }
+        for i in range(n)
+    ]
+
+
+def _dsvc_marker(batch) -> int:
+    # Invert the image decode's normalization (x = v/255 - 0.5).
+    return int(round((float(batch["image"].flat[0]) + 0.5) * 255))
+
+
+def test_data_service_client_faults_heal(caplog):
+    """r8 fault matrix, input leg: connection drops AND delays targeted at
+    the data-service client roles (``<role>_ds``) — the clients reconnect
+    into the SAME server incarnation, whose replay-safe GET_SPLIT re-answers
+    the held split, so the epoch still covers every split exactly once with
+    no duplicate deliveries."""
+    caplog.set_level("INFO", logger="dtx.faults")
+    from distributed_tensorflow_examples_tpu.data import data_service as dsvc
+
+    os.environ["DTX_FAULT_PLAN"] = (
+        "drop_conn:role=dw0_ds,op=6;drop_conn:role=dw1_ds,op=9,count=2;"
+        "delay:role=dw*_ds,op=4,count=6,ms=10"
+    )
+    srv = dsvc.DataServiceServer(_dsvc_splits(6, rows=8), batch_size=4, seed=0)
+    seen = {0: set(), 1: set()}
+    errors: list = []
+
+    def worker(w):
+        try:
+            src = dsvc.RemoteDatasetSource(
+                f"dsvc://127.0.0.1:{srv.port}", worker_id=w, role=f"dw{w}_ds",
+                op_timeout_s=10.0, reconnect_deadline_s=30.0,
+            )
+            for b in src.batches(repeat=False):
+                seen[w].add(int(b["image"][0, 0]))
+            src.close()
+        except BaseException as e:  # noqa: BLE001
+            errors.append((w, e))
+
+    try:
+        ts = [threading.Thread(target=worker, args=(w,)) for w in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=90)
+        assert not any(t.is_alive() for t in ts), "workers hung"
+        assert not errors, errors
+        assert seen[0] | seen[1] == set(range(6))
+        assert not (seen[0] & seen[1]), (seen, "duplicate delivery")
+        events = [
+            r.getMessage() for r in caplog.records if "dtx.faults" in r.getMessage()
+        ]
+        assert any("inject_drop_conn" in m and "role=dw0_ds" in m for m in events), events
+        assert any("inject_delay" in m and "_ds" in m for m in events), events
+        assert any("event=reconnected" in m and "_ds" in m for m in events), events
+    finally:
+        os.environ.pop("DTX_FAULT_PLAN", None)
+        srv.stop()
+
+
+_DSVC_TASK_SCRIPT = """\
+import os, sys
+sys.path.insert(0, {root!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from types import SimpleNamespace
+
+from distributed_tensorflow_examples_tpu.train import ps_experiment
+
+FLAGS = SimpleNamespace(
+    job_name="data_service", task_index=0, ps_hosts="",
+    data_service_hosts="127.0.0.1:{port}", worker_hosts="a:1,b:1",
+    ps_tasks=1, ps_listen_all=False, ps_restarts=2, data_dir={data_dir!r},
+    batch_size=8, train_steps=60, log_dir="", checkpoint_every_steps=50,
+    replicas_to_aggregate=0, max_staleness=0, deterministic=False, seed=0,
+    grad_accum=1,
+)
+ps_experiment.run_ps_cluster_task(
+    init_fn=None, loss_fn=None, optimizer=None, batches_for_worker=None,
+    FLAGS=FLAGS, mode="async", eval_fn=None,
+)
+"""
+
+
+def test_data_service_kill_mid_epoch_heals_via_supervised_restart(tmp_path, caplog):
+    """r8 acceptance: the data-service TASK is killed mid-epoch by the
+    fault plan (``die:after_reqs`` against role ``data_service0``), its
+    supervisor restarts it (stripping the fired spec), the clients
+    reconnect into the new incarnation and RE-CLAIM their in-flight splits,
+    and between the two workers every split is still visited at least
+    once."""
+    caplog.set_level("INFO", logger="dtx.faults")
+    import socket as _socket
+
+    from distributed_tensorflow_examples_tpu.data import (
+        data_service as dsvc,
+        filestream,
+    )
+
+    # 9 shards of 16 marker-valued NHWC rows (the task's decode_fn is the
+    # image decoder); the last shard is held out as the eval chunk, leaving
+    # 8 train splits of 4 local batches each.
+    n_train = 8
+    marker = np.repeat(np.arange(9, dtype=np.uint8), 16)
+    filestream.write_array_shards(
+        str(tmp_path / "shards"),
+        {
+            "image": np.broadcast_to(
+                marker[:, None, None, None], (144, 2, 2, 3)
+            ).copy(),
+            "label": np.zeros(144, np.int64),
+        },
+        rows_per_shard=16,
+    )
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    script = tmp_path / "dsvc_task.py"
+    script.write_text(
+        _DSVC_TASK_SCRIPT.format(
+            root=ROOT, port=port, data_dir=str(tmp_path / "shards")
+        )
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # Kill the data server once it has served 25 requests — mid-epoch: the
+    # 2-worker single-epoch run issues ~50 (32 batches + split/handshake
+    # traffic), while task startup alone stays well under the trigger.
+    env["DTX_FAULT_PLAN"] = "die:role=data_service0,after_reqs=25"
+    logf = open(tmp_path / "dsvc_task.log", "w")
+    proc = subprocess.Popen(
+        [sys.executable, str(script)],
+        stdout=logf, stderr=subprocess.STDOUT, env=env, cwd=ROOT,
+    )
+    seen = {0: set(), 1: set()}
+    errors: list = []
+
+    def worker(w):
+        try:
+            src = dsvc.RemoteDatasetSource(
+                f"dsvc://127.0.0.1:{port}", worker_id=w, role=f"dw{w}_ds",
+                op_timeout_s=10.0, reconnect_deadline_s=120.0,
+            )
+            for b in src.batches(repeat=False):
+                seen[w].add(_dsvc_marker(b))
+                time.sleep(0.03)  # spread the epoch across the kill point
+            src.close()
+        except BaseException as e:  # noqa: BLE001
+            errors.append((w, e))
+
+    try:
+        # Wait for the first incarnation to answer.
+        deadline = time.time() + 120
+        up = False
+        while time.time() < deadline:
+            try:
+                probe = dsvc.DataServiceClient(
+                    "127.0.0.1", port, role="probe_ds", reconnect_deadline_s=0.0
+                )
+                probe.close()
+                up = True
+                break
+            except OSError:
+                time.sleep(0.2)
+        assert up, "data service task never came up"
+
+        ts = [threading.Thread(target=worker, args=(w,)) for w in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=180)
+        assert not any(t.is_alive() for t in ts), "workers hung"
+        assert not errors, errors
+        assert seen[0] | seen[1] == set(range(n_train)), (
+            seen, "a split was never visited across the data-server restart",
+        )
+        # The clients crossed a NEW incarnation (restart detected).
+        events = [
+            r.getMessage() for r in caplog.records if "dtx.faults" in r.getMessage()
+        ]
+        assert any("event=dsvc_reincarnation" in m for m in events), events
+
+        # Clean shutdown of the healed second incarnation.
+        ctl = dsvc.DataServiceClient("127.0.0.1", port, role="ctl_ds")
+        ctl.shutdown_server()
+        ctl.close()
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        logf.close()
+    task_log = (tmp_path / "dsvc_task.log").read_text()
+    assert "event=inject_die" in task_log, task_log[-2000:]
+    assert "event=supervisor_healed_plan" in task_log, task_log[-2000:]
+    assert "DSVC_DONE" in task_log, task_log[-2000:]
+    assert proc.returncode == 0, task_log[-2000:]
+
+
 @pytest.mark.slow
 def test_worker_die_fault_in_multiprocess_cluster():
     """Fault-plan-driven worker death in a REAL 3-process cluster (the

@@ -16,6 +16,14 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def fault_tag(rec: dict) -> str:
+    # A step measured under an active fault plan must say so next to its
+    # number — a fault-run throughput is a recovery measurement, not a
+    # clean baseline.
+    plan = (rec.get("env") or {}).get("DTX_FAULT_PLAN", "")
+    return f" [faults: {plan}]" if plan else ""
+
+
 def fmt_bench(rec: dict, ok: str) -> str:
     # The status tag renders like every other step type: a failed bench
     # whose stdout still held a stale JSON line must read as FAILED, not
@@ -23,15 +31,39 @@ def fmt_bench(rec: dict, ok: str) -> str:
     j = rec.get("json") or {}
     d = j.get("detail", {})
     if not j:
-        return f"- `{rec['name']}` [{ok}]: NO JSON ({rec['seconds']}s)"
+        return f"- `{rec['name']}` [{ok}]{fault_tag(rec)}: NO JSON ({rec['seconds']}s)"
     mfu = d.get("mfu")
     mfu_s = f", {mfu*100:.1f}% MFU" if isinstance(mfu, (int, float)) else ""
     env = " ".join(f"{k}={v}" for k, v in rec.get("env", {}).items())
     return (
-        f"- `{rec['name']}` [{ok}]: **{j.get('value')} {j.get('unit')}**{mfu_s} "
+        f"- `{rec['name']}` [{ok}]{fault_tag(rec)}: **{j.get('value')} {j.get('unit')}**{mfu_s} "
         f"(vs_baseline {j.get('vs_baseline')}; {env or 'default env'}; "
         f"{rec['seconds']}s wall)"
     )
+
+
+def fmt_transport(rec: dict, ok: str) -> str:
+    """Host-side transport/streaming benches (ps_transport_bench,
+    data_service_bench): one line per detail row, memcpy-normalized
+    fractions included — the numbers perf_gate compares."""
+    j = rec.get("json") or {}
+    d = j.get("detail", {})
+    if not j:
+        return f"- `{rec['name']}` [{ok}]{fault_tag(rec)}: NO JSON ({rec['seconds']}s)"
+    lines = [
+        f"- `{rec['name']}` [{ok}]{fault_tag(rec)}: **{j.get('value')} {j.get('unit')}** "
+        f"(memcpy {d.get('memcpy_mbs')} MB/s; {rec['seconds']}s wall)"
+    ]
+    for row_name, row in d.items():
+        if isinstance(row, dict):
+            kv = " ".join(f"{k}={v}" for k, v in row.items())
+            lines.append(f"    - {row_name}: {kv}")
+    if "remote_over_local" in d:
+        lines.append(
+            f"    - remote_over_local={d['remote_over_local']} "
+            "(disaggregation bound: >= 0.5)"
+        )
+    return "\n".join(lines)
 
 
 def main():
@@ -43,7 +75,9 @@ def main():
     for rec in state.get("steps", []):
         name = rec["name"]
         ok = "ok" if rec["rc"] == 0 else f"FAILED rc={rec['rc']}" + (" (timeout)" if rec.get("timed_out") else "")
-        if name.startswith("bench_"):
+        if name in ("ps_transport_bench", "data_service_bench"):
+            print(fmt_transport(rec, ok))
+        elif name.startswith("bench_"):
             print(fmt_bench(rec, ok))
         elif name == "flash_parity":
             j = rec.get("json") or {}

@@ -142,6 +142,11 @@ def steps_plan() -> list[dict]:
         dict(name="ps_transport_bench",
              cmd=[PY, "tools/ps_transport_bench.py"], timeout=900,
              cpu_ok=True),
+        # Disaggregated-input streaming bench (r8): local filestream vs the
+        # remote data service on loopback — also accelerator-free.
+        dict(name="data_service_bench",
+             cmd=[PY, "tools/data_service_bench.py"], timeout=900,
+             cpu_ok=True),
     ]
     return plan
 

@@ -1,11 +1,13 @@
-"""Transport perf regression gate (r7 satellite).
+"""Transport perf regression gate (r7 satellite; data-service rows r8).
 
-Compares a ``tools/ps_transport_bench.py`` result against the checked-in
-host baseline (``tools/ps_transport_baseline.json``) and flags
-regressions, so a future PR cannot silently re-introduce the
-copy-per-send / O(n²)-receive framing this round removed.
+Compares a ``tools/ps_transport_bench.py`` or ``tools/data_service_bench.py``
+result against its checked-in host baseline (``tools/ps_transport_baseline
+.json`` / ``tools/data_service_baseline.json`` — auto-selected from the
+result's ``metric`` field) and flags regressions, so a future PR cannot
+silently re-introduce the copy-per-send / O(n²)-receive framing r7 removed,
+or regress remote batch streaming past the disaggregation acceptance bound.
 
-Two kinds of checks, both deliberately host-portable:
+Three kinds of checks, all deliberately host-portable:
 
 1. **Normalized throughput** — every ``*_frac_memcpy`` row (socket MB/s as
    a fraction of the host's own memcpy bandwidth) must stay above
@@ -16,6 +18,10 @@ Two kinds of checks, both deliberately host-portable:
    be at least ``--if-newer-ratio`` x faster than a full large pull,
    computed entirely from the RESULT file (no cross-host compare at all):
    the check that the versioned pull still moves O(header), not O(params).
+3. **remote/local ratio** (data-service results) — remote batch streaming
+   must deliver at least ``--remote-local-ratio`` (default 0.5: the ISSUE 3
+   "within 2x" acceptance bound) of the local filestream's MB/s, again from
+   the result file alone.
 
 The default tolerance is generous (0.25: flag only when a normalized row
 drops below a QUARTER of baseline) — this is a tripwire for structural
@@ -24,13 +30,22 @@ regressions, not a micro-perf ratchet.
 Usage:
   python tools/ps_transport_bench.py --json /tmp/t.json
   python tools/perf_gate.py /tmp/t.json
+  python tools/data_service_bench.py --json /tmp/d.json
+  python tools/perf_gate.py /tmp/d.json     # baseline auto-selected
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+#: metric field -> checked-in baseline file next to this script.
+BASELINES = {
+    "ps_transport_set_get_mbs": "ps_transport_baseline.json",
+    "data_service_stream_mbs": "data_service_baseline.json",
+}
 
 
 def _detail(rec: dict) -> dict:
@@ -38,11 +53,28 @@ def _detail(rec: dict) -> dict:
 
 
 def gate(
-    result: dict, baseline: dict, *, tolerance: float, if_newer_ratio: float
+    result: dict, baseline: dict, *, tolerance: float, if_newer_ratio: float,
+    remote_local_ratio: float = 0.5,
 ) -> list[str]:
     """Returns a list of human-readable regression lines (empty = pass)."""
     res, base = _detail(result), _detail(baseline)
     failures: list[str] = []
+    # The disaggregation acceptance bound, from the result alone: remote
+    # streaming within 1/ratio of the local in-process loader.  Applies in
+    # the 1 MB+ batch regime the acceptance criterion names — per-batch
+    # round-trip overhead legitimately dominates tiny (--quick) batches.
+    if (
+        isinstance(res.get("remote"), dict)
+        and isinstance(res.get("local"), dict)
+        and res.get("raw_batch_mb", 1.0) >= 1.0
+    ):
+        r, l = res["remote"].get("stream_mbs"), res["local"].get("stream_mbs")
+        if r and l and r < remote_local_ratio * l:
+            failures.append(
+                f"remote.stream_mbs: {r:.1f} < {remote_local_ratio} x local "
+                f"{l:.1f} MB/s — remote batch streaming outside the "
+                "disaggregation acceptance bound"
+            )
     for dtype, brow in base.items():
         if not isinstance(brow, dict):
             continue
@@ -77,21 +109,32 @@ def gate(
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("result", help="ps_transport_bench JSON record")
+    ap.add_argument("result", help="ps_transport_bench / data_service_bench JSON record")
     ap.add_argument(
-        "--baseline",
-        default=__file__.rsplit("/", 1)[0] + "/ps_transport_baseline.json",
+        "--baseline", default="",
+        help="baseline JSON; default: auto-selected next to this script "
+        "from the result's 'metric' field",
     )
     ap.add_argument("--tolerance", type=float, default=0.25)
     ap.add_argument("--if-newer-ratio", type=float, default=20.0)
+    ap.add_argument("--remote-local-ratio", type=float, default=0.5)
     args = ap.parse_args()
     with open(args.result) as f:
         result = json.load(f)
-    with open(args.baseline) as f:
+    baseline_path = args.baseline
+    if not baseline_path:
+        name = BASELINES.get(result.get("metric", ""))
+        if name is None:
+            print(f"PERF_GATE FAIL\n  unknown metric {result.get('metric')!r} "
+                  "and no --baseline given")
+            sys.exit(1)
+        baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+    with open(baseline_path) as f:
         baseline = json.load(f)
     failures = gate(
         result, baseline,
         tolerance=args.tolerance, if_newer_ratio=args.if_newer_ratio,
+        remote_local_ratio=args.remote_local_ratio,
     )
     if failures:
         print("PERF_GATE FAIL")
