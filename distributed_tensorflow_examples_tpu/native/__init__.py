@@ -129,8 +129,21 @@ def _load() -> ctypes.CDLL:
         lib.gq_reset_worker.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.ps_server_start.restype = ctypes.c_int
         lib.ps_server_start.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.ps_server_start_shard.restype = ctypes.c_int
+        lib.ps_server_start_shard.argtypes = [
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+        ]
         lib.ps_server_incarnation.restype = ctypes.c_int64
         lib.ps_server_requests.restype = ctypes.c_int64
+        lib.ps_server_incarnation_port.restype = ctypes.c_int64
+        lib.ps_server_incarnation_port.argtypes = [ctypes.c_int]
+        lib.ps_server_requests_port.restype = ctypes.c_int64
+        lib.ps_server_requests_port.argtypes = [ctypes.c_int]
+        lib.ps_server_stop_port.restype = ctypes.c_int
+        lib.ps_server_stop_port.argtypes = [ctypes.c_int]
         _lib = lib
     return _lib
 

@@ -28,6 +28,13 @@
 // wire version and desired dtype, the server echoes its version (or -4),
 // so a mismatched pair fails loudly at connect instead of misparsing
 // frames mid-stream.
+//
+// Sharded store (r9): a process may host SEVERAL of these servers, each
+// owning one contiguous shard of the flat parameter vector
+// (parallel/ps_shard.py scatter/gathers over them); HELLO additionally
+// validates the client's expected (shard_id, shard_count) against the
+// server's identity, so a mis-wired dial fails at connect instead of
+// silently serving the wrong slice.
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -136,6 +143,21 @@ enum Op : uint8_t {
 
 constexpr int64_t kWireVersion = 2;
 
+// Sharded PS (r9): HELLO's b operand additionally carries the SHARD
+// IDENTITY the client expects of this server — dtype in bits 0..7, the
+// expected shard id in bits 8..31 and the expected shard count in bits
+// 32..55.  shard_count == 0 means "no expectation" (every pre-r9 client:
+// their dtype codes are < 256, so the high bits are naturally zero).  A
+// non-zero expectation that mismatches the server's own (shard_id,
+// shard_count) answers -5 and leaves the connection's encoding untouched,
+// so a mis-wired dial — shard 2's client reaching shard 0's server, or an
+// N=2 client reaching an N=4 topology — fails loudly at connect instead
+// of silently training against the wrong slice of the parameter vector.
+constexpr int64_t kHelloDtypeMask = 0xFF;
+constexpr int kHelloShardIdShift = 8;
+constexpr int kHelloShardCountShift = 32;
+constexpr int64_t kHelloShardMask = 0xFFFFFF;
+
 // bf16 <-> f32 at the socket boundary (server-side storage stays f32).
 // Round-to-nearest-even, NaN kept quiet (the RNE carry would otherwise
 // round a NaN mantissa into infinity).  Branchless (select, not branch) so
@@ -179,6 +201,12 @@ struct Server {
   std::mutex mu;
   std::map<std::string, Object> objects;
   int listen_fd = -1;
+  int port = 0;  // bound port — the key for the per-port C entry points
+  // Shard identity (r9): which contiguous slice of the flat parameter
+  // vector this server owns.  Default (0, 1) = the whole vector (every
+  // pre-r9 topology).  HELLO validates a client's expectation against it.
+  int shard_id = 0;
+  int shard_count = 1;
   // Incarnation id: unique per server instance, so a reconnecting client
   // can tell "same server, transient drop" (replay suffices) from "server
   // restarted, all state lost" (re-create objects, republish, re-seed).
@@ -196,7 +224,14 @@ struct Server {
   std::atomic<int> live_conns{0};
 };
 
-Server* g_server = nullptr;
+// Live servers in start order (r9: one PROCESS may host several shard
+// servers — the chief-hosted --ps_tasks=0 sharded topology and the local
+// shard-scaling bench).  The un-suffixed C entry points keep their pre-r9
+// single-server contract: start appends, stop() stops ALL, incarnation()
+// answers the first (oldest) server, requests() answers the SUM — the
+// fault layer's ``die:after_reqs`` trigger then counts total traffic
+// served by the process, which with one server is exactly the old value.
+std::vector<Server*> g_servers;
 std::mutex g_server_mu;
 
 bool read_n(int fd, void* buf, size_t n) {
@@ -373,14 +408,28 @@ void serve_conn_impl(Server* s, int fd) {
       case PING:
         status = 0;
         break;
-      case HELLO:
-        if (a == kWireVersion && (b == 0 || b == 1)) {
-          wire_dtype = static_cast<int>(b);
-          status = kWireVersion;
-        } else {
+      case HELLO: {
+        const int64_t dtype = b & kHelloDtypeMask;
+        const int64_t want_id = (b >> kHelloShardIdShift) & kHelloShardMask;
+        const int64_t want_n = (b >> kHelloShardCountShift) & kHelloShardMask;
+        if (a != kWireVersion || (dtype != 0 && dtype != 1)) {
           status = -4;  // unsupported version/dtype: encoding unchanged
+        } else if (want_n != 0 && (want_n != s->shard_count ||
+                                   want_id != s->shard_id)) {
+          // Mis-wired dial: the client expects a different shard of the
+          // parameter vector than this server owns.  Answer the server's
+          // identity packed like the request so the client can report
+          // exactly what it reached.
+          status = -5 - ((static_cast<int64_t>(s->shard_id)
+                          << kHelloShardIdShift) |
+                         (static_cast<int64_t>(s->shard_count)
+                          << kHelloShardCountShift));
+        } else {
+          wire_dtype = static_cast<int>(dtype);
+          status = kWireVersion;
         }
         break;
+      }
       case INCARNATION:
         status = s->incarnation;
         break;
@@ -568,18 +617,45 @@ void accept_loop(Server* s) {
   }
 }
 
+// Stops one server: cancels all blocking waiters, stops accepting, shuts
+// down live connections and waits (bounded) for their threads to drain.
+// (Object memory is reclaimed at process exit — servers live for the run.)
+void stop_one(Server* s) {
+  s->stopping.store(true);
+  cancel_all(s);
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  s->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> clock(s->conn_mu);
+    for (int cfd : s->conn_fds) ::shutdown(cfd, SHUT_RDWR);
+  }
+  for (int i = 0; i < 2000 && s->live_conns.load() > 0; ++i)
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+}
+
+Server* find_port(int port) {
+  for (Server* s : g_servers)
+    if (s->port == port) return s;
+  return nullptr;
+}
+
 }  // namespace
 
 extern "C" {
 
-// Starts the server on <port> (0 = ephemeral); returns the bound port, or
-// -1 on failure.  One server per process.  ``loopback_only`` != 0 binds
-// 127.0.0.1 (the default, and the only safe choice on shared hosts — the
-// protocol is unauthenticated, like the reference's in-cluster gRPC);
-// 0 binds all interfaces for a multi-host PS cluster on a trusted network.
-int ps_server_start(int port, int loopback_only) {
+// Starts a shard server on <port> (0 = ephemeral); returns the bound port,
+// or -1 on failure.  A process may host several (one per shard — the
+// chief-hosted sharded topology and the shard-scaling bench).
+// ``loopback_only`` != 0 binds 127.0.0.1 (the default, and the only safe
+// choice on shared hosts — the protocol is unauthenticated, like the
+// reference's in-cluster gRPC); 0 binds all interfaces for a multi-host PS
+// cluster on a trusted network.  (shard_id, shard_count) is the server's
+// identity for HELLO validation; (0, 1) = the whole vector (pre-r9).
+int ps_server_start_shard(int port, int loopback_only, int shard_id,
+                          int shard_count) {
   std::lock_guard<std::mutex> lock(g_server_mu);
-  if (g_server) return -1;
+  if (shard_count < 1 || shard_id < 0 || shard_id >= shard_count) return -1;
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
   int one = 1;
@@ -604,6 +680,9 @@ int ps_server_start(int port, int loopback_only) {
     return -1;
   }
   s->listen_fd = fd;
+  s->port = static_cast<int>(ntohs(addr.sin_port));
+  s->shard_id = shard_id;
+  s->shard_count = shard_count;
   // Unique across restarts WITHIN a process (clock advances) and across
   // processes (pid mixed in); masked positive so the wire status stays
   // out of the error range.
@@ -611,44 +690,75 @@ int ps_server_start(int port, int loopback_only) {
                             std::chrono::system_clock::now().time_since_epoch())
                             .count();
   s->incarnation =
-      ((nanos ^ (static_cast<int64_t>(::getpid()) << 40)) & 0x7FFFFFFFFFFFFFFF);
+      ((nanos ^ (static_cast<int64_t>(::getpid()) << 40) ^
+        (static_cast<int64_t>(shard_id) << 32)) &
+       0x7FFFFFFFFFFFFFFF);
   if (s->incarnation == 0) s->incarnation = 1;
   s->accept_thread = std::thread(accept_loop, s);
-  g_server = s;
-  return static_cast<int>(ntohs(addr.sin_port));
+  g_servers.push_back(s);
+  return s->port;
 }
 
-// This process's live server incarnation id, or -1 when no server runs.
+// Pre-r9 entry point: one whole-vector server.
+int ps_server_start(int port, int loopback_only) {
+  return ps_server_start_shard(port, loopback_only, 0, 1);
+}
+
+// The FIRST (oldest) live server's incarnation id, or -1 when none runs.
 int64_t ps_server_incarnation() {
   std::lock_guard<std::mutex> lock(g_server_mu);
-  return g_server ? g_server->incarnation : -1;
+  return g_servers.empty() ? -1 : g_servers.front()->incarnation;
 }
 
-// Requests served by this process's live server (-1 when none runs) — the
-// fault layer's deterministic "kill PS at request N" trigger reads this.
+// A specific shard server's incarnation id, by bound port (-1 = no such
+// server).
+int64_t ps_server_incarnation_port(int port) {
+  std::lock_guard<std::mutex> lock(g_server_mu);
+  Server* s = find_port(port);
+  return s ? s->incarnation : -1;
+}
+
+// Requests served across ALL live servers in this process (-1 when none
+// runs) — the fault layer's deterministic "kill PS at request N" trigger
+// reads this, and with several local shard servers the right notion of
+// "the PS process's traffic" is the sum.
 int64_t ps_server_requests() {
   std::lock_guard<std::mutex> lock(g_server_mu);
-  return g_server ? g_server->requests.load(std::memory_order_relaxed) : -1;
+  if (g_servers.empty()) return -1;
+  int64_t total = 0;
+  for (Server* s : g_servers)
+    total += s->requests.load(std::memory_order_relaxed);
+  return total;
 }
 
-// Cancels all blocking waiters, stops accepting, shuts down live
-// connections and waits (bounded) for their threads to drain.  (Object
-// memory is reclaimed at process exit — the server lives for the run.)
+// One shard server's request count, by bound port (-1 = no such server).
+int64_t ps_server_requests_port(int port) {
+  std::lock_guard<std::mutex> lock(g_server_mu);
+  Server* s = find_port(port);
+  return s ? s->requests.load(std::memory_order_relaxed) : -1;
+}
+
+// Stops ALL live servers in this process (the pre-r9 contract, which had
+// at most one).
 void ps_server_stop() {
   std::lock_guard<std::mutex> lock(g_server_mu);
-  if (!g_server) return;
-  g_server->stopping.store(true);
-  cancel_all(g_server);
-  ::shutdown(g_server->listen_fd, SHUT_RDWR);
-  ::close(g_server->listen_fd);
-  g_server->accept_thread.join();
-  {
-    std::lock_guard<std::mutex> clock(g_server->conn_mu);
-    for (int cfd : g_server->conn_fds) ::shutdown(cfd, SHUT_RDWR);
+  for (Server* s : g_servers) stop_one(s);
+  g_servers.clear();
+}
+
+// Stops ONE shard server by bound port; returns 1 when a server was
+// stopped, 0 when no server listens there.  The targeted-kill primitive
+// for single-shard fault tests against in-process topologies.
+int ps_server_stop_port(int port) {
+  std::lock_guard<std::mutex> lock(g_server_mu);
+  for (auto it = g_servers.begin(); it != g_servers.end(); ++it) {
+    if ((*it)->port == port) {
+      stop_one(*it);
+      g_servers.erase(it);
+      return 1;
+    }
   }
-  for (int i = 0; i < 2000 && g_server->live_conns.load() > 0; ++i)
-    std::this_thread::sleep_for(std::chrono::milliseconds(1));
-  g_server = nullptr;
+  return 0;
 }
 
 }  // extern "C"

@@ -80,6 +80,11 @@ def _interpret() -> bool:
 
 
 def _params():
+    # Deliberately pinned to the NEW pallas class name: on older jax
+    # (TPUCompilerParams-era) this raises AttributeError BEFORE any
+    # pallas_call is built — that vintage's interpret-mode executor
+    # hard-aborts the process on these kernels, and a clean per-test
+    # failure must never become a suite-killing abort.
     return pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary")
     )

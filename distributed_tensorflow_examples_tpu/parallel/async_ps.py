@@ -474,13 +474,23 @@ class RemotePSChief(AsyncPSTrainer):
     the reference's dedicated-PS-task topology; the chief then signals
     ``ps_shutdown`` when training ends so the PS process exits 0.
 
-    Fault posture (r6): the client carries per-op deadlines and a
+    Sharded store (r9): ``ps_addrs`` (or ``ports`` for the in-process
+    topology) lists N shard servers — the flat parameter vector is
+    partitioned per :class:`ps_shard.ShardLayout` and every publish/pull/
+    gradient moves as N concurrent per-shard transfers
+    (``replica_device_setter`` spreading over multiple ``--ps_hosts``,
+    SURVEY.md section 3.1).  Step tokens and the shutdown signal stay on
+    shard 0 (the coordinator).  N = 1 keeps the r7 single-connection wire
+    byte-identical.
+
+    Fault posture (r6): each shard client carries per-op deadlines and a
     reconnect budget (cfg.ps_op_timeout_s / ps_reconnect_deadline_s); when
-    a reconnect lands on a NEW server incarnation (the PS task was
-    restarted, e.g. by ``supervise()``, losing all coordination state) the
-    chief re-seeds it — republish params, restore the accumulator's global
-    step, re-push the current step's tokens — so training continues from
-    the chief's own state instead of crash-restarting the whole job."""
+    a reconnect lands on a NEW server incarnation (that PS task was
+    restarted, e.g. by ``supervise()``, losing its state) the chief
+    re-seeds THAT SHARD individually — republish its params slice, restore
+    its accumulator's global step, re-push tokens if it is the coordinator
+    shard — so one shard's crash-restart never disturbs the other shards'
+    state or the workers' versioned caches of them."""
 
     #: Socket path: lost tokens/aggregations are real here — self-heal
     #: (see AsyncPSTrainer.sync_stall_repush_s).
@@ -489,65 +499,95 @@ class RemotePSChief(AsyncPSTrainer):
     def __init__(
         self, cfg, loss_fn, optimizer, init_params, *,
         port: int = 0, ps_addr: tuple[str, int] | None = None,
+        ps_addrs: list[tuple[str, int]] | None = None,
+        ports: list[int] | None = None,
         listen_all: bool = False, **kw,
     ):
         """``listen_all``: bind the in-process service on all interfaces
         (workers on other hosts; unauthenticated — explicit opt-in only,
-        same contract as ``host_ps_task``)."""
-        from . import ps_service
+        same contract as ``host_ps_task``).  ``ps_addrs``: external shard
+        servers, one per shard (``ps_addr`` = the 1-shard shorthand);
+        ``ports``: host N shard servers in-process at these ports (0 =
+        ephemeral; ``port`` = the 1-shard shorthand)."""
+        from . import ps_service, ps_shard
 
+        if ps_addrs is None and ps_addr is not None:
+            ps_addrs = [ps_addr]
         client_kw = dict(
             op_timeout_s=cfg.ps_op_timeout_s,
             reconnect_deadline_s=cfg.ps_reconnect_deadline_s,
-            role=faults.current_role() or "chief0",
             wire_dtype=cfg.ps_wire_dtype,
         )
-        if ps_addr is not None:
-            self.port = ps_addr[1]
-            self._client = ps_service.PSClient(ps_addr[0], ps_addr[1], **client_kw)
+        role = faults.current_role() or "chief0"
+        if ps_addrs is not None:
             self._owns_server = False
+            self.ports = [p for _, p in ps_addrs]
         else:
-            self.port = ps_service.start_server(port, loopback_only=not listen_all)
-            self._client = ps_service.PSClient("127.0.0.1", self.port, **client_kw)
+            n = len(ports) if ports else 1
+            self.ports = [
+                ps_service.start_server(
+                    p, loopback_only=not listen_all, shard_id=i, shard_count=n
+                )
+                for i, p in enumerate(ports if ports else [port])
+            ]
+            ps_addrs = [("127.0.0.1", p) for p in self.ports]
             self._owns_server = True
+        self.port = self.ports[0]
+        self._group = ps_shard.ShardedPSClients(ps_addrs, role=role, **client_kw)
+        self._client = self._group.coordinator
         super().__init__(cfg, loss_fn, optimizer, init_params, **kw)
         total = sum(self._leaf_sizes)
-        # Replace the in-process services with their socket proxies, so the
-        # chief exercises the same transport the workers do.
+        self._layout = ps_shard.ShardLayout(total, self._group.num_shards)
+        # Replace the in-process services with their (sharded) socket
+        # proxies, so the chief exercises the same transport the workers do.
         if cfg.mode == "sync_replicas":
-            self._accs = [ps_service.RemoteAccumulator(self._client, "acc", total)]
+            self._accs = [
+                ps_shard.ShardedAccumulator(self._group, "acc", self._layout)
+            ]
         else:
-            self._gq = ps_service.RemoteGradientQueue(
-                self._client, "gq", total, capacity=max(4, 2 * cfg.num_workers)
+            self._gq = ps_shard.ShardedGradientQueue(
+                self._group, "gq", self._layout,
+                capacity=max(4, 2 * cfg.num_workers),
             )
-        self._tq = ps_service.RemoteTokenQueue(self._client, "tokens")
-        self._pstore = ps_service.RemoteParamStore(self._client, "params", total)
-        self._client.on_reincarnation(self._reseed_ps_state)
+        self._tq = ps_service.RemoteTokenQueue(self._group.coordinator, "tokens")
+        self._pstore = ps_shard.ShardedParamStore(
+            self._group, "params", self._layout
+        )
+        for i, c in enumerate(self._group.clients):
+            c.on_reincarnation(lambda i=i: self._reseed_ps_state(i))
         self._publish()
 
-    def _reseed_ps_state(self) -> None:
+    def _reseed_ps_state(self, shard: int = 0) -> None:
         """Run after a reconnect re-created the (empty) objects on a
-        restarted PS: push back the volatile coordination state that only
-        the chief can reconstruct.  In-flight worker gradients from the old
-        incarnation are lost — exactly the reference's stale-drop posture —
-        and re-pushed tokens may admit an extra gradient per worker, which
-        the staleness gate then drops."""
+        restarted shard server: push back the volatile state that only the
+        chief can reconstruct — for THAT shard alone (r9: the other
+        shards' servers, and every worker's versioned cache of them, are
+        untouched).  In-flight worker gradients from the old incarnation
+        are lost — exactly the reference's stale-drop posture — and
+        re-pushed tokens may admit an extra gradient per worker, which the
+        staleness gate then drops."""
         faults.log_event(
-            "chief_reseed", step=self.global_step, mode=self.cfg.mode
+            "chief_reseed", step=self.global_step, mode=self.cfg.mode,
+            shard=shard,
         )
-        self._publish()
+        self._pstore.set_shard(shard, self.global_step, self._flat_params())
         if self.cfg.mode == "sync_replicas":
-            self._accs[0].set_global_step(self.global_step)
-            if self.global_step < self.cfg.train_steps:
+            self._accs[0].set_global_step_shard(shard, self.global_step)
+            if shard == 0 and self.global_step < self.cfg.train_steps:
+                # Tokens live on the coordinator shard only.
                 self._tq.push(self.global_step, self.cfg.num_workers)
         elif self.cfg.max_staleness is not None:
-            self._gq.set_min_step(self.global_step - self.cfg.max_staleness)
+            self._gq.set_min_step_shard(
+                shard, self.global_step - self.cfg.max_staleness
+            )
 
-    def _publish(self) -> None:
-        flat = np.concatenate(
+    def _flat_params(self) -> np.ndarray:
+        return np.concatenate(
             [np.asarray(l).reshape(-1) for l in jax.tree.leaves(self.params)]
         ).astype(np.float32)
-        self._pstore.set(self.global_step, flat)
+
+    def _publish(self) -> None:
+        self._pstore.set(self.global_step, self._flat_params())
 
     def _apply_update(self, grads) -> None:
         super()._apply_update(grads)
@@ -576,7 +616,8 @@ class RemotePSChief(AsyncPSTrainer):
             except Exception:
                 log.exception("final publish failed")
             try:
-                self._client.cancel_all()
+                # Broadcast: workers may be blocked on ANY shard's queues.
+                self._group.cancel_all()
             except Exception:
                 log.exception("cancel_all failed (server already down?)")
             try:
@@ -592,16 +633,21 @@ class RemotePSChief(AsyncPSTrainer):
         if self.cfg.ckpt_dir:
             self.save_checkpoint()
         if not self._owns_server:
-            # Dedicated-PS topology: release the external PS task LAST —
+            # Dedicated-PS topology: release the external PS tasks LAST —
             # after the dropped-counter reads above — so host_ps_task only
-            # tears the service down once nothing will dial it again.
-            # Best-effort: the PS may already have exited via its
+            # tears each service down once nothing will dial it again.
+            # EVERY shard task waits on its own server's ps_shutdown queue.
+            # Best-effort: a PS may already have exited via its
             # cancel-grace window, so do NOT spend the reconnect budget.
-            try:
-                self._client.fail_fast()
-                ps_service.RemoteTokenQueue(self._client, "ps_shutdown").push(0)
-            except Exception:
-                log.info("ps_shutdown signal not delivered (ps already down)")
+            self._group.fail_fast()
+            for i, c in enumerate(self._group.clients):
+                try:
+                    ps_service.RemoteTokenQueue(c, "ps_shutdown").push(0)
+                except Exception:
+                    log.info(
+                        "ps_shutdown signal not delivered to shard %d "
+                        "(ps already down)", i,
+                    )
         log.info(
             "remote async-PS chief done: %d applied steps, %d stale drops",
             self.global_step,
@@ -610,13 +656,22 @@ class RemotePSChief(AsyncPSTrainer):
         return self.params
 
 
-def host_ps_task(port: int, *, loopback_only: bool = True) -> int:
+def host_ps_task(
+    port: int, *, loopback_only: bool = True, shard_id: int = 0,
+    shard_count: int = 1,
+) -> int:
     """Dedicated PS-task body (``--job_name=ps`` under cross-process PS
     emulation): host the C++ state service on ``port`` and block until the
     chief signals ``ps_shutdown`` (the analog of ``server.join()``, except
     it RETURNS when training ends instead of blocking forever).  Returns
     the bound port.  ``loopback_only=False`` serves other hosts (trusted
     networks only — see ps_service.start_server).
+
+    (``shard_id``, ``shard_count``) is this task's shard identity in the
+    sharded-store topology (r9): which contiguous slice of the flat
+    parameter vector it owns.  HELLO-validated on every shard-aware
+    connection, so a mis-wired worker fails its dial loudly.  The chief
+    signals ``ps_shutdown`` to EVERY shard task at the end of training.
 
     Arms any ``die`` fault specs for this process (``DTX_FAULT_PLAN``) —
     ``after_reqs`` triggers off the server's request counter, the
@@ -627,13 +682,17 @@ def host_ps_task(port: int, *, loopback_only: bool = True) -> int:
 
     from . import ps_service
 
-    bound = ps_service.start_server(port, loopback_only=loopback_only)
+    bound = ps_service.start_server(
+        port, loopback_only=loopback_only, shard_id=shard_id,
+        shard_count=shard_count,
+    )
     faults.arm_process_faults(
         request_count_fn=ps_service.server_request_count
     )
     log.info(
-        "PS task serving on port %d, incarnation %d (blocking until chief "
-        "shutdown)", bound, ps_service.server_incarnation(),
+        "PS task serving on port %d (shard %d/%d), incarnation %d (blocking "
+        "until chief shutdown)", bound, shard_id, shard_count,
+        ps_service.server_incarnation(),
     )
     client = ps_service.PSClient("127.0.0.1", bound, timeout_s=10.0)
     tq = ps_service.RemoteTokenQueue(client, "ps_shutdown")
@@ -783,6 +842,9 @@ def remote_worker_loop(
     batches: Iterator,
     model_state: Any = None,
     rng: jax.Array | None = None,
+    addrs: list[tuple[str, int]] | None = None,
+    metrics_dir: str | None = None,
+    metrics_every: int = 20,
 ) -> int:
     """Worker PROCESS body: fetch the latest published params, compute a
     gradient on a local batch, push it (accumulator in sync mode, gradient
@@ -791,30 +853,44 @@ def remote_worker_loop(
     ``init_fn`` rebuilds the parameter STRUCTURE locally (deterministic
     shapes/treedef); values always come from the param store.
 
-    Fault posture (r6): the client reconnects through PS outages (bounded
-    by cfg.ps_reconnect_deadline_s) and its pushes are dedup-tagged with
-    this worker's id, so a push replayed after a drop is never applied
-    twice.  After a PS *restart*, the param store is empty until the chief
-    re-seeds it — the worker waits for a republished snapshot instead of
-    training on zeros.
-    """
-    from . import ps_service
+    Sharded store (r9): ``addrs`` lists the N shard servers in shard order
+    (defaults to the single ``(host, port)``); pulls/pushes then move as N
+    concurrent per-shard transfers and the per-shard wall times are
+    exported as ``ps/pull_ms_shard<i>`` / ``ps/push_ms_shard<i>`` scalars
+    under ``metrics_dir`` (every ``metrics_every`` contributed gradients)
+    so shard imbalance is visible in TensorBoard.
 
+    Fault posture (r6): each shard client reconnects through PS outages
+    (bounded by cfg.ps_reconnect_deadline_s) and its pushes are
+    dedup-tagged with this worker's id, so a push replayed after a drop is
+    never applied twice.  After a shard server *restart*, that shard's
+    store is empty until the chief re-seeds it — the worker waits for a
+    republished snapshot instead of training on zeros (the OTHER shards'
+    versioned caches stay valid throughout).
+    """
+    from . import ps_shard, ps_service
+    from ..utils import metrics
+    from ..utils.metrics import MetricsWriter
+
+    if addrs is None:
+        addrs = [(host, port)]
     role = faults.current_role() or f"worker{wid}"
-    client = ps_service.PSClient(
-        host, port,
+    client_kw = dict(
         op_timeout_s=cfg.ps_op_timeout_s,
         reconnect_deadline_s=cfg.ps_reconnect_deadline_s,
-        worker_tag=wid,
-        role=role,
         wire_dtype=cfg.ps_wire_dtype,
     )
+    group = ps_shard.ShardedPSClients(
+        addrs, role=role, worker_tag=wid, **client_kw
+    )
+    client = group.coordinator
     template = init_fn(jax.random.key(0))
     leaves, treedef = jax.tree.flatten(template)
     shapes = [l.shape for l in leaves]
     sizes = [int(np.prod(s)) if s else 1 for s in shapes]
     offsets = np.cumsum([0] + sizes)
     total = int(offsets[-1])
+    layout = ps_shard.ShardLayout(total, group.num_shards)
 
     def unflatten(flat):
         return jax.tree.unflatten(
@@ -825,33 +901,37 @@ def remote_worker_loop(
             ],
         )
 
-    pstore = ps_service.RemoteParamStore(client, "params", total)
+    pstore = ps_shard.ShardedParamStore(group, "params", layout)
     tq = ps_service.RemoteTokenQueue(client, "tokens")
     prefetcher = None
+    gq = None
     if cfg.mode == "sync_replicas":
-        acc = ps_service.RemoteAccumulator(client, "acc", total)
+        acc = ps_shard.ShardedAccumulator(group, "acc", layout)
+        push_ms_src = acc
     else:
-        gq = ps_service.RemoteGradientQueue(
-            client, "gq", total, capacity=max(4, 2 * cfg.num_workers)
+        gq = ps_shard.ShardedGradientQueue(
+            group, "gq", layout, capacity=max(4, 2 * cfg.num_workers)
         )
+        push_ms_src = gq
         if cfg.ps_prefetch:
-            # Async only: double-buffer the pull on a dedicated connection
-            # so the next snapshot streams while this step's gradient
-            # computes.  Distinct fault role ("<role>_pf") so plans can
-            # target the prefetch connection specifically; "worker*" globs
-            # still match both.
-            pf_client = ps_service.PSClient(
-                host, port,
-                op_timeout_s=cfg.ps_op_timeout_s,
-                reconnect_deadline_s=cfg.ps_reconnect_deadline_s,
-                role=f"{role}_pf",
-                wire_dtype=cfg.ps_wire_dtype,
+            # Async only: double-buffer the pull on dedicated connections
+            # (one per shard) so the next snapshot streams while this
+            # step's gradient computes.  Distinct fault role ("<role>_pf",
+            # shard i > 0 appending "_s<i>") so plans can target the
+            # prefetch connections specifically; "worker*" globs still
+            # match both.
+            pf_group = ps_shard.ShardedPSClients(
+                addrs, role=f"{role}_pf", **client_kw
             )
+            pf_store = ps_shard.ShardedParamStore(pf_group, "params", layout)
             prefetcher = ParamPrefetcher(
-                pf_client,
-                ps_service.RemoteParamStore(pf_client, "params", total),
+                pf_group, pf_store,
                 wait_budget_s=max(cfg.ps_reconnect_deadline_s, 5.0),
             )
+            pstore_timing = pf_store  # pulls run on the prefetch store
+    if prefetcher is None:
+        pstore_timing = pstore
+    writer = MetricsWriter(metrics_dir) if metrics_dir else None
     model_state = model_state if model_state is not None else {}
     rng = rng if rng is not None else jax.random.key(0)
 
@@ -916,7 +996,20 @@ def remote_worker_loop(
             break  # chief finished and tore the service down
         contributed += 1
         it += 1
+        if writer is not None and contributed % max(1, metrics_every) == 0:
+            # Per-shard transport wall times (r9 satellite): shard
+            # imbalance — one slow/hot shard server — shows up as one
+            # ps/*_ms_shard<i> series running away from the others.
+            writer.scalars(
+                local_step,
+                {
+                    **metrics.shard_scalars("pull", pstore_timing.last_pull_ms),
+                    **metrics.shard_scalars("push", push_ms_src.last_push_ms),
+                },
+            )
+    if writer is not None:
+        writer.close()
     if prefetcher is not None:
         prefetcher.close()
-    client.close()
+    group.close()
     return contributed

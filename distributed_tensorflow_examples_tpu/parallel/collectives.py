@@ -37,8 +37,14 @@ def axis_index(axis_name: str):
 
 
 def axis_size(axis_name: str):
-    """Number of devices along the named mesh axis."""
-    return lax.axis_size(axis_name)
+    """Number of devices along the named mesh axis.
+
+    Version shim: ``lax.axis_size`` is newer jax; older releases use the
+    canonical constant-folding idiom ``psum(1, axis)`` (a python-int
+    reduction, resolved statically at trace time)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
 
 
 def ring_permute(x, axis_name: str, *, shift: int = 1):
@@ -50,8 +56,40 @@ def ring_permute(x, axis_name: str, *, shift: int = 1):
     return lax.ppermute(x, axis_name, perm=perm)
 
 
-def shard_map(fn, mesh, *, in_specs, out_specs, check_vma: bool = False):
-    """Project-standard wrapper over ``jax.shard_map`` (manual SPMD regions)."""
-    return jax.shard_map(
-        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+def shard_map(
+    fn, mesh, *, in_specs, out_specs, check_vma: bool = False,
+    axis_names=None,
+):
+    """Project-standard wrapper over ``jax.shard_map`` (manual SPMD regions).
+
+    Version shim: ``jax.shard_map`` (with ``check_vma`` and
+    ``axis_names``) graduated from ``jax.experimental.shard_map`` — where
+    the same knobs are ``check_rep`` and the COMPLEMENT set ``auto`` —
+    so resolve whichever this jax ships.  This wrapper is the ONE place
+    that difference lives; nothing else in the project may call the jax
+    symbol directly.  ``axis_names``: mesh axes the region is manual
+    over (None = all of them)."""
+    if hasattr(jax, "shard_map"):
+        kw = {} if axis_names is None else {"axis_names": set(axis_names)}
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma, **kw,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if axis_names is not None and frozenset(axis_names) != frozenset(
+        mesh.axis_names
+    ):
+        # Old jax spells partial-manual as the complement set ``auto=``,
+        # but that path hard-ABORTS the process (jaxlib CHECK failure) on
+        # the CPU interpret configs our tests run — a clean refusal here
+        # must never become a suite-killing abort.  Full-manual regions
+        # (axis_names == every mesh axis) need no translation at all.
+        raise NotImplementedError(
+            "partial-manual shard_map (axis_names ⊂ mesh axes) requires "
+            "jax.shard_map; this jax only ships the experimental API"
+        )
+    return _shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
     )

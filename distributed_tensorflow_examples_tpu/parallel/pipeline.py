@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from . import collectives
 from .mesh import AXIS_PIPE
 
 
@@ -123,9 +124,9 @@ def pipeline_apply(
         mask = (r == S - 1).astype(jnp.float32)
         return lax.psum(valid.astype(jnp.float32) * mask, axis)
 
-    out_mb = jax.shard_map(
+    out_mb = collectives.shard_map(
         pipelined,
-        mesh=mesh,
+        mesh,
         in_specs=(P(axis), P()),
         out_specs=P(),
         axis_names={axis},

@@ -59,6 +59,13 @@ codec live in ``parallel/wire.py`` (r8), shared with the disaggregated
 data service (``data/data_service.py``) so the two wires cannot drift.
 On THIS wire, payload lengths count ELEMENTS of the negotiated dtype (the
 C++ server's contract); the data wire counts bytes.
+
+Sharded store (r9): ``parallel/ps_shard.py`` spreads the flat parameter
+vector over N of these servers (one ``PSClient`` per shard, HELLO pinned
+via ``expect_shard``) and scatter/gathers concurrently; this module stays
+the single-connection layer it builds on.  ``call(out=...)`` receives a
+response directly into a caller-provided buffer slice — the sharded
+gather's zero-staging path.
 """
 
 from __future__ import annotations
@@ -117,31 +124,57 @@ class PSDeadlineError(PSError):
     ``reconnect_deadline_s``."""
 
 
-def start_server(port: int = 0, *, loopback_only: bool = True) -> int:
-    """Start the in-process C++ PS server; returns the bound port.
+def start_server(
+    port: int = 0, *, loopback_only: bool = True, shard_id: int = 0,
+    shard_count: int = 1,
+) -> int:
+    """Start an in-process C++ PS server; returns the bound port.
 
     ``loopback_only=False`` binds all interfaces — required when workers on
     OTHER hosts dial this PS task (the protocol is unauthenticated, so only
-    do this on a trusted cluster network, as with the reference's gRPC)."""
-    p = native._load().ps_server_start(port, 1 if loopback_only else 0)
+    do this on a trusted cluster network, as with the reference's gRPC).
+
+    (``shard_id``, ``shard_count``) is the server's shard identity (r9):
+    which contiguous slice of the flat parameter vector it owns.  HELLO
+    validates a shard-aware client's expectation against it, so a
+    mis-wired dial fails loudly.  One process may host SEVERAL shard
+    servers (the chief-hosted sharded topology and the shard bench)."""
+    p = native._load().ps_server_start_shard(
+        port, 1 if loopback_only else 0, shard_id, shard_count
+    )
     if p < 0:
         raise RuntimeError("ps_server_start failed")
     return p
 
 
-def stop_server() -> None:
-    native._load().ps_server_stop()
+def stop_server(port: int | None = None) -> None:
+    """Stop ALL in-process servers, or — ``port`` given — just the shard
+    server bound there (the targeted-kill primitive for single-shard fault
+    tests against in-process topologies)."""
+    if port is None:
+        native._load().ps_server_stop()
+    else:
+        native._load().ps_server_stop_port(port)
 
 
-def server_incarnation() -> int:
-    """This process's live server incarnation id (-1 when none runs)."""
-    return int(native._load().ps_server_incarnation())
+def server_incarnation(port: int | None = None) -> int:
+    """A live server's incarnation id (-1 when none runs): the oldest
+    server's by default, or the shard server bound at ``port``."""
+    lib = native._load()
+    if port is None:
+        return int(lib.ps_server_incarnation())
+    return int(lib.ps_server_incarnation_port(port))
 
 
-def server_request_count() -> int:
-    """Requests served by this process's live server (-1 when none runs) —
-    the trigger for ``die:after_reqs`` fault specs."""
-    return int(native._load().ps_server_requests())
+def server_request_count(port: int | None = None) -> int:
+    """Requests served (-1 when no server runs) — the trigger for
+    ``die:after_reqs`` fault specs.  Default: the SUM across this process's
+    live servers (with several local shards, the process's total traffic);
+    ``port`` narrows to one shard server."""
+    lib = native._load()
+    if port is None:
+        return int(lib.ps_server_requests())
+    return int(lib.ps_server_requests_port(port))
 
 
 class PSClient:
@@ -170,6 +203,15 @@ class PSClient:
                              ways; negotiated at connect via HELLO, so a
                              peer that can't speak wire v2 fails the
                              connection loudly instead of misparsing).
+    ``expect_shard``         (shard_id, shard_count) this client expects of
+                             the server it dials (r9 sharded PS).  Non-None
+                             forces the HELLO handshake on every connect
+                             (f32 included) and a server owning a DIFFERENT
+                             shard fails the connection loudly — a
+                             mis-wired dial must never silently serve the
+                             wrong slice of the parameter vector.  None =
+                             no expectation (pre-r9 framing, byte-identical
+                             for f32).
     """
 
     #: Server-side wait per blocking-op round trip when the client has a
@@ -182,12 +224,14 @@ class PSClient:
         op_timeout_s: float | None = None, reconnect_deadline_s: float = 0.0,
         backoff_s: float = 0.25, worker_tag: int | None = None,
         role: str | None = None, wire_dtype: str = "f32",
+        expect_shard: tuple[int, int] | None = None,
     ):
         if wire_dtype not in WIRE_DTYPES:
             raise ValueError(
                 f"wire_dtype {wire_dtype!r} not in {sorted(WIRE_DTYPES)}"
             )
         self._host, self._port = host, port
+        self._expect_shard = expect_shard
         self._connect_timeout = timeout_s
         self._op_timeout = op_timeout_s if op_timeout_s is not None else timeout_s
         self._reconnect_deadline = reconnect_deadline_s
@@ -235,10 +279,13 @@ class PSClient:
         )
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock
-        if self._wire_code != WIRE_DTYPES["f32"]:
-            # Encoding differs from the v1 framing: HELLO per connection
-            # (the server's dtype is per-connection state), BEFORE any
-            # payload op can be misparsed.  f32 connections skip it — their
+        if self._wire_code != WIRE_DTYPES["f32"] or self._expect_shard is not None:
+            # Encoding differs from the v1 framing (HELLO per connection —
+            # the server's dtype is per-connection state, negotiated BEFORE
+            # any payload op can be misparsed) — or the caller expects a
+            # specific SHARD of a sharded store, which the server must
+            # confirm before any payload lands on the wrong slice.  Plain
+            # f32 connections without a shard expectation skip it: their
             # framing is byte-identical to v1, so nothing can misparse and
             # the connect stays one round trip cheaper.
             self._negotiate()
@@ -250,20 +297,31 @@ class PSClient:
         PERMANENT and must not be retried by the reconnect loop."""
         # HELLO carries no payload either way, so it frames identically
         # under every encoding — safe to send before the answer arrives.
+        sid, scount = self._expect_shard if self._expect_shard else (0, 0)
         status, _ = self._attempt(
-            _HELLO, a=WIRE_VERSION, b=self._wire_code,
+            _HELLO, a=WIRE_VERSION,
+            b=wire.pack_hello_b(self._wire_code, sid, scount),
             deadline_s=self._connect_timeout
             if self._connect_timeout is not None
             else 10.0,
         )
-        if status != WIRE_VERSION:
-            self._sever()
+        if status == WIRE_VERSION:
+            return
+        self._sever()
+        if status <= wire.HELLO_SHARD_MISMATCH:
+            got_id, got_n = wire.unpack_shard_mismatch(status)
             raise PSError(
-                f"wire negotiation with {self._host}:{self._port} failed: "
-                f"asked v{WIRE_VERSION}/{self.wire_dtype}, peer answered "
-                f"{status} (pre-v2 server, or unsupported dtype) — both ends "
-                "must speak wire v2 for a non-f32 encoding"
+                f"mis-wired shard dial: {self._host}:{self._port} owns shard "
+                f"{got_id}/{got_n} but this client expected shard "
+                f"{sid}/{scount} — check the --ps_hosts order/--ps_shards "
+                "against the running PS tasks"
             )
+        raise PSError(
+            f"wire negotiation with {self._host}:{self._port} failed: "
+            f"asked v{WIRE_VERSION}/{self.wire_dtype}, peer answered "
+            f"{status} (pre-v2 server, or unsupported dtype) — both ends "
+            "must speak wire v2 for a non-f32 encoding"
+        )
 
     def _sever(self) -> None:
         sock, self._sock = self._sock, None
@@ -307,10 +365,17 @@ class PSClient:
     def _attempt(
         self, op: int, name: str = "", a: int = 0, b: int = 0,
         payload: np.ndarray | None = None, *, deadline_s: float | None = None,
+        out: np.ndarray | None = None,
     ) -> tuple[int, np.ndarray]:
         """One send/recv round trip; severs the socket on ANY failure (the
         framing is broken mid-stream, so the connection is unusable).
-        ``payload`` must already be wire-encoded (``_encode_payload``)."""
+        ``payload`` must already be wire-encoded (``_encode_payload``).
+        ``out``: optional preallocated f32 destination — a response whose
+        element count matches lands via ``recv_into`` DIRECTLY in it (the
+        sharded gather's zero-staging path: each shard's slice of one
+        output buffer); any other length falls back to a fresh array, so
+        status-only answers (e.g. an unchanged-step pull) never clobber
+        or misreport the caller's buffer."""
         if self._sock is None:
             raise ConnectionError("not connected")
         header = wire.pack_request(
@@ -326,16 +391,21 @@ class PSClient:
                 return status, np.empty((0,), np.float32)
             # Receive straight into the result array (f32) or its bf16
             # staging array (upconverted in one vectorized pass).  Freshly
-            # allocated per response, so callers own it outright — the old
-            # frombuffer().copy() double-touch is gone.
+            # allocated per response unless the caller supplied a matching
+            # ``out`` — then the payload lands in the caller's buffer with
+            # zero staging copies.
             if self._wire_code == 0:
-                out = np.empty((plen,), np.float32)
-                self._recv_exact(memoryview(out).cast("B"))
-            else:
-                raw = np.empty((plen,), np.uint16)
-                self._recv_exact(memoryview(raw).cast("B"))
-                out = _bf16_to_f32(raw)
-            return status, out
+                dst = out if out is not None and out.size == plen else None
+                if dst is None:
+                    dst = np.empty((plen,), np.float32)
+                self._recv_exact(memoryview(dst.reshape(-1)).cast("B"))
+                return status, dst
+            raw = np.empty((plen,), np.uint16)
+            self._recv_exact(memoryview(raw).cast("B"))
+            if out is not None and out.size == plen:
+                out.reshape(-1)[:] = _bf16_to_f32(raw)
+                return status, out
+            return status, _bf16_to_f32(raw)
         except OSError:
             self._sever()
             raise
@@ -447,6 +517,7 @@ class PSClient:
         self, op: int, name: str = "", a: int = 0, b: int = 0,
         payload: np.ndarray | None = None, *, replay_safe: bool = True,
         server_wait_s: float = 0.0, fault_point: bool = True,
+        out: np.ndarray | None = None,
     ) -> tuple[int, np.ndarray]:
         """One request/response; recovers + replays on transport failure
         when recovery is enabled and the op is ``replay_safe`` (idempotent
@@ -455,7 +526,8 @@ class PSClient:
         bounded wait is never mistaken for a dead peer.  ``fault_point``:
         whether this call advances the fault-injection op counter — the
         chunked re-issues of one logical blocking op pass False so plan
-        indices count LOGICAL ops, not timing-dependent chunks."""
+        indices count LOGICAL ops, not timing-dependent chunks.  ``out``:
+        optional preallocated response destination (see ``_attempt``)."""
         # Encode once, outside the retry loop: a replay re-sends the same
         # wire bytes without re-converting (bf16) or re-checking layout.
         wire_payload = self._encode_payload(payload)
@@ -476,7 +548,8 @@ class PSClient:
                 if self._sock is not None:
                     try:
                         return self._attempt(
-                            op, name, a, b, wire_payload, deadline_s=deadline
+                            op, name, a, b, wire_payload, deadline_s=deadline,
+                            out=out,
                         )
                     except OSError as e:
                         if self._in_recovery or self._reconnect_deadline <= 0:

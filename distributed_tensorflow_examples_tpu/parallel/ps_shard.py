@@ -1,0 +1,691 @@
+"""Sharded parameter store: parallel scatter/gather over N PS shards (r9).
+
+The reference round-robins variables over *multiple* ``--ps_hosts`` tasks
+(``tf.train.replica_device_setter`` — SURVEY.md section 3.1); until r9 our
+port funneled the entire flat param/gradient vector through ONE PS process
+and one connection, so that host's NIC and the serialized pull/push were
+the scaling bottleneck ("TensorFlow: a system for large-scale machine
+learning", arXiv:1605.08695 section 4.4; weight-update sharding per
+arXiv:2004.13336).  This module partitions the flat vector into N
+contiguous shards — one per PS server — and turns the client hot path into
+parallel scatter/gather:
+
+- :class:`ShardLayout` is the ONE deterministic partition: sizes/offsets
+  derived from ``(num_elems, num_shards)`` alone — checkpoint-stable,
+  independent of worker count and identical in every process, so clients
+  and the chief can never disagree about which server owns which slice.
+  The HELLO handshake additionally pins each connection to its shard
+  (``PSClient(expect_shard=...)``): a mis-wired dial fails loudly.
+- :class:`ShardedParamStore` pulls with ``recv_into`` DIRECTLY into
+  disjoint slices of a single preallocated output buffer — and pushes
+  zero-copy ``memoryview`` slices of the flat vector — concurrently via a
+  per-shard thread pool, so wall-clock pull time drops toward
+  ``max(shard) ~ total/N`` instead of ``sum``.  Versioned pulls
+  (``PSTORE_GET_IF_NEWER``) stay per-shard: an unchanged shard answers
+  O(header) and its bytes are reused from the previous assembled buffer,
+  so a reseeded shard refetches alone while the other shards' caches stay
+  valid.
+- :class:`ShardedAccumulator` / :class:`ShardedGradientQueue` scatter
+  gradient slices to per-shard accumulator/queue objects and gather the
+  per-shard averages/pops back into one flat vector.  Blocking gathers
+  retain per-shard partial results across a ``TIMED_OUT`` return, so the
+  chief's stall-repush loop never loses an already-drained shard average
+  (drains are at-most-once — see ps_service).
+
+**Semantic notes (documented divergence, SURVEY.md section 7 step 6):**
+
+- The chief's publish and the workers' pushes are no longer atomic across
+  the whole vector: two shards can briefly disagree by one step mid-
+  publish, and in sync mode two shard accumulators can aggregate different
+  worker subsets when ``replicas_to_aggregate < num_workers`` — exactly
+  the torn-cross-variable-update window the reference's per-variable PS
+  placement admits (our pre-r9 single flat store was *stricter* than the
+  reference).  The chief's stall-repush heals the rare count-divergence
+  stall the tear can cause.  N=1 keeps the strict pre-r9 semantics and is
+  wire-byte-identical to the r7 path.
+- Async pops gather each shard's head-of-queue slice; under reordered
+  arrivals an assembled "gradient" may mix slices from different workers'
+  same-regime pushes — elementwise-valid for every elementwise optimizer,
+  and again the reference's own per-variable async behavior.
+
+Step tokens and other coordination scalars stay on shard 0 (the
+coordinator shard); ``async_ps.RemotePSChief`` publishes each shard to its
+own server and reseeds a restarted shard INDIVIDUALLY via that client's
+``on_reincarnation`` hook.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from . import ps_service
+
+__all__ = [
+    "ShardLayout",
+    "ShardedPSClients",
+    "ShardedParamStore",
+    "ShardedAccumulator",
+    "ShardedGradientQueue",
+]
+
+
+class ShardLayout:
+    """Deterministic contiguous partition of ``num_elems`` over
+    ``num_shards`` servers.
+
+    Shard ``i`` owns ``[offsets[i], offsets[i+1])``; the first
+    ``num_elems % num_shards`` shards are one element larger, so the cover
+    is exact for every (size, N) pair — including N > num_elems, where the
+    trailing shards own zero elements (their servers stay on the launch
+    topology but carry NO objects and see no data traffic — the native
+    services reject zero-element objects, so empty shards are handled
+    entirely client-side).  A pure function of its two inputs:
+    every process, every restart, and every worker count derives the SAME
+    layout, which is what makes sharded checkpoints/publishes stable.
+    """
+
+    def __init__(self, num_elems: int, num_shards: int):
+        if num_elems < 0:
+            raise ValueError(f"num_elems must be >= 0, got {num_elems}")
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        self.num_elems = int(num_elems)
+        self.num_shards = int(num_shards)
+        base, rem = divmod(self.num_elems, self.num_shards)
+        self.sizes: tuple[int, ...] = tuple(
+            base + (1 if i < rem else 0) for i in range(self.num_shards)
+        )
+        offs = [0]
+        for s in self.sizes:
+            offs.append(offs[-1] + s)
+        self.offsets: tuple[int, ...] = tuple(offs)
+
+    def slice(self, i: int) -> slice:
+        return slice(self.offsets[i], self.offsets[i + 1])
+
+    def shard_of(self, elem: int) -> int:
+        """The shard owning flat index ``elem``."""
+        if not 0 <= elem < max(self.num_elems, 1):
+            raise IndexError(elem)
+        return int(np.searchsorted(self.offsets, elem, side="right") - 1)
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, ShardLayout)
+            and other.num_elems == self.num_elems
+            and other.num_shards == self.num_shards
+        )
+
+    def __repr__(self) -> str:
+        return f"ShardLayout(num_elems={self.num_elems}, num_shards={self.num_shards})"
+
+
+class _ShardPool:
+    """One persistent daemon thread per shard, executing the per-shard leg
+    of a scatter/gather.  Persistent (not per-op spawn) so the
+    unchanged-step fast path — N parallel O(header) round trips — isn't
+    dominated by thread start-up, and daemon so a leaked pool can never
+    wedge interpreter shutdown behind a blocked socket.  ``run`` is
+    serialized (one scatter/gather at a time per pool): each sharded
+    object owns its own pool, and its callers are single-threaded by
+    contract (the worker/chief loops)."""
+
+    def __init__(self, n: int, name: str):
+        self._tasks: list[queue.SimpleQueue] = [queue.SimpleQueue() for _ in range(n)]
+        self._done: queue.SimpleQueue = queue.SimpleQueue()
+        self._run_lock = threading.Lock()
+        self._threads = [
+            threading.Thread(
+                target=self._loop, args=(i,), daemon=True, name=f"{name}-s{i}"
+            )
+            for i in range(n)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _loop(self, i: int) -> None:
+        while True:
+            fn = self._tasks[i].get()
+            if fn is None:
+                return
+            try:
+                self._done.put((i, fn(), None))
+            except BaseException as e:  # noqa: BLE001 — re-raised in run()
+                self._done.put((i, None, e))
+
+    def run(self, fns: dict[int, object]) -> dict[int, object]:
+        """Execute ``fns[i]`` on shard thread ``i`` concurrently; returns
+        the per-shard results.  The first per-shard exception is re-raised
+        AFTER every leg completes (a half-landed scatter must not leave
+        stray worker threads racing the caller's next op)."""
+        with self._run_lock:
+            for i, fn in fns.items():
+                self._tasks[i].put(fn)
+            out: dict[int, object] = {}
+            first_exc: BaseException | None = None
+            for _ in range(len(fns)):
+                i, r, e = self._done.get()
+                if e is not None and first_exc is None:
+                    first_exc = e
+                out[i] = r
+            if first_exc is not None:
+                raise first_exc
+            return out
+
+    def close(self) -> None:
+        for q in self._tasks:
+            q.put(None)
+
+
+class ShardedPSClients:
+    """One :class:`ps_service.PSClient` per shard server, plus the shared
+    scatter/gather machinery the sharded objects hang off.
+
+    ``addrs`` orders the servers BY SHARD (entry i serves shard i — the
+    ``--ps_hosts`` order); with N > 1 every connection carries an
+    ``expect_shard`` HELLO so a permuted/mis-copied host list fails the
+    connect loudly.  N == 1 keeps the pre-r9 framing byte-identical (no
+    HELLO on f32) and every sharded object degrades to a zero-overhead
+    pass-through around its single-shard Remote* counterpart.
+
+    Client fault roles: shard 0 keeps the caller's bare ``role`` (so
+    existing single-shard fault plans keep matching), shard i > 0 gets
+    ``<role>_s<i>`` — a plan can target one shard's client specifically.
+    """
+
+    def __init__(
+        self, addrs: list[tuple[str, int]], *, role: str | None = None,
+        **client_kw,
+    ):
+        if not addrs:
+            raise ValueError("need at least one shard address")
+        self.addrs = list(addrs)
+        n = len(self.addrs)
+        self.clients: list[ps_service.PSClient] = []
+        try:
+            for i, (host, port) in enumerate(self.addrs):
+                kw = dict(client_kw)
+                if role is not None:
+                    kw["role"] = role if i == 0 else f"{role}_s{i}"
+                self.clients.append(
+                    ps_service.PSClient(
+                        host, port,
+                        expect_shard=(i, n) if n > 1 else None,
+                        **kw,
+                    )
+                )
+        except BaseException:
+            self.close()
+            raise
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.addrs)
+
+    @property
+    def coordinator(self) -> ps_service.PSClient:
+        """Shard 0's client — where step tokens and other unsharded
+        coordination scalars live."""
+        return self.clients[0]
+
+    def cancel_all(self) -> None:
+        """Broadcast CANCEL_ALL to every shard server (chief teardown:
+        workers may be blocked on any shard's queue)."""
+        for c in self.clients:
+            c.cancel_all()
+
+    def fail_fast(self) -> None:
+        for c in self.clients:
+            c.fail_fast()
+
+    def close(self) -> None:
+        for c in self.clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+
+def _pool_for(group: ShardedPSClients, tag: str) -> _ShardPool | None:
+    return (
+        _ShardPool(group.num_shards, f"dtx-ps-{tag}")
+        if group.num_shards > 1
+        else None
+    )
+
+
+class ShardedParamStore:
+    """The published (step, flat params) snapshot, spread over N shard
+    servers — pulls gather concurrently into one preallocated buffer,
+    publishes scatter zero-copy slices.  API-compatible with
+    :class:`ps_service.RemoteParamStore` (``set``/``get``/
+    ``invalidate_cache`` and the read-only-result contract); N == 1
+    delegates to it outright, so the single-shard wire stays
+    byte-identical to r7.
+
+    Versioned pulls are per-shard: ``get`` issues ``PSTORE_GET_IF_NEWER``
+    with each shard's cached step.  All-unchanged returns the previous
+    assembled buffer untouched (N O(header) round trips, zero copies);
+    any changed shard receives straight into its slice of a FRESH buffer
+    (never the one previously returned — a consumer may still be reading
+    it under the prefetch overlap) and only genuinely unchanged slices
+    are copied across from the previous buffer (rare: the chief publishes
+    every shard each step, so the steady state is all-changed or
+    all-unchanged).
+
+    ``last_pull_ms``/``last_push_ms`` expose the most recent per-shard
+    wall times — the shard-imbalance signal the worker loop exports as
+    ``ps/pull_ms_shard<i>`` TensorBoard scalars.
+    """
+
+    def __init__(
+        self, group: ShardedPSClients, name: str, layout: ShardLayout, *,
+        cache_pulls: bool = True,
+    ):
+        if layout.num_shards != group.num_shards:
+            raise ValueError(
+                f"{layout} does not match {group.num_shards} shard clients"
+            )
+        self._group, self._name, self._layout = group, name, layout
+        n = layout.num_shards
+        self.last_pull_ms = [0.0] * n
+        self.last_push_ms = [0.0] * n
+        self._single: ps_service.RemoteParamStore | None = None
+        if n == 1:
+            self._single = ps_service.RemoteParamStore(
+                group.clients[0], name, layout.num_elems,
+                cache_pulls=cache_pulls,
+            )
+            return
+        self._pool = _pool_for(group, "pull")
+        self._cache_enabled = cache_pulls
+        self._steps = [-1] * n
+        self._front: np.ndarray | None = None
+        # Shards with a zero-size slice (N > num_elems layouts) carry no
+        # remote objects and see no traffic — handled entirely here.
+        self._active = [i for i in range(n) if layout.sizes[i] > 0]
+        for i in self._active:
+            c = group.clients[i]
+            ps_service._check(
+                c.ensure_object(
+                    ps_service._PSTORE_GET_OBJ, name, layout.sizes[i]
+                ),
+                "pstore_get_obj",
+            )
+            if cache_pulls:
+                # A transport gap proves only THAT shard's mirror stale —
+                # the other shards' versioned caches stay valid (their
+                # connections never dropped), so a single restarted shard
+                # refetches alone.
+                c.on_reconnect(lambda i=i: self.invalidate_shard(i))
+
+    # -- cache management ---------------------------------------------------
+
+    def invalidate_shard(self, i: int) -> None:
+        if self._single is not None:
+            self._single.invalidate_cache()
+            return
+        self._steps[i] = -1
+
+    def invalidate_cache(self) -> None:
+        if self._single is not None:
+            self._single.invalidate_cache()
+            return
+        self._steps = [-1] * self._layout.num_shards
+        self._front = None
+
+    # -- publish (scatter) --------------------------------------------------
+
+    def set_shard(self, i: int, step: int, flat: np.ndarray) -> None:
+        """Publish ONE shard's slice of ``flat`` at ``step`` — the chief's
+        targeted reseed of a restarted shard server (the other shards'
+        stores, and every client's cache of them, stay untouched)."""
+        if self._single is not None:
+            self._single.set(step, flat)
+            return
+        if self._layout.sizes[i] == 0:
+            return
+        flat = np.ascontiguousarray(flat, np.float32).reshape(-1)
+        s, _ = self._group.clients[i].call(
+            ps_service._PSTORE_SET, self._name, step,
+            payload=flat[self._layout.slice(i)],
+        )
+        ps_service._check(s, "pstore_set")
+
+    def set(self, step: int, flat: np.ndarray) -> None:
+        """Publish ``flat`` at ``step``: each shard server receives its
+        contiguous slice — a zero-copy view of the caller's array on the
+        f32 wire — concurrently."""
+        if self._single is not None:
+            t0 = time.perf_counter()
+            self._single.set(step, flat)
+            self.last_push_ms[0] = (time.perf_counter() - t0) * 1e3
+            return
+        flat = np.ascontiguousarray(flat, np.float32).reshape(-1)
+        if flat.size != self._layout.num_elems:
+            raise ValueError(
+                f"flat vector has {flat.size} elems, layout expects "
+                f"{self._layout.num_elems}"
+            )
+
+        def one(i: int):
+            t0 = time.perf_counter()
+            s, _ = self._group.clients[i].call(
+                ps_service._PSTORE_SET, self._name, step,
+                payload=flat[self._layout.slice(i)],
+            )
+            self.last_push_ms[i] = (time.perf_counter() - t0) * 1e3
+            return ps_service._check(s, "pstore_set")
+
+        self._pool.run({i: (lambda i=i: one(i)) for i in self._active})
+
+    # -- pull (gather) ------------------------------------------------------
+
+    def _gather_full(self) -> tuple[int, np.ndarray]:
+        """Unconditional full pull of every shard into one fresh buffer."""
+        buf = np.empty(self._layout.num_elems, np.float32)
+
+        def one(i: int):
+            t0 = time.perf_counter()
+            s, _ = self._group.clients[i].call(
+                ps_service._PSTORE_GET, self._name,
+                out=buf[self._layout.slice(i)],
+            )
+            self.last_pull_ms[i] = (time.perf_counter() - t0) * 1e3
+            return ps_service._check(s, "pstore_get")
+
+        res = self._pool.run({i: (lambda i=i: one(i)) for i in self._active})
+        step = min(res.values())
+        if step >= 0 and self._cache_enabled:
+            for i, s in res.items():
+                self._steps[i] = int(s)
+            self._front = buf
+        return step, buf
+
+    def get(self) -> tuple[int, np.ndarray]:
+        """Latest assembled snapshot: ``(step, flat)``.  ``step`` is the
+        MINIMUM across shards — negative while any shard is still
+        unpublished (restart/reseed window: callers keep polling, exactly
+        the single-shard contract), and briefly one less than the newest
+        shard mid-publish (the documented sharding tear).  The returned
+        array is READ-ONLY and owned by the store."""
+        if self._single is not None:
+            t0 = time.perf_counter()
+            out = self._single.get()
+            self.last_pull_ms[0] = (time.perf_counter() - t0) * 1e3
+            return out
+        if not self._cache_enabled:
+            return self._gather_full()
+        have = list(self._steps) if self._front is not None else [-1] * self._layout.num_shards
+        buf = np.empty(self._layout.num_elems, np.float32)
+
+        def one(i: int):
+            t0 = time.perf_counter()
+            s, out = self._group.clients[i].call(
+                ps_service._PSTORE_GET_IF_NEWER, self._name, have[i],
+                out=buf[self._layout.slice(i)],
+            )
+            self.last_pull_ms[i] = (time.perf_counter() - t0) * 1e3
+            return s, out.size
+
+        res = self._pool.run({i: (lambda i=i: one(i)) for i in self._active})
+        statuses = {i: s for i, (s, _) in res.items()}
+        if any(s == -2 for s in statuses.values()):
+            # Pre-v2 server on some shard: fall back to full pulls for the
+            # life of this store rather than failing the caller.
+            self._cache_enabled = False
+            return self._gather_full()
+        for s in statuses.values():
+            ps_service._check(s, "pstore_get_if_newer")
+        if any(s < 0 for s in statuses.values()):
+            # Some shard never published (PS restart before the chief's
+            # reseed landed): status-only overall, nothing cached —
+            # callers gate on step < 0 and poll, per the await contract.
+            return min(statuses.values()), np.empty((0,), np.float32)
+        changed = {i for i, (s, size) in res.items() if size != 0}
+        stale = {
+            i for i in self._active
+            if i not in changed and statuses[i] != have[i]
+        }
+        if stale:
+            # A shard's step moved without a payload (republished at a
+            # lower step — a reseed this client never saw as a reconnect):
+            # distrust that mirror and refetch the shard in full.
+            def refetch(i: int):
+                s, _ = self._group.clients[i].call(
+                    ps_service._PSTORE_GET, self._name,
+                    out=buf[self._layout.slice(i)],
+                )
+                return ps_service._check(s, "pstore_get")
+
+            rres = self._pool.run({i: (lambda i=i: refetch(i)) for i in stale})
+            statuses.update(rres)
+            changed |= stale
+        if not changed:
+            # All shards unchanged: N header-sized round trips, zero data
+            # movement — the sharded analog of the r7 if-newer fast path.
+            return min(statuses.values()), self._front
+        if len(changed) < len(self._active) and self._front is not None:
+            # Mixed: the unchanged shards' bytes live in the previous
+            # buffer — copy them across (rare; see class docstring).
+            for i in self._active:
+                if i not in changed:
+                    buf[self._layout.slice(i)] = self._front[self._layout.slice(i)]
+        for i, s in statuses.items():
+            self._steps[i] = int(s)
+        self._front = buf
+        return min(statuses.values()), buf
+
+
+class ShardedAccumulator:
+    """Sync-mode gradient aggregation over per-shard accumulators:
+    ``apply`` scatters the flat gradient's slices concurrently (dedup-
+    tagged per shard connection when the client carries a ``worker_tag``);
+    ``take`` gathers the per-shard averages back into one flat vector.
+
+    A ``take`` that times out on SOME shards retains the shards that DID
+    answer (``_partial``) and re-takes only the missing ones on the next
+    call — the drain is at-most-once, so retrying an already-drained
+    shard would lose its average and deadlock the chief's stall-repush
+    loop.  API-compatible with :class:`ps_service.RemoteAccumulator`;
+    N == 1 is a direct pass-through."""
+
+    def __init__(self, group: ShardedPSClients, name: str, layout: ShardLayout):
+        if layout.num_shards != group.num_shards:
+            raise ValueError(
+                f"{layout} does not match {group.num_shards} shard clients"
+            )
+        self._group, self._name, self._layout = group, name, layout
+        self._pool = _pool_for(group, "acc")
+        self.last_push_ms = [0.0] * layout.num_shards
+        self._active = [i for i in range(layout.num_shards) if layout.sizes[i] > 0]
+        self._accs = {
+            i: ps_service.RemoteAccumulator(
+                group.clients[i], name, layout.sizes[i]
+            )
+            for i in self._active
+        }
+        self._partial: dict[int, np.ndarray] = {}
+
+    def apply(self, local_step: int, grad: np.ndarray) -> bool:
+        grad = np.ascontiguousarray(grad, np.float32).reshape(-1)
+        if self._layout.num_shards == 1:
+            t0 = time.perf_counter()
+            r = self._accs[0].apply(local_step, grad)
+            self.last_push_ms[0] = (time.perf_counter() - t0) * 1e3
+            return r
+
+        def one(i: int):
+            t0 = time.perf_counter()
+            r = self._accs[i].apply(local_step, grad[self._layout.slice(i)])
+            self.last_push_ms[i] = (time.perf_counter() - t0) * 1e3
+            return r
+
+        res = self._pool.run({i: (lambda i=i: one(i)) for i in self._active})
+        # Per-shard staleness gating can briefly disagree (the documented
+        # tear); report "counted" only when every shard accepted.
+        return all(res.values())
+
+    def take(self, num_required: int, timeout_s: float | None = None):
+        """Blocking sharded average; None when cancelled, ``TIMED_OUT``
+        when ``timeout_s`` expires on any still-missing shard (already-
+        gathered shards are retained for the next call)."""
+        if self._layout.num_shards == 1:
+            return self._accs[0].take(num_required, timeout_s)
+        pending = [i for i in self._active if i not in self._partial]
+        res = self._pool.run(
+            {i: (lambda i=i: self._accs[i].take(num_required, timeout_s))
+             for i in pending}
+        )
+        cancelled = False
+        for i, r in res.items():
+            if r is None:
+                cancelled = True
+            elif r is not ps_service.TIMED_OUT:
+                self._partial[i] = r
+        if cancelled:
+            self._partial.clear()
+            return None
+        if len(self._partial) < len(self._active):
+            return ps_service.TIMED_OUT
+        out = np.empty(self._layout.num_elems, np.float32)
+        for i in self._active:
+            out[self._layout.slice(i)] = self._partial[i]
+        self._partial.clear()
+        return out
+
+    def set_global_step(self, step: int) -> None:
+        if self._layout.num_shards == 1:
+            self._accs[0].set_global_step(step)
+            return
+        self._pool.run(
+            {i: (lambda i=i: self._accs[i].set_global_step(step))
+             for i in self._active}
+        )
+
+    def set_global_step_shard(self, i: int, step: int) -> None:
+        """Restore ONE (restarted) shard accumulator's global step — the
+        chief's targeted reseed."""
+        if i in self._accs:
+            self._accs[i].set_global_step(step)
+
+    @property
+    def dropped(self) -> int:
+        return sum(a.dropped for a in self._accs.values())
+
+    @property
+    def deduped(self) -> int:
+        return sum(a.deduped for a in self._accs.values())
+
+    def cancel(self) -> None:
+        self._group.cancel_all()
+
+
+class ShardedGradientQueue:
+    """Async-mode gradient transport over per-shard queues: ``push``
+    scatters the flat gradient's slices concurrently, ``pop`` gathers one
+    slice per shard back into a flat vector (head-of-queue per shard —
+    see the module docstring's note on cross-shard mixing).  Timed-out
+    pops retain the shards that answered, like :class:`ShardedAccumulator`.
+    API-compatible with :class:`ps_service.RemoteGradientQueue`; N == 1 is
+    a direct pass-through."""
+
+    def __init__(
+        self, group: ShardedPSClients, name: str, layout: ShardLayout,
+        capacity: int = 16,
+    ):
+        if layout.num_shards != group.num_shards:
+            raise ValueError(
+                f"{layout} does not match {group.num_shards} shard clients"
+            )
+        self._group, self._name, self._layout = group, name, layout
+        self._pool = _pool_for(group, "gq")
+        self.last_push_ms = [0.0] * layout.num_shards
+        self._active = [i for i in range(layout.num_shards) if layout.sizes[i] > 0]
+        self._gqs = {
+            i: ps_service.RemoteGradientQueue(
+                group.clients[i], name, layout.sizes[i], capacity
+            )
+            for i in self._active
+        }
+        self._partial: dict[int, tuple[int, np.ndarray]] = {}
+
+    def push(self, local_step: int, grad: np.ndarray) -> bool | None:
+        grad = np.ascontiguousarray(grad, np.float32).reshape(-1)
+        if self._layout.num_shards == 1:
+            t0 = time.perf_counter()
+            r = self._gqs[0].push(local_step, grad)
+            self.last_push_ms[0] = (time.perf_counter() - t0) * 1e3
+            return r
+
+        def one(i: int):
+            t0 = time.perf_counter()
+            r = self._gqs[i].push(local_step, grad[self._layout.slice(i)])
+            self.last_push_ms[i] = (time.perf_counter() - t0) * 1e3
+            return r
+
+        res = self._pool.run({i: (lambda i=i: one(i)) for i in self._active})
+        if any(r is None for r in res.values()):
+            return None  # cancelled: the chief is done or failed
+        return all(bool(r) for r in res.values())
+
+    def pop(self, timeout_s: float | None = None):
+        """Blocking sharded pop; ``(local_step, flat)``, None when
+        cancelled+drained, ``TIMED_OUT`` when ``timeout_s`` expires on any
+        still-missing shard (gathered shards retained)."""
+        if self._layout.num_shards == 1:
+            return self._gqs[0].pop(timeout_s)
+        pending = [i for i in self._active if i not in self._partial]
+        res = self._pool.run(
+            {i: (lambda i=i: self._gqs[i].pop(timeout_s)) for i in pending}
+        )
+        cancelled = False
+        for i, r in res.items():
+            if r is None:
+                cancelled = True
+            elif r is not ps_service.TIMED_OUT:
+                self._partial[i] = r
+        if cancelled:
+            self._partial.clear()
+            return None
+        if len(self._partial) < len(self._active):
+            return ps_service.TIMED_OUT
+        out = np.empty(self._layout.num_elems, np.float32)
+        for i in self._active:
+            out[self._layout.slice(i)] = self._partial[i][1]
+        # The first active shard's local_step labels the assembled gradient
+        # (the chief only uses it for logging/staleness bookkeeping; under
+        # mixing the per-shard steps can legitimately differ).
+        step = self._partial[self._active[0]][0]
+        self._partial.clear()
+        return step, out
+
+    def set_min_step(self, step: int) -> None:
+        if self._layout.num_shards == 1:
+            self._gqs[0].set_min_step(step)
+            return
+        self._pool.run(
+            {i: (lambda i=i: self._gqs[i].set_min_step(step))
+             for i in self._active}
+        )
+
+    def set_min_step_shard(self, i: int, step: int) -> None:
+        """Restore ONE (restarted) shard queue's staleness floor — the
+        chief's targeted reseed."""
+        if i in self._gqs:
+            self._gqs[i].set_min_step(step)
+
+    @property
+    def dropped(self) -> int:
+        return sum(g.dropped for g in self._gqs.values())
+
+    @property
+    def deduped(self) -> int:
+        return sum(g.deduped for g in self._gqs.values())
+
+    def cancel(self) -> None:
+        self._group.cancel_all()

@@ -44,6 +44,38 @@ WIRE_DTYPES = {"f32": 0, "bf16": 1}
 #: the same code point so one negotiation routine serves both wires).
 HELLO_OP = 26
 
+# Sharded PS (r9): HELLO's b operand carries the SHARD IDENTITY the client
+# expects of the server it dialed — dtype code in bits 0..7, expected shard
+# id in bits 8..31, expected shard count in bits 32..55.  A zero count
+# means "no expectation" (every pre-r9 client — their b is just the dtype
+# code, < 256).  The server answers ``-5 - packed(own identity)`` on a
+# mismatch, so a mis-wired dial fails loudly at connect, naming what was
+# actually reached, instead of silently serving the wrong slice of the
+# parameter vector.
+HELLO_SHARD_ID_SHIFT = 8
+HELLO_SHARD_COUNT_SHIFT = 32
+HELLO_SHARD_MASK = 0xFFFFFF
+HELLO_SHARD_MISMATCH = -5
+
+
+def pack_hello_b(dtype_code: int, shard_id: int = 0, shard_count: int = 0) -> int:
+    """HELLO's b operand: dtype + (optional) expected shard identity."""
+    return (
+        dtype_code
+        | ((shard_id & HELLO_SHARD_MASK) << HELLO_SHARD_ID_SHIFT)
+        | ((shard_count & HELLO_SHARD_MASK) << HELLO_SHARD_COUNT_SHIFT)
+    )
+
+
+def unpack_shard_mismatch(status: int) -> tuple[int, int]:
+    """Decode a ``-5 - packed`` HELLO answer into the SERVER's
+    (shard_id, shard_count)."""
+    packed = -(status - HELLO_SHARD_MISMATCH)
+    return (
+        (packed >> HELLO_SHARD_ID_SHIFT) & HELLO_SHARD_MASK,
+        (packed >> HELLO_SHARD_COUNT_SHIFT) & HELLO_SHARD_MASK,
+    )
+
 #: Request tail after the name bytes: a, b, payload_len.
 REQ_TAIL = struct.Struct("<qqI")
 

@@ -289,15 +289,19 @@ def run_ps_cluster_task(
 
     - ``ps``:     hosts the C++ state service at ``--ps_hosts[task_index]``
                   until the chief signals shutdown (``server.join()`` role).
-                  The coordination state lives on entry 0; further PS tasks
-                  are accepted for launch-script parity but stay idle (the
-                  MODEL variables need no PS spreading — they live in mesh
-                  HBM; only coordination state crosses processes).
+                  Task i owns SHARD i of the flat parameter vector (r9,
+                  ``parallel/ps_shard.ShardLayout`` over ``--ps_shards``
+                  servers; -1 = one per host — the reference's
+                  ``replica_device_setter`` spreading): param pulls,
+                  publishes and gradient pushes scatter/gather over every
+                  shard in parallel, while step tokens and the shutdown
+                  signal stay on shard 0 (the coordinator).
     - ``chief``:  aggregation/apply/publish loop (``RemotePSChief``).
                   Topology is DETERMINISTIC, not probed: with
-                  ``--ps_tasks=0`` the chief hosts the service in-process
-                  (3-process minimum launch); otherwise a dedicated PS task
-                  is expected at ``ps_hosts[0]`` and waited for (120 s).
+                  ``--ps_tasks=0`` the chief hosts every shard server
+                  in-process (3-process minimum launch); otherwise
+                  dedicated PS tasks are expected at ``ps_hosts[0:N]`` and
+                  waited for (120 s each).
     - ``worker``: gradient computation against the published snapshots
                   (``remote_worker_loop``), data-sharded by ``task_index``.
     - ``data_service`` (r8): dedicated input worker — serves decoded,
@@ -358,9 +362,14 @@ def run_ps_cluster_task(
         print(f"DSVC_DONE port={bound}")
         return None
 
-    entries = FLAGS.ps_hosts.split(",")
-    host, port_s = entries[0].rsplit(":", 1)
-    port = int(port_s)
+    from ..utils.flags import ps_shard_topology
+
+    entries, n_shards = ps_shard_topology(FLAGS)
+    # The sharded-store topology (r9): shard i's server is entries[i];
+    # every client scatters/gathers over all of them in parallel.  Shard 0
+    # doubles as the coordinator (tokens, shutdown signal).
+    shard_addrs = entries[:n_shards]
+    host, port = shard_addrs[0]
     acfg = _ps_cfg(FLAGS, mode, n_workers)
     if acfg.fixed_interleave:
         # Real processes free-run — there is no scheduler to fix their
@@ -380,20 +389,36 @@ def run_ps_cluster_task(
                 "--job_name=ps contradicts --ps_tasks=0 (chief hosts the "
                 "service); launch without the PS task or drop --ps_tasks=0"
             )
-        my_host, my_port = entries[
-            min(FLAGS.task_index, len(entries) - 1)
-        ].rsplit(":", 1)
+        tid = min(FLAGS.task_index, len(entries) - 1)
+        my_host, my_port = entries[tid]
         listen_all = _resolve_listen_all(FLAGS, my_host)
         # Host in a supervised CHILD (--ps_restarts): a PS crash (injected
         # or organic) is healed by a fresh incarnation on the same port,
         # which the chief/worker clients reconnect into — partial recovery
-        # instead of whole-job crash-restart.
+        # instead of whole-job crash-restart.  With sharding, ONE shard's
+        # crash is healed this way while the other shards serve on.
         rc = _supervised_reexec(FLAGS, child_env_flag="DTX_PS_SUPERVISED")
         if rc is not None:
             if rc != 0:
                 raise SystemExit(rc)
             return None
-        bound = async_ps.host_ps_task(int(my_port), loopback_only=not listen_all)
+        if tid >= n_shards:
+            # Launch-script parity: extra PS tasks beyond the shard count
+            # are accepted but own no slice — host an unsharded-identity
+            # service nothing will dial.
+            log.warning(
+                "PS task %d exceeds --ps_shards=%d: no shard assigned "
+                "(idle; shrink --ps_hosts or raise --ps_shards)",
+                tid, n_shards,
+            )
+            bound = async_ps.host_ps_task(
+                int(my_port), loopback_only=not listen_all
+            )
+        else:
+            bound = async_ps.host_ps_task(
+                int(my_port), loopback_only=not listen_all,
+                shard_id=tid, shard_count=n_shards,
+            )
         print(f"PS_DONE port={bound}")
         return None
 
@@ -402,16 +427,20 @@ def run_ps_cluster_task(
         params = init_fn(jax.random.key(FLAGS.seed))
         if isinstance(params, tuple):
             params, model_state = params
-        if not chief_hosts_service and not _probe_ps(host, port, 120.0):
-            raise ConnectionError(
-                f"no PS task answered at {host}:{port} after 120 s "
-                "(launch the --job_name=ps process first, or pass "
-                "--ps_tasks=0 to host the service in the chief)"
-            )
+        if not chief_hosts_service:
+            for sh, sp in shard_addrs:
+                if not _probe_ps(sh, sp, 120.0):
+                    raise ConnectionError(
+                        f"no PS task answered at {sh}:{sp} after 120 s "
+                        "(launch every --job_name=ps shard process first, "
+                        "or pass --ps_tasks=0 to host the service in the "
+                        "chief)"
+                    )
         log.info(
-            "PS cluster chief: mode=%s %d workers, service %s:%d (%s)",
-            mode, n_workers, host, port,
-            "hosted in-process" if chief_hosts_service else "external PS task",
+            "PS cluster chief: mode=%s %d workers, %d shard(s) at %s (%s)",
+            mode, n_workers, n_shards,
+            ",".join(f"{h}:{p}" for h, p in shard_addrs),
+            "hosted in-process" if chief_hosts_service else "external PS tasks",
         )
         # Scrapable platform record: tools/ps_tpu_smoke.py asserts the chief
         # genuinely ran the accelerator plugin (not a silent CPU fallback).
@@ -421,11 +450,17 @@ def run_ps_cluster_task(
             model_state=model_state,
             rng=jax.random.key(FLAGS.seed),
             **(
-                # Chief-hosted service: same explicit-exposure contract as
-                # the dedicated PS task (code-review r5).
-                {"port": port, "listen_all": _resolve_listen_all(FLAGS, host)}
+                # Chief-hosted service (one in-process server per shard):
+                # same explicit-exposure contract as the dedicated PS task
+                # (code-review r5), checked per listed host.
+                {
+                    "ports": [p for _, p in shard_addrs],
+                    "listen_all": any(
+                        _resolve_listen_all(FLAGS, h) for h, _ in shard_addrs
+                    ),
+                }
                 if chief_hosts_service
-                else {"ps_addr": (host, port)}
+                else {"ps_addrs": shard_addrs}
             ),
         )
         t0 = time.perf_counter()
@@ -454,8 +489,9 @@ def run_ps_cluster_task(
     # job == "worker"
     faults.arm_process_faults()
     wid = FLAGS.task_index
-    if not _probe_ps(host, port, 120.0):
-        raise ConnectionError(f"no PS service at {host}:{port} after 120 s")
+    for sh, sp in shard_addrs:
+        if not _probe_ps(sh, sp, 120.0):
+            raise ConnectionError(f"no PS service at {sh}:{sp} after 120 s")
 
     def struct_init(rng):
         p = init_fn(rng)
@@ -469,6 +505,12 @@ def run_ps_cluster_task(
         batches=iter(batches_for_worker(wid, local_bs, n_workers)),
         model_state=model_state,
         rng=jax.random.key(FLAGS.seed),
+        addrs=shard_addrs,
+        # Per-shard pull/push wall-time scalars (shard-imbalance signal).
+        metrics_dir=(
+            os.path.join(FLAGS.log_dir, f"worker{wid}") if FLAGS.log_dir else None
+        ),
+        metrics_every=max(1, getattr(FLAGS, "log_every_steps", 20) or 20),
     )
     print(f"WORKER_DONE task={wid} contributed={n}")
     return None

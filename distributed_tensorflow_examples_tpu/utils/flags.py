@@ -143,6 +143,17 @@ def define_legacy_cluster_flags():
     )
     _define(
         "integer",
+        "ps_shards",
+        -1,
+        "Sharded parameter store (r9): partition the flat param/gradient "
+        "vector over this many PS servers (contiguous ShardLayout slices; "
+        "pulls/pushes scatter/gather in parallel, one connection per "
+        "shard).  -1 = one shard per --ps_hosts entry (the reference's "
+        "replica_device_setter convention); must not exceed the host "
+        "count.  1 = the single-server r7 wire, byte-identical.",
+    )
+    _define(
+        "integer",
         "ps_restarts",
         3,
         "Cross-process PS launch: run the --job_name=ps task under "
@@ -216,6 +227,45 @@ def is_cross_process_ps(FLAGS) -> bool:
     )
 
 
+def parse_hostports(spec: str, flag: str = "--ps_hosts") -> list[tuple[str, int]]:
+    """Validate a comma-separated ``host:port`` list into addr tuples.
+    Malformed entries (empty, missing/non-numeric port, duplicates) fail
+    the launch loudly — a typo'd shard list must never silently collapse
+    onto fewer servers than the operator asked for."""
+    addrs: list[tuple[str, int]] = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        host, sep, port_s = entry.rpartition(":")
+        if not entry or not sep or not host or not port_s.isdigit():
+            raise ValueError(
+                f"{flag} entry {entry!r} is not host:port (full list: {spec!r})"
+            )
+        addr = (host, int(port_s))
+        if addr in addrs:
+            raise ValueError(f"{flag} lists {entry!r} twice ({spec!r})")
+        addrs.append(addr)
+    return addrs
+
+
+def ps_shard_topology(FLAGS) -> tuple[list[tuple[str, int]], int]:
+    """The validated PS shard topology: the FULL ``--ps_hosts`` address
+    list plus the resolved shard count (``--ps_shards``; -1 = one shard
+    per host).  Shard i's server is ``addrs[i]`` — the ONE place the
+    host-order/shard-id correspondence is defined (r9 fix: the pre-r9
+    path warned and silently used ``ps_hosts[0]`` only)."""
+    addrs = parse_hostports(FLAGS.ps_hosts)
+    raw = getattr(FLAGS, "ps_shards", -1)
+    n = -1 if raw is None else int(raw)
+    if n < 0:
+        n = len(addrs)
+    if n == 0 or n > len(addrs):
+        raise ValueError(
+            f"--ps_shards={n} invalid for {len(addrs)} --ps_hosts entries "
+            f"(need 1..{len(addrs)}, or -1 for one shard per host)"
+        )
+    return addrs, n
+
+
 def resolve_legacy_cluster(FLAGS) -> dict:
     """Interpret legacy cluster flags against the mesh world; returns info for
     the example to log.  A process launched as a PS task has no role in SPMD:
@@ -230,16 +280,27 @@ def resolve_legacy_cluster(FLAGS) -> dict:
         jax.config.update("jax_platforms", FLAGS.platform)
     info = {}
     cross = is_cross_process_ps(FLAGS)
+    # Any PS-emulation mode (cross-process OR the single-process thread
+    # emulation): --ps_hosts is meaningful topology, never "obsolete".
+    emulation = cross or (
+        getattr(FLAGS, "ps_emulation", False)
+        or not getattr(FLAGS, "sync_replicas", True)
+    )
     if getattr(FLAGS, "ps_hosts", ""):
-        info["ps_hosts"] = FLAGS.ps_hosts.split(",")
-        if cross:
+        if emulation:
+            # Validate and surface the FULL list (r9 fix: this path used
+            # to log entry [0] only, hiding a sharded topology's servers).
+            addrs, n_shards = ps_shard_topology(FLAGS)
+            info["ps_hosts"] = [f"{h}:{p}" for h, p in addrs]
+            info["ps_shards"] = n_shards
             log.info(
-                "--ps_hosts given with cross-process PS emulation: the "
-                "native state service (gradients/tokens/param snapshots) "
-                "serves at %s.",
-                info["ps_hosts"][0],
+                "--ps_hosts given with PS emulation: %d host(s), %d "
+                "shard(s) — the native state service serves shard i at "
+                "entry i: %s.",
+                len(addrs), n_shards, ",".join(info["ps_hosts"][:n_shards]),
             )
         else:
+            info["ps_hosts"] = FLAGS.ps_hosts.split(",")
             log.warning(
                 "--ps_hosts given: parameter servers are obsolete on TPU — "
                 "variables are mesh-sharded in HBM (replica_device_setter -> "

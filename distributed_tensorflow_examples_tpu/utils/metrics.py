@@ -13,6 +13,18 @@ import os
 import time
 
 
+def shard_scalars(kind: str, ms_per_shard) -> dict[str, float]:
+    """Per-shard PS transport wall times as TensorBoard scalar tags —
+    ``ps/<kind>_ms_shard<i>`` (r9 satellite).  One naming convention for
+    every emitter, so dashboards can glob ``ps/pull_ms_shard*`` and a hot
+    or slow shard server shows up as one series running away from its
+    siblings."""
+    return {
+        f"ps/{kind}_ms_shard{i}": float(ms)
+        for i, ms in enumerate(ms_per_shard)
+    }
+
+
 class MetricsWriter:
     def __init__(self, log_dir: str | None, *, tensorboard: bool = True):
         self.log_dir = log_dir

@@ -65,8 +65,9 @@ def _eval_loss(params) -> float:
 
 
 def _run_socket_training(
-    *, steps=40, mode="async", plan="", ps_addr=None, n_workers=2,
-    reconnect_deadline_s=60.0, join_timeout=180.0, wire_dtype="f32",
+    *, steps=40, mode="async", plan="", ps_addr=None, ps_addrs=None,
+    n_workers=2, shards=1, reconnect_deadline_s=60.0, join_timeout=180.0,
+    wire_dtype="f32",
 ):
     """One async-PS training run over the socket transport, chief + worker
     threads in THIS process (the thread/2-process fault path): cheap enough
@@ -74,7 +75,9 @@ def _run_socket_training(
     drops/delays/PS restarts exercise the actual recovery code.  Async runs
     carry the r7 fast path by default (prefetch double-buffering + the
     versioned param-pull cache); ``wire_dtype`` additionally switches the
-    negotiated payload encoding."""
+    negotiated payload encoding.  ``shards`` > 1 hosts that many in-process
+    shard servers (r9 scatter/gather); ``ps_addrs`` connects to external
+    shard servers instead."""
     os.environ["DTX_FAULT_PLAN"] = plan
     try:
         cfg = async_ps.AsyncPSConfig(
@@ -93,6 +96,13 @@ def _run_socket_training(
             models.mlp.init(CFG, jax.random.key(0)),
             rng=jax.random.key(0),
             ps_addr=ps_addr,
+            ps_addrs=ps_addrs,
+            ports=[0] * shards if shards > 1 else None,
+        )
+        addrs = (
+            ps_addrs
+            if ps_addrs is not None
+            else [("127.0.0.1", p) for p in chief.ports]
         )
         workers = [
             threading.Thread(
@@ -104,6 +114,7 @@ def _run_socket_training(
                     init_fn=lambda rng: models.mlp.init(CFG, rng),
                     batches=_blob_batches(w + 1),
                     rng=jax.random.key(0),
+                    addrs=addrs,
                 ),
                 daemon=True,
             )
@@ -295,7 +306,7 @@ from types import SimpleNamespace
 from distributed_tensorflow_examples_tpu.train import ps_experiment
 
 FLAGS = SimpleNamespace(
-    job_name="ps", task_index=0, ps_hosts="127.0.0.1:{port}",
+    job_name="ps", task_index={task_index}, ps_hosts={ps_hosts!r},
     worker_hosts="a:1,b:1", ps_tasks=1, ps_listen_all=False, ps_restarts=2,
     batch_size=8, train_steps=60, log_dir="", checkpoint_every_steps=50,
     replicas_to_aggregate=0, max_staleness=0, deterministic=False, seed=0,
@@ -308,6 +319,18 @@ ps_experiment.run_ps_cluster_task(
 """
 
 
+def _free_ports(n: int) -> list[int]:
+    import socket as _socket
+
+    socks = [_socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
 def test_ps_kill_mid_run_heals_via_supervised_restart(tmp_path, caplog):
     """The tentpole acceptance scenario: a dedicated PS task is KILLED
     mid-run by the fault plan (``die:after_reqs`` — deterministic in the
@@ -317,15 +340,13 @@ def test_ps_kill_mid_run_heals_via_supervised_restart(tmp_path, caplog):
     reaches its step target and the fault-free loss — partial recovery, not
     whole-job restart."""
     caplog.set_level("INFO", logger="dtx.faults")
-    import socket as _socket
-
-    s = _socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-
+    (port,) = _free_ports(1)
     script = tmp_path / "ps_task.py"
-    script.write_text(_PS_TASK_SCRIPT.format(root=ROOT, port=port))
+    script.write_text(
+        _PS_TASK_SCRIPT.format(
+            root=ROOT, task_index=0, ps_hosts=f"127.0.0.1:{port}"
+        )
+    )
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -389,6 +410,129 @@ def test_ps_kill_mid_run_heals_via_supervised_restart(tmp_path, caplog):
     assert "event=supervisor_healed_plan" in ps_log, ps_log[-2000:]
     assert "PS_DONE" in ps_log, ps_log[-2000:]
     assert ps_proc.returncode == 0, ps_log[-2000:]
+
+
+def test_single_shard_drop_conn_heals(caplog):
+    """r9 fault matrix: connection drops targeted at ONE SHARD's client
+    connections only (role suffix ``_s<i>`` — the direct and prefetch
+    clients of shard 1) in a 2-shard run.  That shard's clients reconnect
+    and replay; the other shard's connections never drop; the run reaches
+    its step target at the fault-free loss with zero duplicate
+    applications."""
+    caplog.set_level("INFO", logger="dtx.faults")
+    plan = (
+        "drop_conn:role=worker0_s1,op=6;drop_conn:role=worker1_s1,op=9;"
+        "drop_conn:role=worker0_pf_s1,op=4"
+    )
+    chief = _run_socket_training(steps=40, plan=plan, shards=2)
+    assert chief.global_step == 40
+    assert chief.total_deduped == 0
+    assert _eval_loss(chief.params) < 2.0
+    events = [
+        r.getMessage() for r in caplog.records if "dtx.faults" in r.getMessage()
+    ]
+    # The faults really hit shard 1's clients, and those clients really
+    # reconnected; shard 0's plain worker roles never dropped.
+    assert any("role=worker0_s1" in m and "inject_drop_conn" in m for m in events), events
+    assert any("_s1" in m and "event=reconnected" in m for m in events), events
+    assert not any(
+        "inject_drop_conn" in m and "role=worker0 " in m for m in events
+    ), events
+
+
+def test_one_shard_of_two_killed_heals_via_supervised_restart(tmp_path, caplog):
+    """r9 acceptance (the sharded tentpole scenario): a 2-shard, 2-worker
+    async MNIST-blob run with BOTH shard servers as dedicated supervised
+    PS tasks; shard 1's task is KILLED mid-run by its fault plan, its
+    supervisor restarts it, the chief reseeds ONLY that shard (republish
+    slice + counters — shard 0 is never reseeded, so the workers' shard-0
+    versioned caches stay valid), and training heals to the step target
+    and the fault-free loss."""
+    caplog.set_level("INFO", logger="dtx.faults")
+    ports = _free_ports(2)
+    ps_hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+    env_base = dict(os.environ)
+    env_base["JAX_PLATFORMS"] = "cpu"
+    env_base.pop("PALLAS_AXON_POOL_IPS", None)
+    procs, logs = [], []
+    try:
+        for tid in (0, 1):
+            script = tmp_path / f"ps_task_{tid}.py"
+            script.write_text(
+                _PS_TASK_SCRIPT.format(
+                    root=ROOT, task_index=tid, ps_hosts=ps_hosts
+                )
+            )
+            env = dict(env_base)
+            # Only shard 1 dies (role ps1), once it has served 60 requests
+            # — mid-run: each shard sees roughly half the single-server
+            # request stream of the unsharded kill test (tokens stay on
+            # shard 0), while startup polling stays well under the
+            # trigger.
+            env["DTX_FAULT_PLAN"] = "die:role=ps1,after_reqs=60"
+            logf = open(tmp_path / f"ps_task_{tid}.log", "w")
+            logs.append(logf)
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, str(script)],
+                    stdout=logf, stderr=subprocess.STDOUT, env=env, cwd=ROOT,
+                )
+            )
+        # Wait for both shard servers to answer.
+        for port in ports:
+            deadline = time.time() + 120
+            up = False
+            while time.time() < deadline:
+                try:
+                    c = ps_service.PSClient("127.0.0.1", port, timeout_s=2.0)
+                    c.ping()
+                    c.close()
+                    up = True
+                    break
+                except OSError:
+                    time.sleep(0.2)
+            assert up, f"shard task at port {port} never came up"
+
+        chief = _run_socket_training(
+            steps=40,
+            ps_addrs=[("127.0.0.1", p) for p in ports],
+            reconnect_deadline_s=90.0,
+            join_timeout=240.0,
+        )
+        assert chief.global_step == 40
+        assert chief.total_deduped != -1 and chief.total_dropped != -1
+        assert _eval_loss(chief.params) < 2.0
+        events = [
+            r.getMessage() for r in caplog.records if "dtx.faults" in r.getMessage()
+        ]
+        # The chief crossed shard 1's new incarnation and reseeded THAT
+        # shard individually; shard 0 was never reseeded.
+        assert any(
+            "event=chief_reseed" in m and "shard=1" in m for m in events
+        ), events
+        assert not any(
+            "event=chief_reseed" in m and "shard=0" in m for m in events
+        ), events
+
+        for p in procs:
+            p.wait(timeout=60)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        for f in logs:
+            f.close()
+    log1 = (tmp_path / "ps_task_1.log").read_text()
+    log0 = (tmp_path / "ps_task_0.log").read_text()
+    # Shard 1: injected death fired, supervisor healed, second incarnation
+    # served to completion.  Shard 0: no death, served straight through.
+    assert "event=inject_die" in log1, log1[-2000:]
+    assert "event=supervisor_healed_plan" in log1, log1[-2000:]
+    assert "PS_DONE" in log1, log1[-2000:]
+    assert "event=inject_die" not in log0, log0[-2000:]
+    assert "PS_DONE" in log0, log0[-2000:]
+    assert procs[0].returncode == 0 and procs[1].returncode == 0
 
 
 def _dsvc_splits(n=8, rows=16):

@@ -1,0 +1,306 @@
+"""Sharded parameter store (r9 tentpole): ShardLayout determinism and
+cover, scatter/gather correctness against the real socket servers,
+byte-identity of the N=1 path with the r7 single-shard wire, the HELLO
+shard handshake, and the per-shard gather machinery (partial-retention
+takes/pops, per-shard cache invalidation).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_examples_tpu.parallel import (
+    ps_service,
+    ps_shard,
+    wire,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _stop_servers():
+    yield
+    ps_service.stop_server()
+
+
+def _servers(n: int) -> list[tuple[str, int]]:
+    return [
+        ("127.0.0.1", ps_service.start_server(0, shard_id=i, shard_count=n))
+        for i in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# ShardLayout
+# ---------------------------------------------------------------------------
+
+
+def test_shard_layout_exact_cover_awkward_n():
+    """Disjoint exact cover of [0, num_elems) for every awkward (size, N):
+    N=1, N > num_elems, prime sizes, prime N."""
+    for num_elems, n in [
+        (10, 1), (10, 3), (7, 7), (5, 8), (1, 4), (0, 3),
+        (1_000_003, 4), (97, 13), (128, 128),
+    ]:
+        lo = ps_shard.ShardLayout(num_elems, n)
+        assert len(lo.sizes) == n
+        assert sum(lo.sizes) == num_elems
+        assert lo.offsets[0] == 0 and lo.offsets[-1] == num_elems
+        assert all(
+            lo.offsets[i + 1] - lo.offsets[i] == lo.sizes[i] for i in range(n)
+        )
+        # Contiguous slices tile the vector exactly once.
+        cover = np.zeros(num_elems, np.int32)
+        for i in range(n):
+            cover[lo.slice(i)] += 1
+        assert (cover == 1).all()
+        # Balanced: sizes differ by at most one element.
+        assert max(lo.sizes) - min(lo.sizes) <= 1
+    with pytest.raises(ValueError):
+        ps_shard.ShardLayout(10, 0)
+    lo = ps_shard.ShardLayout(10, 3)
+    assert lo.shard_of(0) == 0 and lo.shard_of(9) == 2
+
+
+def test_shard_layout_deterministic_across_processes():
+    """The layout is a pure function of (num_elems, num_shards): a fresh
+    interpreter derives byte-identical sizes/offsets — the property that
+    makes sharded publishes/checkpoints stable across restarts and
+    heterogeneous launch orders (worker count never enters)."""
+    cases = [(1_000_003, 4), (97, 13), (64, 2)]
+    prog = (
+        "import json, sys\n"
+        f"sys.path.insert(0, {ROOT!r})\n"
+        "from distributed_tensorflow_examples_tpu.parallel import ps_shard\n"
+        f"cases = {cases!r}\n"
+        "print(json.dumps([\n"
+        "    [list(ps_shard.ShardLayout(e, n).sizes),\n"
+        "     list(ps_shard.ShardLayout(e, n).offsets)] for e, n in cases\n"
+        "]))\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", prog], capture_output=True, text=True, check=True
+    )
+    remote = json.loads(out.stdout)
+    for (e, n), (sizes, offsets) in zip(cases, remote):
+        lo = ps_shard.ShardLayout(e, n)
+        assert list(lo.sizes) == sizes
+        assert list(lo.offsets) == offsets
+
+
+# ---------------------------------------------------------------------------
+# HELLO shard handshake
+# ---------------------------------------------------------------------------
+
+
+def test_hello_shard_mismatch_fails_loudly():
+    """A mis-wired dial — the client expecting a different shard than the
+    server owns — must fail the CONNECT with a diagnostic naming both
+    identities, never silently serve the wrong slice."""
+    addrs = _servers(2)
+    with pytest.raises(ps_service.PSError, match=r"shard 0/2.*expected shard 1/2"):
+        ps_service.PSClient(*addrs[0], timeout_s=5.0, expect_shard=(1, 2))
+    with pytest.raises(ps_service.PSError, match="expected shard 0/3"):
+        ps_service.PSClient(*addrs[0], timeout_s=5.0, expect_shard=(0, 3))
+    # The right expectation connects; a legacy client (no expectation)
+    # still connects to a shard server (b's high bits are zero).
+    c = ps_service.PSClient(*addrs[1], timeout_s=5.0, expect_shard=(1, 2))
+    c.ping()
+    c.close()
+    legacy = ps_service.PSClient(*addrs[0], timeout_s=5.0)
+    legacy.ping()
+    legacy.close()
+    # Packing round trip.
+    b = wire.pack_hello_b(1, 3, 7)
+    assert b & 0xFF == 1
+    assert wire.unpack_shard_mismatch(-5 - (b - 1)) == (3, 7)
+
+
+def test_permuted_host_list_fails_loudly():
+    """A ps_hosts list in the wrong ORDER (shard 0's client dialing shard
+    1's server) is the silent-corruption case the handshake exists for."""
+    addrs = _servers(2)
+    with pytest.raises(ps_service.PSError, match="mis-wired shard dial"):
+        ps_shard.ShardedPSClients(addrs[::-1], role="w0", timeout_s=5.0)
+
+
+# ---------------------------------------------------------------------------
+# Scatter/gather correctness
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 2, 3])
+def test_sharded_store_byte_identical_get(n):
+    """A sharded publish+pull round trip is BYTE-identical to the single
+    connection path for the same vector — sharding must never change what
+    the workers train on (prime-ish size so the slice bounds are
+    awkward)."""
+    total = 100_003
+    vec = np.random.default_rng(7).normal(size=total).astype(np.float32)
+
+    # Reference: the r7 single-shard path on its own server.
+    ref_port = ps_service.start_server(0)
+    ref_client = ps_service.PSClient("127.0.0.1", ref_port, timeout_s=10.0)
+    ref_store = ps_service.RemoteParamStore(ref_client, "params", total)
+    ref_store.set(3, vec)
+    ref_step, ref_out = ref_store.get()
+    ps_service.stop_server(ref_port)
+    ref_client.close()
+
+    addrs = _servers(n)
+    group = ps_shard.ShardedPSClients(addrs, role="w0", timeout_s=10.0)
+    st = ps_shard.ShardedParamStore(group, "params", ps_shard.ShardLayout(total, n))
+    st.set(3, vec)
+    step, out = st.get()
+    assert step == ref_step == 3
+    assert out.tobytes() == ref_out.tobytes() == vec.tobytes()
+    group.close()
+
+
+def test_sharded_store_versioned_pull_and_front_buffer():
+    """Per-shard if-newer semantics: an unchanged-step gather returns the
+    SAME assembled buffer (zero data movement), a new publish lands in a
+    FRESH buffer (the consumer may still be reading the old one under the
+    prefetch overlap), and per-shard wall times are recorded."""
+    n, total = 2, 10_000
+    group = ps_shard.ShardedPSClients(_servers(n), role="w0", timeout_s=10.0)
+    st = ps_shard.ShardedParamStore(group, "params", ps_shard.ShardLayout(total, n))
+    v1 = np.arange(total, dtype=np.float32)
+    st.set(1, v1)
+    s, a = st.get()
+    assert s == 1 and np.array_equal(a, v1)
+    s, b = st.get()
+    assert s == 1 and b is a  # unchanged: same front buffer, no reassembly
+    v2 = v1 * 2
+    st.set(2, v2)
+    s, c = st.get()
+    assert s == 2 and c is not a
+    assert np.array_equal(c, v2) and np.array_equal(a, v1)  # old buffer intact
+    assert len(st.last_pull_ms) == n and all(t >= 0.0 for t in st.last_pull_ms)
+    assert len(st.last_push_ms) == n
+    group.close()
+
+
+def test_sharded_store_single_shard_reseed_keeps_other_caches():
+    """Kill+restart ONE shard server of 2: after the owner republishes
+    that shard, a pulling client refetches ONLY the restarted shard's
+    slice — the surviving shard answers unchanged (its versioned cache
+    stays valid) — and the assembled vector is correct."""
+    n, total = 2, 10_000
+    addrs = _servers(n)
+    kw = dict(timeout_s=10.0, op_timeout_s=5.0, reconnect_deadline_s=30.0)
+    chief = ps_shard.ShardedPSClients(addrs, role="chief0", **kw)
+    cst = ps_shard.ShardedParamStore(chief, "params", ps_shard.ShardLayout(total, n))
+    worker = ps_shard.ShardedPSClients(addrs, role="w0", **kw)
+    wst = ps_shard.ShardedParamStore(worker, "params", ps_shard.ShardLayout(total, n))
+
+    vec = np.arange(total, dtype=np.float32)
+    cst.set(5, vec)
+    s, out = wst.get()
+    assert s == 5 and np.array_equal(out, vec)
+
+    # Kill and restart shard 1 on the same port (state lost).
+    ps_service.stop_server(addrs[1][1])
+    assert ps_service.start_server(
+        addrs[1][1], shard_id=1, shard_count=n
+    ) == addrs[1][1]
+
+    # Until the owner reseeds, the gather reports "not published" overall.
+    s, _ = wst.get()
+    assert s < 0
+
+    # Owner reseeds ONLY the restarted shard, at the same step.
+    cst.set_shard(1, 5, vec)
+    s, out = wst.get()
+    assert s == 5 and np.array_equal(out, vec)
+    # Shard 0 stayed cached: its cache step never regressed to -1.
+    assert wst._steps == [5, 5]
+    chief.close()
+    worker.close()
+
+
+def test_sharded_store_empty_shards():
+    """N > num_elems: trailing shards own zero elements, carry no remote
+    objects, and the gather is still exact."""
+    n, total = 5, 3
+    group = ps_shard.ShardedPSClients(_servers(n), role="w0", timeout_s=10.0)
+    st = ps_shard.ShardedParamStore(group, "params", ps_shard.ShardLayout(total, n))
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+    st.set(1, v)
+    s, out = st.get()
+    assert s == 1 and np.array_equal(out, v)
+    group.close()
+
+
+# ---------------------------------------------------------------------------
+# Sharded accumulator / gradient queue
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_accumulator_average_and_partial_take():
+    n, total = 3, 1_000
+    group = ps_shard.ShardedPSClients(
+        _servers(n), role="w0", timeout_s=10.0, worker_tag=0
+    )
+    lo = ps_shard.ShardLayout(total, n)
+    acc = ps_shard.ShardedAccumulator(group, "acc", lo)
+    acc.set_global_step(0)
+    g1 = np.random.default_rng(0).normal(size=total).astype(np.float32)
+    g2 = np.random.default_rng(1).normal(size=total).astype(np.float32)
+    assert acc.apply(0, g1)
+    # One gradient so far: a bounded take times out but must not LOSE
+    # anything (partial retention) — the second apply then completes it.
+    assert acc.take(2, timeout_s=0.2) is ps_service.TIMED_OUT
+    assert acc.apply(0, g2)
+    out = acc.take(2, timeout_s=10.0)
+    np.testing.assert_allclose(out, (g1 + g2) / 2, rtol=1e-6)
+    assert acc.dropped == 0
+    # Stale apply: every shard drops it, the counter aggregates.
+    acc.set_global_step(5)
+    assert not acc.apply(4, g1)
+    assert acc.dropped == n
+    group.close()
+
+
+def test_sharded_gradient_queue_roundtrip_and_counters():
+    n, total = 2, 999
+    group = ps_shard.ShardedPSClients(
+        _servers(n), role="w0", timeout_s=10.0, worker_tag=1
+    )
+    lo = ps_shard.ShardLayout(total, n)
+    gq = ps_shard.ShardedGradientQueue(group, "gq", lo, capacity=4)
+    g = np.random.default_rng(2).normal(size=total).astype(np.float32)
+    assert gq.push(7, g) is True
+    step, out = gq.pop(timeout_s=10.0)
+    assert step == 7 and np.array_equal(out, g)
+    assert gq.pop(timeout_s=0.2) is ps_service.TIMED_OUT
+    # Stale push: dropped on every shard, aggregated counter.
+    gq.set_min_step(10)
+    assert gq.push(3, g) is False
+    assert gq.dropped == n and gq.deduped == 0
+    group.close()
+
+
+def test_sharded_store_bf16_wire():
+    """The sharded gather composes with the bf16 wire: payloads land via
+    the per-shard staging convert, values quantized exactly like the
+    single-shard bf16 path."""
+    n, total = 2, 4_096
+    group = ps_shard.ShardedPSClients(
+        _servers(n), role="w0", timeout_s=10.0, wire_dtype="bf16"
+    )
+    st = ps_shard.ShardedParamStore(group, "params", ps_shard.ShardLayout(total, n))
+    vec = np.random.default_rng(3).normal(size=total).astype(np.float32)
+    st.set(1, vec)
+    s, out = st.get()
+    expect = wire.bf16_to_f32(wire.f32_to_bf16(wire.bf16_to_f32(wire.f32_to_bf16(vec))))
+    assert s == 1
+    np.testing.assert_array_equal(out, expect)
+    group.close()

@@ -22,6 +22,18 @@ Three kinds of checks, all deliberately host-portable:
    must deliver at least ``--remote-local-ratio`` (default 0.5: the ISSUE 3
    "within 2x" acceptance bound) of the local filestream's MB/s, again from
    the result file alone.
+4. **sharded pull speedup** (r9) — the shards=2 cold-pull row must beat
+   shards=1 by at least ``--sharded-speedup`` (default 1.3: the ISSUE 4
+   acceptance bound) at the full 64 MB payload, from the result file
+   alone.  Host-portability condition, same spirit as the memcpy
+   normalization: a loopback shard bench parallelizes over CPU CORES
+   (each stream pins a server-writer and a client-reader thread), so on a
+   host with < 4 cores one stream already saturates the box and NO
+   implementation can express a speedup — there the check degrades to a
+   no-collapse floor (shards=2 >= 0.6x shards=1, which still trips the
+   catastrophic regressions: a serialized gather that re-pulls the full
+   vector per shard halves the row).  The bench records ``cpus`` for
+   this; on >= 4-core hosts the full 1.3x bound applies.
 
 The default tolerance is generous (0.25: flag only when a normalized row
 drops below a QUARTER of baseline) — this is a tripwire for structural
@@ -54,11 +66,37 @@ def _detail(rec: dict) -> dict:
 
 def gate(
     result: dict, baseline: dict, *, tolerance: float, if_newer_ratio: float,
-    remote_local_ratio: float = 0.5,
+    remote_local_ratio: float = 0.5, sharded_speedup: float = 1.3,
 ) -> list[str]:
     """Returns a list of human-readable regression lines (empty = pass)."""
     res, base = _detail(result), _detail(baseline)
     failures: list[str] = []
+    # The r9 shard-scaling acceptance bound, from the result alone: the
+    # sharded cold pull must genuinely parallelize.  Gated only at the
+    # full 64 MB payload (the acceptance size); hosts too small to express
+    # loopback parallelism (< 4 cores, see module docstring) get the
+    # no-collapse floor instead of the speedup bound.
+    shard_rows = res.get("shards")
+    if (
+        isinstance(shard_rows, dict)
+        and isinstance(shard_rows.get("2"), dict)
+        and res.get("large_mb", 0.0) >= 64.0
+    ):
+        bound = sharded_speedup if res.get("cpus", 0) >= 4 else 0.6
+        sp = shard_rows["2"].get("sharded_pull_speedup")
+        if sp is not None and sp < bound:
+            failures.append(
+                f"shards.2.sharded_pull_speedup: {sp:.2f} < {bound} "
+                f"(host cpus={res.get('cpus', '?')}) — sharded gather no "
+                "longer parallel?"
+            )
+    baseline_shards = base.get("shards")
+    if (
+        isinstance(baseline_shards, dict)
+        and isinstance(baseline_shards.get("2"), dict)
+        and not isinstance(shard_rows, dict)
+    ):
+        failures.append("shards: rows missing from result")
     # The disaggregation acceptance bound, from the result alone: remote
     # streaming within 1/ratio of the local in-process loader.  Applies in
     # the 1 MB+ batch regime the acceptance criterion names — per-batch
@@ -118,6 +156,7 @@ def main():
     ap.add_argument("--tolerance", type=float, default=0.25)
     ap.add_argument("--if-newer-ratio", type=float, default=20.0)
     ap.add_argument("--remote-local-ratio", type=float, default=0.5)
+    ap.add_argument("--sharded-speedup", type=float, default=1.3)
     args = ap.parse_args()
     with open(args.result) as f:
         result = json.load(f)
@@ -135,6 +174,7 @@ def main():
         result, baseline,
         tolerance=args.tolerance, if_newer_ratio=args.if_newer_ratio,
         remote_local_ratio=args.remote_local_ratio,
+        sharded_speedup=args.sharded_speedup,
     )
     if failures:
         print("PERF_GATE FAIL")

@@ -34,7 +34,10 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if ROOT not in sys.path:
     sys.path.insert(0, ROOT)
 
-from distributed_tensorflow_examples_tpu.parallel import ps_service  # noqa: E402
+from distributed_tensorflow_examples_tpu.parallel import (  # noqa: E402
+    ps_service,
+    ps_shard,
+)
 
 
 def _time(fn, reps: int) -> float:
@@ -109,6 +112,62 @@ def bench_dtype(
     return row
 
 
+def bench_shards(
+    host: str, *, counts: list[int], elems: int, reps: int, trials: int = 3,
+) -> dict:
+    """Shard-scaling axis (r9 tentpole measurement): the SAME total bytes
+    pulled/pushed through 1/2/4 local shard servers via the sharded
+    scatter/gather client (``parallel/ps_shard``).  Each count gets its own
+    fresh in-process servers (multi-server support, per-port stop), so the
+    rows are independent.  Every row is the BEST of ``trials`` timing
+    passes — on small/shared hosts the loopback rows are hostage to
+    scheduler noise (single-trial spread exceeds the effect under test),
+    and the max is the standard noise-floor estimator for a
+    throughput microbench.  ``sharded_pull_speedup`` is the cold-pull MB/s
+    over the shards=1 row — the number ``tools/perf_gate.py`` gates
+    (>= 1.3x at shards=2, 64 MB, on hosts with the cores to express it)."""
+    rows: dict = {}
+    mb = elems * 4 / 1e6
+    for n in counts:
+        ports = [
+            ps_service.start_server(0, shard_id=i, shard_count=n)
+            for i in range(n)
+        ]
+        try:
+            group = ps_shard.ShardedPSClients(
+                [(host, p) for p in ports], role="bench0", timeout_s=120.0
+            )
+            layout = ps_shard.ShardLayout(elems, n)
+            # cache_pulls=False: every get is a COLD full gather — the
+            # worker-pulls-fresh-params hot path this axis prices.
+            st = ps_shard.ShardedParamStore(
+                group, "p_sh", layout, cache_pulls=False
+            )
+            flat = (np.arange(elems, dtype=np.float32) % 251) - 125.0
+            st.set(0, flat)
+            st.get()
+            row: dict = {"shards": n, "set_mbs": 0.0, "get_mbs": 0.0}
+            for _ in range(max(1, trials)):
+                dt = _time(lambda: st.set(1, flat), reps)
+                row["set_mbs"] = max(row["set_mbs"], reps * mb / dt)
+                dt = _time(st.get, reps)
+                row["get_mbs"] = max(row["get_mbs"], reps * mb / dt)
+            rows[str(n)] = row
+            group.close()
+        finally:
+            for p in ports:
+                ps_service.stop_server(p)
+    # Speedups are relative to the shards=1 row SPECIFICALLY — with a
+    # custom --shards axis that omits 1, the ratio has no baseline and the
+    # rows carry none (perf_gate skips a missing speedup) rather than a
+    # bogus 1.0 pinned to whichever count happened to run first.
+    base_get = rows.get("1", {}).get("get_mbs")
+    if base_get:
+        for row in rows.values():
+            row["sharded_pull_speedup"] = row["get_mbs"] / base_get
+    return rows
+
+
 def bench_concurrent_get(
     host: str, port: int, *, clients: int, elems: int, reps: int
 ) -> dict:
@@ -152,6 +211,11 @@ def run(args) -> dict:
             "large_mb": args.large_mb,
             "small_kb": args.small_kb,
             "memcpy_mbs": memcpy_mbs(large_elems * 4),
+            # Loopback sharding is CPU-parallelism: the gate needs to know
+            # whether this host can physically express a speedup (a 2-core
+            # box saturates its loopback with ONE stream — server writer +
+            # client reader — leaving no idle core for shard 2).
+            "cpus": os.cpu_count() or 1,
         }
         for dtype in args.dtypes:
             detail[dtype] = bench_dtype(
@@ -170,6 +234,12 @@ def run(args) -> dict:
         )
     finally:
         ps_service.stop_server()
+    # Shard-scaling axis AFTER the main server is down (its own servers,
+    # same total bytes per row).
+    detail["shards"] = bench_shards(
+        "127.0.0.1", counts=getattr(args, "shards_axis", [1, 2]),
+        elems=large_elems, reps=args.reps_large,
+    )
     return detail
 
 
@@ -183,6 +253,9 @@ def main():
     ap.add_argument("--reps-large", type=int, default=8)
     ap.add_argument("--reps-small", type=int, default=200)
     ap.add_argument("--dtypes", default="f32,bf16")
+    ap.add_argument("--shards", default="1,2,4",
+                    help="shard-scaling axis: local shard-server counts "
+                    "(same total bytes per row)")
     ap.add_argument("--quick", action="store_true",
                     help="CI-sized: 8 MB large payload, 2 clients, few reps")
     ap.add_argument("--json", default="", help="also write the record here")
@@ -193,6 +266,7 @@ def main():
         args.reps_large = min(args.reps_large, 4)
         args.reps_small = min(args.reps_small, 50)
     args.dtypes = [d for d in args.dtypes.split(",") if d]
+    args.shards_axis = [int(s) for s in args.shards.split(",") if s]
 
     detail = run(args)
     headline = detail[args.dtypes[0]]["set_get_mbs_large"]
