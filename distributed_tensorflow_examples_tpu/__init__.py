@@ -23,5 +23,6 @@ from . import parallel  # noqa: F401
 from . import data  # noqa: F401
 from . import models  # noqa: F401
 from . import ops  # noqa: F401
+from . import serve  # noqa: F401
 from . import train  # noqa: F401
 from . import utils  # noqa: F401

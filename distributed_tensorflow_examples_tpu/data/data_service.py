@@ -63,7 +63,6 @@ import logging
 import os
 import queue
 import socket
-import struct
 import threading
 import time
 from collections import OrderedDict, deque
@@ -90,8 +89,9 @@ DSVC_STATS = 69
 DSVC_GET_EVAL = 70
 DSVC_SHUTDOWN = 71
 
-#: HELLO answer payload: the service tag a client must verify.
-SERVICE_TAG = b"dsvc"
+#: HELLO answer payload: the service tag a client must verify (one shared
+#: registry in parallel/wire.py — r10).
+SERVICE_TAG = wire.SERVICE_TAGS["dsvc"]
 
 # Response statuses (non-assignment ops: 0 ok, >0 op-specific, <0 error).
 OK = 0
@@ -124,56 +124,15 @@ def parse_spec(spec: str) -> tuple[str, int]:
 
 
 # ----------------------------------------------------------------------------
-# Batch codec: JSON schema header + raw field bytes (zero-copy both ways)
+# Batch codec: JSON schema header + raw field bytes (zero-copy both ways).
+# One shared definition in parallel/wire.py (r10: the serving wire carries
+# the same field-dict payloads); these names stay as the stable import
+# point for tests and hosting code.
 # ----------------------------------------------------------------------------
 
-
-def encode_batch(batch: dict[str, np.ndarray]) -> list:
-    """Wire form of a field-dict batch: ``<I`` schema length + JSON schema +
-    each field's raw bytes, returned as a BUFFER LIST for scatter/gather
-    ``sendmsg`` — field arrays are never copied into a concatenated
-    message.  Field order is sorted for determinism."""
-    fields, bufs = [], []
-    for k in sorted(batch):
-        src = np.asarray(batch[k])
-        a = np.ascontiguousarray(src)
-        # Record the SOURCE shape: ascontiguousarray promotes 0-d scalars
-        # to 1-d, and the decode side must reconstruct the original.
-        fields.append({"name": k, "dtype": a.dtype.str, "shape": list(src.shape)})
-        bufs.append(a)
-    meta = json.dumps(fields).encode()
-    return [struct.pack("<I", len(meta)) + meta] + bufs
-
-
-def encoded_nbytes(bufs: list) -> int:
-    return sum(
-        b.nbytes if isinstance(b, np.ndarray) else len(b) for b in bufs
-    )
-
-
-def read_batch(sock, nbytes: int) -> dict[str, np.ndarray]:
-    """Inverse of :func:`encode_batch`, receiving each field via
-    ``recv_into`` straight into its final freshly-allocated array — no
-    staging buffer, no per-field copy."""
-    head = bytearray(4)
-    wire.recv_exact(sock, memoryview(head))
-    (mlen,) = struct.unpack("<I", head)
-    meta = bytearray(mlen)
-    wire.recv_exact(sock, memoryview(meta))
-    consumed = 4 + mlen
-    out: dict[str, np.ndarray] = {}
-    for f in json.loads(bytes(meta)):
-        a = np.empty(f["shape"], np.dtype(f["dtype"]))
-        if a.nbytes:
-            # reshape(-1) view: a 0-d array's own memoryview can't cast.
-            wire.recv_exact(sock, memoryview(a.reshape(-1)).cast("B"))
-        out[f["name"]] = a
-        consumed += a.nbytes
-    if consumed != nbytes:
-        raise ConnectionError(
-            f"batch framing mismatch: {consumed} consumed != {nbytes} framed"
-        )
-    return out
+encode_batch = wire.encode_batch
+encoded_nbytes = wire.encoded_nbytes
+read_batch = wire.read_batch
 
 
 # ----------------------------------------------------------------------------
@@ -551,11 +510,12 @@ class DataServiceServer:
 
     def _handle(self, conn, op: int, name: str, a: int, b: int) -> None:
         if op == DSVC_HELLO:
-            # a=version, b=dtype code.  Batches carry mixed-dtype fields as
-            # raw bytes, so only the f32 (pass-through) code is sound here.
-            ok = a == wire.WIRE_VERSION and b == wire.WIRE_DTYPES["f32"]
-            self._reply(conn, wire.WIRE_VERSION if ok else -1,
-                        [SERVICE_TAG] if ok else None)
+            # a=version, b=dtype code + announced service (r10: the shared
+            # hello_answer helper refuses a wrong-service dial loudly).
+            # Batches carry mixed-dtype fields as raw bytes, so only the
+            # f32 (pass-through) code is sound here.
+            status, tag = wire.hello_answer(a, b, service="dsvc")
+            self._reply(conn, status, [tag] if tag else None)
             return
         if op == DSVC_REGISTER:
             if a >= 0:
@@ -695,15 +655,15 @@ class DataServiceClient:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock
         status, tag = self._attempt(
-            DSVC_HELLO, a=wire.WIRE_VERSION, b=wire.WIRE_DTYPES["f32"]
+            DSVC_HELLO, a=wire.WIRE_VERSION,
+            b=wire.pack_hello_b(wire.WIRE_DTYPES["f32"], service="dsvc"),
         )
-        if status != wire.WIRE_VERSION or tag != SERVICE_TAG:
+        err = wire.hello_failure(
+            status, tag, service="dsvc", host=self._host, port=self._port
+        )
+        if err is not None:
             self._sever()
-            raise DSVCError(
-                f"HELLO with {self._host}:{self._port} failed: asked "
-                f"v{wire.WIRE_VERSION}/dsvc, peer answered {status} "
-                f"{tag!r} — not a data service, or incompatible version"
-            )
+            raise DSVCError(err)
 
     def _register(self) -> None:
         """REGISTER on the live socket (single attempt); detects a new

@@ -885,21 +885,8 @@ def remote_worker_loop(
     )
     client = group.coordinator
     template = init_fn(jax.random.key(0))
-    leaves, treedef = jax.tree.flatten(template)
-    shapes = [l.shape for l in leaves]
-    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
-    offsets = np.cumsum([0] + sizes)
-    total = int(offsets[-1])
+    total, unflatten = ps_shard.flat_param_spec(template)
     layout = ps_shard.ShardLayout(total, group.num_shards)
-
-    def unflatten(flat):
-        return jax.tree.unflatten(
-            treedef,
-            [
-                flat[offsets[i] : offsets[i + 1]].reshape(s)
-                for i, s in enumerate(shapes)
-            ],
-        )
 
     pstore = ps_shard.ShardedParamStore(group, "params", layout)
     tq = ps_service.RemoteTokenQueue(client, "tokens")

@@ -297,10 +297,13 @@ class PSClient:
         PERMANENT and must not be retried by the reconnect loop."""
         # HELLO carries no payload either way, so it frames identically
         # under every encoding — safe to send before the answer arrives.
+        # The "ps" service announcement (r10) rides in b's high bits: the
+        # native server masks them out (back-compatible), while a Python
+        # service reached by mistake refuses with a status naming itself.
         sid, scount = self._expect_shard if self._expect_shard else (0, 0)
         status, _ = self._attempt(
             _HELLO, a=WIRE_VERSION,
-            b=wire.pack_hello_b(self._wire_code, sid, scount),
+            b=wire.pack_hello_b(self._wire_code, sid, scount, service="ps"),
             deadline_s=self._connect_timeout
             if self._connect_timeout is not None
             else 10.0,
@@ -308,6 +311,17 @@ class PSClient:
         if status == WIRE_VERSION:
             return
         self._sever()
+        got = wire.unpack_wrong_service(status)
+        if got is not None:
+            # Checked BEFORE the shard decode: wrong-service statuses live
+            # in a range a genuine shard-mismatch echo can never produce
+            # (its packed identity always carries shard_count >= 1 in bits
+            # 32+, putting it far below this band).
+            raise PSError(
+                f"wrong-service dial: {self._host}:{self._port} is "
+                f"{wire.SERVICE_NAMES[got]} ({got!r}), not the native PS "
+                "state service — check --ps_hosts against the running tasks"
+            )
         if status <= wire.HELLO_SHARD_MISMATCH:
             got_id, got_n = wire.unpack_shard_mismatch(status)
             raise PSError(

@@ -70,7 +70,35 @@ __all__ = [
     "ShardedParamStore",
     "ShardedAccumulator",
     "ShardedGradientQueue",
+    "flat_param_spec",
 ]
+
+
+def flat_param_spec(template):
+    """``(total_elems, unflatten)`` for a parameter-tree TEMPLATE — the ONE
+    definition of the flat-vector convention every PS consumer shares
+    (training worker loops and serving replicas): leaves in ``jax.tree``
+    order, row-major reshape, contiguous concatenation.  Chief-side
+    flatten (``RemotePSChief``) and every consumer's unflatten must agree
+    leaf for leaf, or a published vector decodes into the wrong tree with
+    no loud failure — keep this the only spelling."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(template)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    offsets = np.cumsum([0] + sizes)
+
+    def unflatten(flat):
+        return jax.tree.unflatten(
+            treedef,
+            [
+                flat[offsets[i] : offsets[i + 1]].reshape(s)
+                for i, s in enumerate(shapes)
+            ],
+        )
+
+    return int(offsets[-1]), unflatten
 
 
 class ShardLayout:

@@ -1,22 +1,30 @@
 """Shared wire machinery for the cross-process services (r8 satellite).
 
-Both socket services — the PS state service client (``parallel/ps_service.py``
--> ``native/ps_server.cc``) and the disaggregated data service
-(``data/data_service.py``) — speak the same frame layout, the same HELLO
+All three socket services — the PS state service client
+(``parallel/ps_service.py`` -> ``native/ps_server.cc``), the disaggregated
+data service (``data/data_service.py``) and the model-serving replicas
+(``serve/model_server.py``) — speak the same frame layout, the same HELLO
 version negotiation, and the same zero-copy send/receive discipline.  This
 module is the ONE definition of those pieces, factored out of ``ps_service``
-so the two services cannot drift:
+so the services cannot drift:
 
 - **Frame layout** — request: ``<BB`` (op, name_len) + name bytes + ``<qqI``
   (a, b, payload_len); response: ``<qI`` (status, payload_len).  The unit of
   ``payload_len`` is per-service: the PS wire counts ELEMENTS of the
-  negotiated dtype (the C++ server's contract), the data wire counts BYTES
-  (batches carry mixed-dtype fields).  The layout and the zero-copy paths
-  are identical either way.
+  negotiated dtype (the C++ server's contract), the data and serving wires
+  count BYTES (batches carry mixed-dtype fields).  The layout and the
+  zero-copy paths are identical either way.
 - **HELLO** (op 26, shared code point) — version+dtype negotiation, sent
-  before any payload op can be misparsed.  The data service additionally
-  answers a service tag so a client dialing the wrong service fails loudly
-  instead of misinterpreting op codes.
+  before any payload op can be misparsed.  Every service has a SERVICE
+  IDENTITY too (r10): clients announce the service they expect in HELLO's
+  ``b`` operand (:func:`pack_hello_b` ``service=``), the Python services
+  answer through one shared helper (:func:`hello_answer`) that refuses a
+  wrong-service dial with a status naming the service actually reached,
+  and the shared client-side check (:func:`hello_failure`) turns every
+  mismatch into a diagnostic naming BOTH ends.  The native PS server
+  ignores the announcement bits (its success answer carries no tag), which
+  is itself distinctive: a data/serve client reading a tag-less success
+  knows it dialed the PS state service.
 - **Zero-copy send** (:func:`send_frames`) — header + payload buffers leave
   via scatter/gather ``sendmsg``; payload bytes are never copied into a
   concatenated request buffer.
@@ -25,10 +33,15 @@ so the two services cannot drift:
   was O(n²) in payload size), no staging copy.
 - **bf16 payload codec** — round-to-nearest-even f32<->bf16 bit-pattern
   conversion, bit-exact with the C++ server's ``f32_to_bf16``.
+- **batch codec** (:func:`encode_batch` / :func:`read_batch`) — mixed-dtype
+  field dicts as a JSON schema header + raw bytes, scatter/gather out and
+  ``recv_into`` straight into the final arrays; shared by the data service
+  (training batches) and the serving wire (predict inputs/outputs).
 """
 
 from __future__ import annotations
 
+import json
 import struct
 
 import numpy as np
@@ -57,13 +70,105 @@ HELLO_SHARD_COUNT_SHIFT = 32
 HELLO_SHARD_MASK = 0xFFFFFF
 HELLO_SHARD_MISMATCH = -5
 
+# Service identity (r10): every wire service has an id + a 4-byte tag.  A
+# client announces the service it EXPECTS in HELLO's b operand (bits
+# 56..62 — above the shard-identity bits, below the sign bit; the native
+# PS server masks them out, so announcing is backward-compatible with it);
+# the Python services refuse a mismatched announcement with status
+# ``WRONG_SERVICE_BASE - own_id`` so the dial fails loudly naming what was
+# actually reached.  Successful Python-service HELLOs answer their 4-byte
+# tag as payload; the native PS answers tag-less (also distinctive).
+SERVICE_IDS = {"ps": 1, "dsvc": 2, "msrv": 3}
+SERVICE_TAGS = {"ps": b"psrv", "dsvc": b"dsvc", "msrv": b"msrv"}
+SERVICE_NAMES = {
+    "ps": "the native PS state service",
+    "dsvc": "a data service",
+    "msrv": "a model-serving replica",
+}
+HELLO_SERVICE_SHIFT = 56
+HELLO_SERVICE_MASK = 0x7F
+WRONG_SERVICE_BASE = -100
 
-def pack_hello_b(dtype_code: int, shard_id: int = 0, shard_count: int = 0) -> int:
-    """HELLO's b operand: dtype + (optional) expected shard identity."""
+
+def pack_hello_b(
+    dtype_code: int, shard_id: int = 0, shard_count: int = 0,
+    service: str = "",
+) -> int:
+    """HELLO's b operand: dtype + (optional) expected shard identity +
+    (optional) expected SERVICE identity."""
     return (
         dtype_code
         | ((shard_id & HELLO_SHARD_MASK) << HELLO_SHARD_ID_SHIFT)
         | ((shard_count & HELLO_SHARD_MASK) << HELLO_SHARD_COUNT_SHIFT)
+        | ((SERVICE_IDS[service] if service else 0) << HELLO_SERVICE_SHIFT)
+    )
+
+
+def hello_expected_service(b: int) -> str:
+    """The service a HELLO's sender announced it expects ('' = none)."""
+    sid = (b >> HELLO_SERVICE_SHIFT) & HELLO_SERVICE_MASK
+    for name, i in SERVICE_IDS.items():
+        if i == sid:
+            return name
+    return ""
+
+
+def wrong_service_status(service: str) -> int:
+    return WRONG_SERVICE_BASE - SERVICE_IDS[service]
+
+
+def unpack_wrong_service(status: int) -> str | None:
+    """The service a ``WRONG_SERVICE_BASE``-range HELLO answer names, or
+    None when ``status`` is not a wrong-service refusal."""
+    sid = WRONG_SERVICE_BASE - status
+    for name, i in SERVICE_IDS.items():
+        if i == sid:
+            return name
+    return None
+
+
+def hello_answer(
+    a: int, b: int, *, service: str, accept_dtypes=(0,),
+) -> tuple[int, bytes | None]:
+    """The shared server-side HELLO answer for the Python services: returns
+    ``(status, tag_payload)``.  A client announcing a DIFFERENT service is
+    refused with a status naming this one (the wrong-service loud failure);
+    a version/dtype mismatch answers -1; success echoes the wire version
+    plus this service's 4-byte tag."""
+    expected = hello_expected_service(b)
+    if expected and expected != service:
+        return wrong_service_status(service), None
+    if a != WIRE_VERSION or (b & 0xFF) not in accept_dtypes:
+        return -1, None
+    return WIRE_VERSION, SERVICE_TAGS[service]
+
+
+def hello_failure(
+    status: int, tag: bytes | None, *, service: str, host: str, port: int,
+) -> str | None:
+    """The shared client-side HELLO verdict: None when ``(status, tag)`` is
+    a valid success for ``service``, else a diagnostic naming both ends —
+    what this client speaks AND what the peer turned out to be."""
+    want = SERVICE_NAMES[service]
+    if status == WIRE_VERSION and tag == SERVICE_TAGS[service]:
+        return None
+    got = unpack_wrong_service(status)
+    if got is not None:
+        return (
+            f"wrong-service dial: {host}:{port} is {SERVICE_NAMES[got]} "
+            f"({got!r}), not {want} ({service!r}) — check the host lists "
+            "against the running tasks"
+        )
+    if status == WIRE_VERSION and not tag:
+        return (
+            f"wrong-service dial: {host}:{port} answered HELLO "
+            f"v{WIRE_VERSION} without a service tag — that port hosts the "
+            f"native PS state service, not {want} ({service!r})"
+        )
+    return (
+        f"HELLO with {host}:{port} failed: asked v{WIRE_VERSION}/{service}, "
+        f"peer answered {status} {tag!r} — not {want}, or an incompatible "
+        "version"
     )
 
 
@@ -112,17 +217,25 @@ def bf16_to_f32(u16: np.ndarray) -> np.ndarray:
     return (u16.astype(np.uint32) << np.uint32(16)).view(np.float32)
 
 
+def _byte_view(a: np.ndarray) -> np.ndarray:
+    """Zero-copy uint8 view of a contiguous array.  ``memoryview(...).cast``
+    would do for standard dtypes, but PEP 3118 has no format code for
+    extension dtypes (ml_dtypes bfloat16 & co. raise ``cannot include
+    dtype 'E' in a buffer``) — a uint8 ``view`` moves any itemsize.
+    ``reshape(-1)`` keeps 0-d scalar arrays — unsized for ``len()`` —
+    valid."""
+    return a.reshape(-1).view(np.uint8)
+
+
 def send_frames(sock, bufs) -> None:
     """Scatter/gather send of a buffer list via ``sendmsg`` — no buffer is
     ever copied into a concatenated message.  Accepts ``bytes``,
-    ``memoryview`` and contiguous ndarrays (cast to byte views here;
-    ``reshape(-1)`` keeps 0-d scalar arrays — unsized for ``len()`` —
-    valid)."""
+    ``memoryview`` and contiguous ndarrays (cast to byte views here)."""
     out = []
     for b in bufs:
         if isinstance(b, np.ndarray):
             if b.nbytes:
-                out.append(memoryview(b.reshape(-1)).cast("B"))
+                out.append(memoryview(_byte_view(b)))
         elif len(b):
             out.append(memoryview(b))
     while out:
@@ -172,3 +285,74 @@ def read_request(sock, hdr2: bytearray | None = None):
     recv_exact(sock, memoryview(tail))
     a, b, plen = REQ_TAIL.unpack(tail)
     return op, name.decode(), a, b, plen
+
+
+# ----------------------------------------------------------------------------
+# Batch codec: JSON schema header + raw field bytes (zero-copy both ways).
+# Shared by the data service (training batches) and the serving wire
+# (predict inputs/outputs) — one definition, so the two byte-counting wires
+# cannot drift.
+# ----------------------------------------------------------------------------
+
+
+def encode_batch(batch: dict[str, np.ndarray]) -> list:
+    """Wire form of a field-dict batch: ``<I`` schema length + JSON schema +
+    each field's raw bytes, returned as a BUFFER LIST for scatter/gather
+    ``sendmsg`` — field arrays are never copied into a concatenated
+    message.  Field order is sorted for determinism."""
+    fields, bufs = [], []
+    for k in sorted(batch):
+        src = np.asarray(batch[k])
+        a = np.ascontiguousarray(src)
+        # Record the SOURCE shape: ascontiguousarray promotes 0-d scalars
+        # to 1-d, and the decode side must reconstruct the original.
+        # Extension dtypes (ml_dtypes bfloat16 & co.) stringify to a void
+        # '<V2' that would DECODE as raw void — their registered NAME is
+        # the round-trippable spelling; .str keeps byte order for the rest.
+        spec = a.dtype.name if a.dtype.kind == "V" else a.dtype.str
+        fields.append({"name": k, "dtype": spec, "shape": list(src.shape)})
+        bufs.append(a)
+    meta = json.dumps(fields).encode()
+    return [struct.pack("<I", len(meta)) + meta] + bufs
+
+
+def encoded_nbytes(bufs: list) -> int:
+    return sum(
+        b.nbytes if isinstance(b, np.ndarray) else len(b) for b in bufs
+    )
+
+
+def _decode_dtype(spec: str) -> np.dtype:
+    """Decode a schema dtype spelling.  Extension-dtype names ('bfloat16')
+    resolve only once their registering package is imported — numpy knows
+    nothing of them on its own."""
+    try:
+        return np.dtype(spec)
+    except TypeError:
+        import ml_dtypes  # noqa: F401 — registers bfloat16/float8_* names
+
+        return np.dtype(spec)
+
+
+def read_batch(sock, nbytes: int) -> dict[str, np.ndarray]:
+    """Inverse of :func:`encode_batch`, receiving each field via
+    ``recv_into`` straight into its final freshly-allocated array — no
+    staging buffer, no per-field copy."""
+    head = bytearray(4)
+    recv_exact(sock, memoryview(head))
+    (mlen,) = struct.unpack("<I", head)
+    meta = bytearray(mlen)
+    recv_exact(sock, memoryview(meta))
+    consumed = 4 + mlen
+    out: dict[str, np.ndarray] = {}
+    for f in json.loads(bytes(meta)):
+        a = np.empty(f["shape"], _decode_dtype(f["dtype"]))
+        if a.nbytes:
+            recv_exact(sock, memoryview(_byte_view(a)))
+        out[f["name"]] = a
+        consumed += a.nbytes
+    if consumed != nbytes:
+        raise ConnectionError(
+            f"batch framing mismatch: {consumed} consumed != {nbytes} framed"
+        )
+    return out

@@ -1,0 +1,33 @@
+"""Online inference plane (r10): param-tracking model replicas with
+dynamic micro-batching over the PS wire.
+
+The first consumer of the parameter-store plane that is not a training
+worker — the TensorFlow architecture paper's "servers hand versioned
+params to any consumer" substrate, applied to serving:
+
+- ``model_server`` — :class:`ModelReplicaServer` hot-tracks training by
+  polling the (sharded) PS with versioned pulls, micro-batches predict
+  requests into one jitted apply, stamps responses with the served
+  ``model_step``, and sheds load with an explicit OVERLOAD status; hosted
+  as the supervised ``--job_name=serve`` cluster role.
+- ``batcher`` — the model-agnostic dynamic micro-batcher + admission
+  control.
+- ``client`` — :class:`ServeClient` (deadlines / backoff reconnect /
+  ``<role>_sv`` fault injection) and :class:`ServePool` (round-robin over
+  N replicas with unhealthy-replica ejection).
+"""
+
+from .batcher import DynamicBatcher, Overloaded  # noqa: F401
+from .client import (  # noqa: F401
+    ServeClient,
+    ServeDeadlineError,
+    ServeError,
+    ServeOverloadError,
+    ServePool,
+    ServeRejectedError,
+    ServeUnavailableError,
+)
+from .model_server import (  # noqa: F401
+    ModelReplicaServer,
+    host_serve_task,
+)

@@ -1,0 +1,252 @@
+"""Dynamic micro-batcher: coalesce queued predict requests into one apply.
+
+Online inference arrives one small request at a time, but the accelerator's
+throughput comes from batched applies — the same tension the reference
+stack resolved for *training* with global batches.  This module is the
+serving-side resolution (r10 tentpole): requests queue as they arrive, a
+single batch thread coalesces them — up to ``max_batch`` rows, or whatever
+accumulated within ``max_wait_ms`` of the first request — and runs ONE
+jitted apply, then scatters the per-request output slices back to each
+waiting connection handler.
+
+Admission control: the number of in-system requests (queued + being
+batched + computing) is bounded by ``queue_depth``.  Past it, ``submit``
+raises :class:`Overloaded` IMMEDIATELY — the server answers an explicit
+OVERLOAD status so resilient clients back off / rotate to another replica,
+instead of piling requests onto a replica that can only grow its latency
+tail (the load-shedding half of the serving SLO).
+
+The batcher is model-agnostic: ``run_batch(items) -> results`` is the only
+coupling, so the unit tests drive it with plain functions and the model
+server plugs in the padded jitted apply.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+
+class Overloaded(RuntimeError):
+    """Admission control refused the request: the replica's queue is full.
+    Clients should back off or try another replica."""
+
+
+class Ticket:
+    """One submitted request's future: ``result()`` blocks until the batch
+    containing it was applied, then returns this request's slice (or
+    re-raises the batch's error on the submitting side)."""
+
+    __slots__ = ("rows", "key", "_event", "_value", "_error")
+
+    def __init__(self, rows: int, key=None):
+        self.rows = rows
+        self.key = key
+        self._event = threading.Event()
+        self._value = None
+        self._error: BaseException | None = None
+
+    def _resolve(self, value=None, error: BaseException | None = None) -> None:
+        self._value, self._error = value, error
+        self._event.set()
+
+    def result(self, timeout_s: float | None = None):
+        if not self._event.wait(timeout_s):
+            raise TimeoutError("batched apply did not complete in time")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class DynamicBatcher:
+    """The coalescing loop.  ``run_batch(items: list) -> list`` runs on the
+    single batch thread and must return one result per item (in order);
+    an exception fails every request of that batch (each submitter sees
+    it), never the batcher itself.
+
+    ``max_batch``    row budget per apply; a request's ``rows`` that would
+                     overflow the current batch is carried into the next
+                     one (never split).  A single request larger than
+                     ``max_batch`` runs as its own batch.
+    ``max_wait_ms``  how long a non-full batch waits for more requests
+                     after its FIRST one arrived — the latency the first
+                     request pays to buy coalescing.
+    ``queue_depth``  max in-system requests before ``submit`` answers
+                     :class:`Overloaded`.
+    """
+
+    def __init__(
+        self, run_batch, *, max_batch: int = 32, max_wait_ms: float = 5.0,
+        queue_depth: int = 128, name: str = "serve",
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._run = run_batch
+        self.max_batch = int(max_batch)
+        self.max_wait_s = max_wait_ms / 1e3
+        self.queue_depth = int(queue_depth)
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._carry: Ticket | None = None  # would-overflow head of next batch
+        self._items: dict[Ticket, object] = {}
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._stopped = False
+        # Counters (read via stats(); writes under _lock or batch-thread-only).
+        self.requests = 0
+        self.overloads = 0
+        self.batches = 0
+        self.rows_batched = 0
+        self.flush_full = 0
+        self.flush_timeout = 0
+        self.last_batch_rows = 0
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"dtx-{name}-batcher"
+        )
+        self._thread.start()
+
+    # -- producer side -------------------------------------------------------
+
+    def submit(self, item, rows: int = 1, key=None) -> Ticket:
+        """Enqueue one request (``rows`` = its leading-dim size, the unit
+        ``max_batch`` budgets).  Only requests with EQUAL ``key`` coalesce
+        into one apply (the model server keys by field schema, so one
+        malformed request can never poison a well-formed neighbour's
+        batch; a mismatched arrival ends the current batch and heads the
+        next one).  Raises :class:`Overloaded` when the in-system request
+        count is at ``queue_depth`` — the caller answers the explicit
+        OVERLOAD status instead of queuing unboundedly."""
+        t = Ticket(rows, key)
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("batcher is stopped")
+            if self._inflight >= self.queue_depth:
+                self.overloads += 1
+                raise Overloaded(
+                    f"{self._inflight} requests in flight (depth "
+                    f"{self.queue_depth})"
+                )
+            self._inflight += 1
+            self.requests += 1
+            # Enqueue under the SAME lock that stop() takes to set
+            # _stopped: a ticket that passed the check above is therefore
+            # queued before the stop sentinel, so the drain loop always
+            # sees it and no submitter is left blocking on an unresolved
+            # ticket.
+            self._items[t] = item
+            self._q.put(t)
+        return t
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "requests": self.requests,
+                "overloads": self.overloads,
+                "batches": self.batches,
+                "rows_batched": self.rows_batched,
+                "flush_full": self.flush_full,
+                "flush_timeout": self.flush_timeout,
+                "last_batch_rows": self.last_batch_rows,
+                "inflight": self._inflight,
+                "max_batch": self.max_batch,
+                "queue_depth": self.queue_depth,
+            }
+
+    def stop(self) -> None:
+        """Stop the batch thread; pending submitters see RuntimeError."""
+        with self._lock:
+            self._stopped = True
+        self._q.put(None)  # wake the collector
+        self._thread.join(timeout=10.0)
+
+    # -- the batch thread ----------------------------------------------------
+
+    def _next_ticket(self, timeout_s: float | None):
+        try:
+            return self._q.get(timeout=timeout_s)
+        except queue.Empty:
+            return None
+
+    def _collect(self) -> tuple[list[Ticket], bool] | None:
+        """Block for the first request, then coalesce until the row budget
+        fills or ``max_wait_ms`` passes.  Returns ``(batch, filled)`` or
+        None when stopping."""
+        if self._carry is not None:
+            first, self._carry = self._carry, None
+        else:
+            while True:
+                if self._stopped:
+                    return None
+                # The stop() wake sentinel arrives as a literal None — the
+                # same shape as a get() timeout, and handled the same way:
+                # loop around and observe _stopped.
+                first = self._next_ticket(0.2)
+                if first is not None:
+                    break
+        batch, rows = [first], first.rows
+        deadline = time.monotonic() + self.max_wait_s
+        while rows < self.max_batch:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            t = self._next_ticket(remaining)
+            if t is None:
+                break  # window expired (or the stop sentinel: flush now)
+            if t.key != first.key:
+                self._carry = t  # different schema: never co-batched
+                break
+            if rows + t.rows > self.max_batch:
+                self._carry = t  # head of the NEXT batch — never split
+                rows = self.max_batch
+                break
+            batch.append(t)
+            rows += t.rows
+        return batch, rows >= self.max_batch
+
+    def _loop(self) -> None:
+        while True:
+            got = self._collect()
+            if got is None:
+                break
+            batch, filled = got
+            items = [self._items.pop(t) for t in batch]
+            try:
+                results = self._run(items)
+                if len(results) != len(batch):
+                    raise RuntimeError(
+                        f"run_batch returned {len(results)} results for "
+                        f"{len(batch)} requests"
+                    )
+            except BaseException as e:  # noqa: BLE001 — re-raised per ticket
+                for t in batch:
+                    t._resolve(error=e)
+            else:
+                for t, r in zip(batch, results):
+                    t._resolve(value=r)
+            nrows = sum(t.rows for t in batch)
+            with self._lock:
+                self._inflight -= len(batch)
+                self.batches += 1
+                self.rows_batched += nrows
+                self.last_batch_rows = nrows
+                if filled:
+                    self.flush_full += 1
+                else:
+                    self.flush_timeout += 1
+        # Drain: anything still queued (or carried) fails loudly on its
+        # submitter's side rather than hanging it.
+        err = RuntimeError("batcher stopped")
+        pending = [self._carry] if self._carry is not None else []
+        self._carry = None
+        while True:
+            try:
+                t = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if isinstance(t, Ticket):  # skip the stop() wake sentinel
+                pending.append(t)
+        with self._lock:
+            self._inflight -= len(pending)
+        for t in pending:
+            self._items.pop(t, None)
+            t._resolve(error=err)

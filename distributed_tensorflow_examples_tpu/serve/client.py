@@ -1,0 +1,378 @@
+"""Resilient serving clients: per-replica transport + replica pool (r10).
+
+:class:`ServeClient` is the PR 1 discipline applied to the serving wire —
+per-op deadlines, exponential-backoff reconnect bounded by
+``reconnect_deadline_s``, ``DTX_FAULT_PLAN`` injection under the client
+role ``<role>_sv`` — over the shared ``parallel/wire.py`` framing with the
+``msrv`` HELLO service identity (a wrong-service dial fails loudly naming
+both ends).  Predict is PURE (same inputs, same published params, same
+outputs), so replaying it after a reconnect is always safe — the simplest
+replay story of the three wires.
+
+:class:`ServePool` is the load-balancing layer: round-robin over N
+replicas, with unhealthy-replica EJECTION (a transport failure benches the
+replica for ``eject_s`` and the request retries on a peer immediately) and
+explicit backoff on OVERLOAD / NO_MODEL answers (admission control means
+the replica is alive but shedding — rotate, don't hammer).  Under a
+replica kill + supervised restart, the pool absorbs the gap: requests keep
+succeeding on the surviving replicas, and the healed replica rejoins the
+rotation when its ejection expires — the "zero failed client requests"
+contract the fault tests pin.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+
+from ..parallel import wire
+from ..utils import faults
+from .model_server import NO_MODEL, OVERLOAD, SRV_PREDICT, SRV_SHUTDOWN, SRV_STATS
+
+
+class ServeError(RuntimeError):
+    """A serving op failed terminally (transport unrecoverable or the
+    replica rejected the request)."""
+
+
+class ServeDeadlineError(ServeError):
+    """Reconnect/retry budget exhausted: no replica answered in time."""
+
+
+class ServeOverloadError(ServeError):
+    """The replica's admission control refused the request (queue full):
+    back off or try another replica."""
+
+
+class ServeUnavailableError(ServeError):
+    """The replica is up but has not pulled a published snapshot yet
+    (warming after a restart, or the chief has not published)."""
+
+
+class ServeRejectedError(ServeError):
+    """The replica ANSWERED and rejected the request itself (malformed
+    inputs, apply error) — the transport is fine and every peer would
+    answer the same, so pools must surface this to the caller instead of
+    ejecting the healthy replica and replaying the bad request."""
+
+
+class ServeClient:
+    """One TCP connection to a model replica (requests serialized on it).
+
+    Fault-plan role: ``<process role>_sv`` by default, so ``DTX_FAULT_PLAN``
+    specs can target serving connections specifically (``role=client0_sv``)
+    while broad globs still match every transport of a process.
+    """
+
+    def __init__(
+        self, host: str, port: int, *, op_timeout_s: float | None = 30.0,
+        reconnect_deadline_s: float = 60.0, backoff_s: float = 0.25,
+        role: str | None = None,
+    ):
+        self._host, self._port = host, port
+        self._op_timeout = op_timeout_s
+        self._reconnect_deadline = reconnect_deadline_s
+        self._backoff = backoff_s
+        self.role = role if role is not None else (
+            (faults.current_role() or "client") + "_sv"
+        )
+        self._injector = faults.client_injector(self.role)
+        self._lock = threading.RLock()
+        self._sock: socket.socket | None = None
+        self._hdr = bytearray(wire.RESP_HDR.size)
+        try:
+            self._connect()
+        except OSError:
+            if self._reconnect_deadline <= 0:
+                raise
+            self._recover(time.monotonic() + self._reconnect_deadline)
+
+    # -- transport -----------------------------------------------------------
+
+    def _connect(self) -> None:
+        sock = socket.create_connection(
+            (self._host, self._port), timeout=self._op_timeout
+        )
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        status, tag = self._attempt(
+            wire.HELLO_OP, a=wire.WIRE_VERSION,
+            b=wire.pack_hello_b(wire.WIRE_DTYPES["f32"], service="msrv"),
+        )
+        err = wire.hello_failure(
+            status, tag, service="msrv", host=self._host, port=self._port
+        )
+        if err is not None:
+            self._sever()
+            raise ServeError(err)
+
+    def _sever(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._reconnect_deadline = 0.0
+        self._sever()
+
+    def _attempt(
+        self, op: int, name: str = "", a: int = 0, b: int = 0, *,
+        payload_bufs: list | None = None, batch: bool = False,
+    ):
+        """One send/recv round trip; severs the socket on ANY transport
+        failure.  ``payload_bufs``: a pre-encoded batch buffer list (wire
+        codec) sent zero-copy via scatter/gather ``sendmsg``."""
+        if self._sock is None:
+            raise ConnectionError("not connected")
+        try:
+            self._sock.settimeout(self._op_timeout)
+            nbytes = wire.encoded_nbytes(payload_bufs) if payload_bufs else 0
+            hdr = wire.pack_request(op, name, a, b, nbytes)
+            wire.send_frames(self._sock, [hdr] + (payload_bufs or []))
+            head = memoryview(self._hdr)
+            wire.recv_exact(self._sock, head)
+            status, rbytes = wire.RESP_HDR.unpack(self._hdr)
+            if not rbytes:
+                return status, None
+            if batch:
+                return status, wire.read_batch(self._sock, rbytes)
+            buf = bytearray(rbytes)
+            wire.recv_exact(self._sock, memoryview(buf))
+            return status, bytes(buf)
+        except OSError:
+            self._sever()
+            raise
+
+    def _recover(self, t_end: float) -> None:
+        attempt = 0
+        while True:
+            if attempt:
+                delay = min(self._backoff * (2 ** min(attempt - 1, 6)), 2.0)
+                time.sleep(min(delay, max(0.0, t_end - time.monotonic())))
+            if time.monotonic() >= t_end:
+                faults.log_event(
+                    "reconnect_gave_up", role=self.role, host=self._host,
+                    port=self._port, attempts=attempt,
+                )
+                raise ServeDeadlineError(
+                    f"model replica at {self._host}:{self._port} unreachable "
+                    f"for {self._reconnect_deadline:.0f}s ({attempt} attempts)"
+                )
+            attempt += 1
+            try:
+                self._connect()
+            except OSError:
+                self._sever()
+                continue
+            faults.log_event("reconnected", role=self.role, attempts=attempt)
+            return
+
+    def call(
+        self, op: int, name: str = "", a: int = 0, b: int = 0, *,
+        payload_bufs: list | None = None, batch: bool = False,
+    ):
+        """One request/response; recovers + replays on transport failure
+        (every SRV op is pure/idempotent, so replay is always safe)."""
+        with self._lock:
+            if self._injector is not None and self._injector.before_op(op):
+                self._sever()  # injected drop_conn
+            t_end = None
+            while True:
+                if self._sock is not None:
+                    try:
+                        return self._attempt(
+                            op, name, a, b, payload_bufs=payload_bufs,
+                            batch=batch,
+                        )
+                    except OSError as e:
+                        if self._reconnect_deadline <= 0:
+                            raise ServeError(
+                                f"serve op {op} failed: {e!r}"
+                            ) from e
+                        faults.log_event(
+                            "conn_lost", role=self.role, op_code=op,
+                            error=type(e).__name__,
+                        )
+                elif self._reconnect_deadline <= 0:
+                    raise ServeError(f"serve op {op} failed: not connected")
+                if t_end is None:
+                    t_end = time.monotonic() + self._reconnect_deadline
+                self._recover(t_end)
+
+    # -- ops -----------------------------------------------------------------
+
+    def predict(self, inputs: dict) -> tuple[int, dict[str, np.ndarray]]:
+        """One predict round trip: ``(model_step, outputs)``.  The step is
+        the published update the replica served this answer from.  Raises
+        :class:`ServeOverloadError` / :class:`ServeUnavailableError` on the
+        explicit shed statuses (callers/pools back off or rotate)."""
+        bufs = wire.encode_batch(inputs)
+        status, out = self.call(SRV_PREDICT, payload_bufs=bufs, batch=True)
+        if status == OVERLOAD:
+            raise ServeOverloadError(
+                f"replica {self._host}:{self._port} overloaded"
+            )
+        if status == NO_MODEL:
+            raise ServeUnavailableError(
+                f"replica {self._host}:{self._port} has no model yet"
+            )
+        if status < 0 or out is None:
+            raise ServeRejectedError(f"predict rejected: {status}")
+        return status, out
+
+    def stats(self) -> dict:
+        status, raw = self.call(SRV_STATS)
+        if status != 0 or raw is None:
+            raise ServeRejectedError(f"stats rejected: {status}")
+        return json.loads(raw)
+
+    def shutdown_server(self) -> None:
+        self.call(SRV_SHUTDOWN)
+
+
+class ServePool:
+    """Round-robin load balancer over N replicas with unhealthy-replica
+    ejection.  Per-replica clients run FAIL-FAST (no per-client reconnect
+    budget): the pool itself is the recovery layer — a failed attempt
+    benches that replica for ``eject_s`` and immediately retries on a peer,
+    which converts a replica kill into added latency on one request rather
+    than an error.  ``deadline_s`` bounds one logical predict across every
+    retry; it should comfortably cover a supervised replica restart."""
+
+    def __init__(
+        self, addrs: list[tuple[str, int]], *, role: str | None = None,
+        op_timeout_s: float | None = 10.0, eject_s: float = 1.0,
+        deadline_s: float = 60.0, backoff_s: float = 0.05,
+    ):
+        if not addrs:
+            raise ValueError("need at least one replica address")
+        self.addrs = list(addrs)
+        self.role = role if role is not None else (
+            (faults.current_role() or "client") + "_sv"
+        )
+        self._op_timeout = op_timeout_s
+        self._eject_s = eject_s
+        self._deadline = deadline_s
+        self._backoff = backoff_s
+        n = len(self.addrs)
+        self._clients: list[ServeClient | None] = [None] * n
+        self._eject_until = [0.0] * n
+        self._rr = 0
+        self._lock = threading.Lock()
+        self.retries = 0
+        self.ejections = 0
+        self.last_replica = -1
+
+    def _pick(self) -> int | None:
+        with self._lock:
+            now = time.monotonic()
+            for k in range(len(self.addrs)):
+                i = (self._rr + k) % len(self.addrs)
+                if now >= self._eject_until[i]:
+                    self._rr = i + 1
+                    return i
+            return None  # every replica currently benched
+
+    def _eject(self, i: int, for_s: float) -> None:
+        with self._lock:
+            self._eject_until[i] = time.monotonic() + for_s
+            self.ejections += 1
+            c, self._clients[i] = self._clients[i], None
+        if c is not None:
+            c.close()
+
+    def _client(self, i: int) -> ServeClient:
+        with self._lock:
+            c = self._clients[i]
+        if c is not None:
+            return c
+        host, port = self.addrs[i]
+        c = ServeClient(
+            host, port, op_timeout_s=self._op_timeout,
+            reconnect_deadline_s=0.0,  # the POOL is the recovery layer
+            role=self.role,
+        )
+        with self._lock:
+            # Two threads can race past the None check and both dial;
+            # first one in wins, the loser closes its socket (no leak)
+            # and shares the winner's client.
+            if self._clients[i] is None:
+                self._clients[i] = c
+                return c
+            winner = self._clients[i]
+        c.close()
+        return winner
+
+    def predict(
+        self, inputs: dict, *, deadline_s: float | None = None,
+    ) -> tuple[int, dict[str, np.ndarray]]:
+        """One logical predict, retried across the rotation until it
+        succeeds or the deadline passes.  Safe to retry without markers:
+        predict is pure, so a response lost mid-failover at worst costs a
+        recomputation, never a duplicated side effect."""
+        t_end = time.monotonic() + (
+            deadline_s if deadline_s is not None else self._deadline
+        )
+        last_err: BaseException | None = None
+        first = True
+        while time.monotonic() < t_end:
+            if not first:
+                with self._lock:
+                    self.retries += 1
+            first = False
+            i = self._pick()
+            if i is None:
+                # Everything benched: sleep to the earliest un-ejection
+                # (bounded by the backoff floor) and try again.
+                with self._lock:
+                    wake = min(self._eject_until)
+                time.sleep(
+                    min(max(self._backoff, wake - time.monotonic()), 1.0)
+                )
+                continue
+            try:
+                got = self._client(i).predict(inputs)
+                self.last_replica = i
+                return got
+            except ServeRejectedError:
+                # The replica ANSWERED: the request itself is bad (or the
+                # apply failed deterministically).  Every peer would reject
+                # it the same way — surface it instead of benching healthy
+                # replicas and replaying for the whole deadline.
+                raise
+            except (ServeOverloadError, ServeUnavailableError) as e:
+                # Alive but shedding: rotate with a short bench — long
+                # enough to drain, short enough to rejoin promptly.
+                last_err = e
+                self._eject(i, min(self._eject_s, 0.25))
+            except (ServeError, OSError, ConnectionError) as e:
+                last_err = e
+                self._eject(i, self._eject_s)
+                faults.log_event(
+                    "serve_replica_ejected", role=self.role, replica=i,
+                    error=type(e).__name__,
+                )
+        raise ServeDeadlineError(
+            f"no replica answered within {self._deadline:.0f}s "
+            f"(last error: {last_err!r})"
+        )
+
+    def stats(self, i: int) -> dict:
+        """Replica ``i``'s stats (dialing it directly, even if benched)."""
+        return self._client(i).stats()
+
+    def close(self) -> None:
+        for k, c in enumerate(self._clients):
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+            self._clients[k] = None

@@ -1,0 +1,502 @@
+"""Param-tracking model replica server: the online inference plane (r10).
+
+After r9 the repo trains behind a resilient sharded parameter store but has
+no process that answers a predict request.  The TensorFlow architecture
+paper frames the PS pattern as the shared substrate for training AND
+serving — parameter servers hand versioned params to any consumer — and
+the tf.data-service PR (r8) showed the payoff of disaggregating a plane
+onto the shared wire.  This module applies the same move to inference:
+
+- :class:`ModelReplicaServer` — a replica speaking the shared
+  ``parallel/wire.py`` framing under the ``msrv`` service tag.  It
+  HOT-TRACKS training: a background refresher thread polls the (sharded)
+  parameter store with ``PSTORE_GET_IF_NEWER`` (via
+  ``ps_shard.ShardedParamStore`` / ``ps_service.RemoteParamStore``), so an
+  unchanged model costs one O(header) round trip per shard and a changed
+  one lands in a FRESH buffer the store never reuses — an in-flight batch
+  holds its own ``(step, params)`` snapshot and can never tear.  Every
+  predict response is stamped with the served ``model_step`` (the response
+  status), so consumers can observe exactly which published update they
+  were answered from.
+- Dynamic micro-batching — requests from all connections coalesce through
+  :class:`serve.batcher.DynamicBatcher` into one padded jitted apply
+  (padding keeps the jit cache at ONE shape; row-independent models make
+  the pad rows inert, so batched and unbatched outputs are byte-identical).
+  A bounded queue answers an explicit OVERLOAD status past ``queue_depth``
+  — admission control, so resilient clients back off instead of piling on.
+- Fault posture — the replica process carries a fault role (``serve<i>``),
+  ``die:after_reqs`` arms off the server's request counter, and the
+  ``--job_name=serve`` task runs under the shared supervised-restart path
+  (``train/ps_experiment._supervised_reexec``): a killed replica restarts,
+  re-pulls the CURRENT params from the PS (zero coordination — the store
+  is the rendezvous), and rejoins the client rotation.
+
+Wire notes: frame layout / HELLO / zero-copy paths shared via
+``parallel/wire.py``; payload lengths count BYTES (predict inputs/outputs
+are mixed-dtype field dicts moved with the shared batch codec).  Op codes
+are disjoint from both the PS range (1..27) and the data service's
+(64..71), so a frame reaching the wrong service is refused, never
+misinterpreted; the HELLO service identity makes even the refusal loud.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+
+from ..parallel import ps_shard, wire
+from ..utils import faults
+from ..utils.metrics import LatencyRecorder, MetricsWriter
+from . import batcher as batcher_lib
+
+log = logging.getLogger("dtx.serve")
+
+#: This wire's service identity (parallel/wire.py registry).
+SERVICE = "msrv"
+SERVICE_TAG = wire.SERVICE_TAGS[SERVICE]
+
+# Op codes (SRV_*), disjoint from the PS server's 1..27 and DSVC's 64..71.
+SRV_HELLO = wire.HELLO_OP
+SRV_PREDICT = 96
+SRV_STATS = 97
+SRV_SHUTDOWN = 98
+
+# Response statuses.  PREDICT success answers the served model_step (>= 0)
+# as the status — the per-response staleness stamp costs zero extra bytes.
+ERR = -2
+OVERLOAD = -7  # admission control: queue full, back off / try a peer
+NO_MODEL = -8  # replica up but no published snapshot pulled yet (warming)
+
+
+def flat_param_spec(init_fn):
+    """``(total_elems, unflatten)`` for the parameter STRUCTURE ``init_fn``
+    builds — the shared ``ps_shard.flat_param_spec`` convention the
+    training workers use (values always come from the param store; only
+    shapes matter here)."""
+    import jax
+
+    template = init_fn(jax.random.key(0))
+    if isinstance(template, tuple):  # init_fn returning (params, model_state)
+        template = template[0]
+    return ps_shard.flat_param_spec(template)
+
+
+class ModelReplicaServer:
+    """One serving replica: PS-tracking model + micro-batched predict.
+
+    ``init_fn``       builds the parameter structure (shapes/treedef); the
+                      VALUES are pulled from the parameter store.
+    ``predict_fn``    ``predict_fn(params, inputs: dict) -> array | dict``;
+                      must be row-wise in the leading dim (outputs row i
+                      depend only on inputs row i) — that is what makes
+                      padded batching exact and the scatter well-defined.
+    ``ps_addrs``      the shard servers in shard order (``--ps_hosts``).
+    ``max_batch`` / ``max_wait_ms`` / ``queue_depth``
+                      the micro-batcher knobs (serve/batcher.py).
+    ``refresh_ms``    param-poll cadence; each poll is O(header) per shard
+                      while the published step is unchanged.
+    """
+
+    def __init__(
+        self, init_fn, predict_fn, ps_addrs, *, port: int = 0,
+        loopback_only: bool = True, max_batch: int = 32,
+        max_wait_ms: float = 5.0, queue_depth: int = 128,
+        refresh_ms: float = 50.0, op_timeout_s: float | None = 10.0,
+        reconnect_deadline_s: float = 60.0, role: str | None = None,
+        metrics_dir: str | None = None, metrics_every: int = 100,
+    ):
+        import jax
+
+        total, self._unflatten = flat_param_spec(init_fn)
+        self._predict = jax.jit(predict_fn)
+        self.role = role if role is not None else (
+            faults.current_role() or "serve0"
+        )
+        self._group = ps_shard.ShardedPSClients(
+            list(ps_addrs), role=self.role, op_timeout_s=op_timeout_s,
+            reconnect_deadline_s=reconnect_deadline_s,
+        )
+        self._layout = ps_shard.ShardLayout(total, self._group.num_shards)
+        self._pstore = ps_shard.ShardedParamStore(
+            self._group, "params", self._layout
+        )
+        self.max_batch = int(max_batch)
+        self._refresh_s = max(refresh_ms, 1.0) / 1e3
+        # The served model: an immutable (step, params) tuple swapped by
+        # ONE reference assignment.  A changed pull lands in a fresh buffer
+        # (the store's contract), so a batch holding the previous tuple is
+        # never torn by the swap.
+        self._model: tuple[int, object] | None = None
+        self._incarnation = int.from_bytes(os.urandom(4), "little") | 1
+        self._lock = threading.Lock()
+        self._requests = 0
+        self._predicts = 0
+        self._refreshes = 0
+        self._refresh_errors = 0
+        self._overloads = 0
+        self.latency = LatencyRecorder()
+        self._writer = MetricsWriter(metrics_dir) if metrics_dir else None
+        self._metrics_every = max(1, metrics_every)
+        self._batcher = batcher_lib.DynamicBatcher(
+            self._run_batch, max_batch=max_batch, max_wait_ms=max_wait_ms,
+            queue_depth=queue_depth,
+        )
+        self._stop = threading.Event()
+        self.shutdown_requested = threading.Event()
+        self._conns: list[socket.socket] = []
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        bind_deadline = time.monotonic() + (5.0 if port else 0.0)
+        while True:
+            try:
+                self._listener.bind(("127.0.0.1" if loopback_only else "", port))
+                break
+            except OSError:
+                # A supervised restart rebinds the dead incarnation's FIXED
+                # port; lingering sockets can hold it briefly — retry within
+                # a short window instead of failing the healing restart.
+                if time.monotonic() >= bind_deadline:
+                    raise
+                time.sleep(0.2)
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self._refresher = threading.Thread(
+            target=self._refresh_loop, daemon=True, name="msrv-refresh"
+        )
+        self._refresher.start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="msrv-accept"
+        )
+        self._accept_thread.start()
+        log.info(
+            "model replica %s serving on port %d (%d PS shard(s), "
+            "max_batch=%d, incarnation %d)",
+            self.role, self.port, self._group.num_shards, self.max_batch,
+            self._incarnation,
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def request_count(self) -> int:
+        """Requests handled so far — the ``die:after_reqs`` fault trigger
+        for a serve task (same contract as the PS / data servers)."""
+        return self._requests
+
+    @property
+    def model_step(self) -> int:
+        m = self._model
+        return -1 if m is None else m[0]
+
+    def wait_for_model(self, timeout_s: float = 60.0) -> bool:
+        """Block until the first published snapshot was pulled (True), or
+        the timeout passes (False) — the warm-up gate hosting code may use
+        before advertising the replica."""
+        deadline = time.monotonic() + timeout_s
+        while self._model is None:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.02)
+        return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        # shutdown() BEFORE close(): close alone does not free the port
+        # while the accept thread is blocked in accept() (same reasoning as
+        # DataServiceServer.stop).
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._accept_thread.join(timeout=5.0)
+        self._refresher.join(timeout=5.0)
+        with self._lock:
+            conns, self._conns = self._conns[:], []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._batcher.stop()
+        if self._writer is not None:
+            self._writer.close()
+        self._group.close()
+
+    # -- the param refresher (hot-tracking thread) ---------------------------
+
+    def _refresh_loop(self) -> None:
+        from ..parallel import ps_service
+
+        while not self._stop.is_set():
+            try:
+                step, flat = self._pstore.get()
+            except (ps_service.PSError, OSError) as e:
+                # A PS outage past the client's own reconnect budget: keep
+                # serving the LAST pulled model (stale-but-available beats
+                # down) and keep polling.
+                self._refresh_errors += 1
+                faults.log_event(
+                    "serve_refresh_error", role=self.role,
+                    error=type(e).__name__,
+                )
+                self._stop.wait(min(1.0, self._refresh_s * 4))
+                continue
+            cur = self._model
+            if step >= 0 and (cur is None or int(step) != cur[0]):
+                # A CHANGED pull landed in a fresh buffer (the store never
+                # hands back the previously returned one), so the views the
+                # unflatten takes can outlive any number of later swaps.
+                # device_put HERE, once per publish: the same snapshot is
+                # reused across every apply until the next change, so the
+                # batches must not each re-pay the host->device transfer.
+                import jax
+
+                self._model = (
+                    int(step), jax.device_put(self._unflatten(flat))
+                )
+                self._refreshes += 1
+            self._stop.wait(self._refresh_s)
+
+    # -- the batched apply ---------------------------------------------------
+
+    def _run_batch(self, items: list[dict]):
+        """One padded jitted apply for a coalesced request list; returns
+        ``(step, outputs_slice)`` per request.  Runs on the batch thread."""
+        model = self._model
+        if model is None:
+            raise _NoModel()
+        step, params = model
+        proto = items[0]
+        rows = [len(next(iter(it.values()))) for it in items]
+        total = sum(rows)
+        # Pad to the fixed max_batch shape so the jit cache holds ONE entry
+        # per field signature; a lone oversized request runs at its own
+        # (padded-to-itself) shape.
+        padded = self.max_batch if total <= self.max_batch else total
+        batch = {
+            k: np.zeros((padded,) + np.asarray(v).shape[1:], np.asarray(v).dtype)
+            for k, v in proto.items()
+        }
+        off = 0
+        for it, r in zip(items, rows):
+            for k in batch:
+                batch[k][off : off + r] = it[k]
+            off += r
+        out = self._predict(params, batch)
+        if not isinstance(out, dict):
+            out = {"output": out}
+        out_np = {k: np.asarray(v) for k, v in out.items()}
+        results = []
+        off = 0
+        for r in rows:
+            results.append(
+                (step, {k: v[off : off + r] for k, v in out_np.items()})
+            )
+            off += r
+        with self._lock:
+            self._predicts += total
+        return results
+
+    # -- stats ---------------------------------------------------------------
+
+    def stats(self) -> dict:
+        b = self._batcher.stats()
+        with self._lock:
+            s = {
+                "service": SERVICE,
+                "incarnation": self._incarnation,
+                "model_step": self.model_step,
+                "requests": self._requests,
+                "predict_rows": self._predicts,
+                "overloads": self._overloads,
+                "refreshes": self._refreshes,
+                "refresh_errors": self._refresh_errors,
+                "ps_shards": self._group.num_shards,
+            }
+        s.update({f"batcher_{k}": v for k, v in b.items()})
+        s.update(self.latency.percentile_scalars("serve"))
+        return s
+
+    # -- connection handling -------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.append(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True,
+                name="msrv-conn",
+            ).start()
+
+    def _reply(self, conn, status: int, bufs: list | None) -> None:
+        bufs = bufs or []
+        hdr = wire.RESP_HDR.pack(status, wire.encoded_nbytes(bufs))
+        wire.send_frames(conn, [hdr] + bufs)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        hdr2 = bytearray(2)
+        try:
+            while not self._stop.is_set():
+                req = wire.read_request(conn, hdr2)
+                if req is None:
+                    return
+                op, name, a, b, plen = req
+                with self._lock:
+                    self._requests += 1
+                if op == SRV_PREDICT:
+                    t0 = time.perf_counter()
+                    # The payload must leave the socket even on the
+                    # overload path — the framing survives the refusal.
+                    inputs = wire.read_batch(conn, plen)
+                    self._handle_predict(conn, inputs, t0)
+                    continue
+                if plen:  # no other SRV op carries a request payload
+                    sink = bytearray(min(plen, 1 << 20))
+                    left = plen
+                    while left:
+                        view = memoryview(sink)[: min(left, len(sink))]
+                        wire.recv_exact(conn, view)
+                        left -= len(view)
+                if op == SRV_HELLO:
+                    status, tag = wire.hello_answer(a, b, service=SERVICE)
+                    self._reply(conn, status, [tag] if tag else None)
+                elif op == SRV_STATS:
+                    self._reply(conn, 0, [json.dumps(self.stats()).encode()])
+                elif op == SRV_SHUTDOWN:
+                    self.shutdown_requested.set()
+                    self._reply(conn, 0, None)
+                else:
+                    self._reply(conn, ERR, None)
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            with self._lock:
+                try:
+                    self._conns.remove(conn)
+                except ValueError:
+                    pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_predict(self, conn, inputs: dict, t0: float) -> None:
+        if not inputs:
+            self._reply(conn, ERR, None)
+            return
+        lens = {len(np.asarray(v)) if np.asarray(v).ndim else -1
+                for v in inputs.values()}
+        if len(lens) != 1 or -1 in lens:
+            # Every field must share one leading dim — the row unit the
+            # batcher budgets and the scatter slices by.
+            self._reply(conn, ERR, None)
+            return
+        if self._model is None:
+            self._reply(conn, NO_MODEL, None)
+            return
+        # Requests coalesce only with SCHEMA-IDENTICAL neighbours (same
+        # field names, trailing shapes and dtypes): one client sending a
+        # mismatched request must never poison a well-formed concurrent
+        # request's batch (it fails alone, in its own apply).
+        schema = tuple(sorted(
+            (k, np.asarray(v).shape[1:], str(np.asarray(v).dtype))
+            for k, v in inputs.items()
+        ))
+        try:
+            ticket = self._batcher.submit(inputs, rows=lens.pop(), key=schema)
+        except batcher_lib.Overloaded:
+            with self._lock:
+                self._overloads += 1
+            self._reply(conn, OVERLOAD, None)
+            return
+        try:
+            step, out = ticket.result(timeout_s=120.0)
+        except _NoModel:
+            self._reply(conn, NO_MODEL, None)
+            return
+        except Exception:
+            # An apply bug — or the ticket's own TimeoutError on a stuck
+            # batch thread (an OSError subclass, so no transport-error
+            # carve-out here: the try block does no socket I/O) — must
+            # surface as a LOUD per-op error on the client, not a silent
+            # connection close (same posture as the data service's
+            # handler guard).
+            log.exception("batched predict failed server-side")
+            self._reply(conn, ERR, None)
+            return
+        bufs = wire.encode_batch(out)
+        hdr = wire.RESP_HDR.pack(step, wire.encoded_nbytes(bufs))
+        wire.send_frames(conn, [hdr] + bufs)
+        self.latency.record(time.perf_counter() - t0)
+        if (
+            self._writer is not None
+            and self.latency.total % self._metrics_every == 0
+        ):
+            self._writer.scalars(
+                self.model_step, self.latency.percentile_scalars("serve")
+            )
+
+
+class _NoModel(RuntimeError):
+    """Raised inside a batch whose replica has no pulled snapshot yet —
+    mapped to the NO_MODEL status per request (warming replicas shed load
+    explicitly, like overload)."""
+
+
+# ----------------------------------------------------------------------------
+# Task-role hosting (the runner's `serve` job)
+# ----------------------------------------------------------------------------
+
+
+def host_serve_task(
+    *, init_fn, predict_fn, ps_addrs, port: int, loopback_only: bool = True,
+    max_batch: int = 32, max_wait_ms: float = 5.0, queue_depth: int = 128,
+    refresh_ms: float = 50.0, op_timeout_s: float | None = 10.0,
+    reconnect_deadline_s: float = 60.0, metrics_dir: str | None = None,
+) -> int:
+    """Dedicated serve-task body (``--job_name=serve``): host one replica
+    until a client signals SRV_SHUTDOWN (or the supervisor dies).  Arms
+    ``die`` fault specs off the replica's request counter — the
+    deterministic "kill replica i at request N" fault the serving recovery
+    tests inject; a supervisor restart re-pulls the current params from the
+    PS and rejoins the rotation with zero coordination."""
+    server = ModelReplicaServer(
+        init_fn, predict_fn, ps_addrs, port=port,
+        loopback_only=loopback_only, max_batch=max_batch,
+        max_wait_ms=max_wait_ms, queue_depth=queue_depth,
+        refresh_ms=refresh_ms, op_timeout_s=op_timeout_s,
+        reconnect_deadline_s=reconnect_deadline_s, metrics_dir=metrics_dir,
+    )
+    faults.arm_process_faults(request_count_fn=server.request_count)
+    if not server.wait_for_model(timeout_s=120.0):
+        log.warning(
+            "serve task: no published params after 120 s — serving NO_MODEL "
+            "until the chief publishes"
+        )
+    log.info(
+        "serve task on port %d (model_step=%d; blocking until shutdown)",
+        server.port, server.model_step,
+    )
+    supervised = os.environ.get("DTX_SERVE_SUPERVISED") == "1"
+    ppid0 = os.getppid()
+    while not server.shutdown_requested.wait(timeout=2.0):
+        if supervised and os.getppid() != ppid0:
+            log.warning("serve task: supervisor died; exiting")
+            break
+    bound = server.port
+    server.stop()
+    return bound

@@ -51,13 +51,16 @@ def run_ps_emulation(
     mode: str,
     eval_fn: Callable[[Any], dict[str, float]] | None = None,
     model_state: Any = None,
+    predict_fn: Callable | None = None,
 ) -> Any:
     """Run W1/W2 PS-emulation training; returns final params.
 
     ``batches_for_worker(worker_id, local_batch_size, n_workers)`` yields
     that worker's local batches (its data shard; the count is passed so data
     sharding can never diverge from the thread count); ``eval_fn(params)``
-    computes final metrics for the FINAL line.
+    computes final metrics for the FINAL line.  ``predict_fn(params,
+    inputs)`` is the row-wise inference apply a ``--job_name=serve``
+    replica (r10) would serve — only that task role needs it.
 
     With ``--job_name=ps|chief|worker`` and ``--ps_hosts`` (the reference's
     one-process-per-task launch, SURVEY.md sections 3.1/3.2) this process
@@ -79,6 +82,7 @@ def run_ps_emulation(
             mode=mode,
             eval_fn=eval_fn,
             model_state=model_state,
+            predict_fn=predict_fn,
         )
 
     n_workers = worker_count(FLAGS)
@@ -184,18 +188,19 @@ def _ps_cfg(FLAGS, mode: str, n_workers: int):
     )
 
 
-def _resolve_listen_all(FLAGS, host: str) -> bool:
+def _resolve_listen_all(FLAGS, host: str, flag: str = "--ps_hosts") -> bool:
     """Network exposure is an explicit operator decision (--ps_listen_all),
     never inferred from how the hostname is spelled: '::1' or a
     loopback-resolving FQDN must not silently bind INADDR_ANY, and a
     non-loopback entry without the flag is a launch error, not a silent
     network-wide bind of an unauthenticated service (ADVICE r4).  Applies
-    to BOTH service-hosting paths: the dedicated PS task and the
-    chief-hosted (--ps_tasks=0) service."""
+    to EVERY service-hosting path: the dedicated PS task, the chief-hosted
+    (--ps_tasks=0) service, the data-service task, and the serve replicas
+    (``flag`` names the host list the entry came from)."""
     listen_all = bool(getattr(FLAGS, "ps_listen_all", False))
     if not listen_all and host not in ("127.0.0.1", "localhost"):
         raise ValueError(
-            f"--ps_hosts entry {host!r} is not a literal loopback "
+            f"{flag} entry {host!r} is not a literal loopback "
             "address; serving other hosts needs the unauthenticated "
             "state service bound on all interfaces — opt in explicitly "
             "with --ps_listen_all (trusted networks only)"
@@ -281,7 +286,7 @@ def _supervised_reexec(FLAGS, *, child_env_flag: str) -> int | None:
 
 def run_ps_cluster_task(
     *, init_fn, loss_fn, optimizer, batches_for_worker, FLAGS, mode, eval_fn=None,
-    model_state=None,
+    model_state=None, predict_fn=None,
 ):
     """One task of the reference's multi-process PS cluster (its defining
     launch pattern — one process per ``--job_name``/``--task_index``,
@@ -309,6 +314,16 @@ def run_ps_cluster_task(
                   ``--data_service_hosts[task_index]``; training workers
                   consume via ``--data_dir=dsvc://host:port``
                   (``data/data_service.py``).  Needs no PS service.
+    - ``serve`` (r10): online inference replica — hot-tracks the (sharded)
+                  parameter store with versioned pulls and serves
+                  micro-batched predictions at
+                  ``--serve_hosts[task_index]`` under the ``msrv`` service
+                  tag (``serve/model_server.py``; needs ``predict_fn``).
+                  Clients load-balance over the full list
+                  (``serve.ServePool``).  Restarts under ``--ps_restarts``
+                  like the other service tasks: a killed replica re-pulls
+                  the current params from the PS and rejoins with zero
+                  coordination.
 
     Fault posture (r6): each task gets a fault role (``ps0``, ``chief0``,
     ``worker<i>``, ``data_service0``) for ``DTX_FAULT_PLAN`` matching, and the PS task runs
@@ -349,7 +364,7 @@ def run_ps_cluster_task(
         my_host, my_port = ds_entries[
             min(FLAGS.task_index, len(ds_entries) - 1)
         ].rsplit(":", 1)
-        listen_all = _resolve_listen_all(FLAGS, my_host)
+        listen_all = _resolve_listen_all(FLAGS, my_host, "--data_service_hosts")
         rc = _supervised_reexec(FLAGS, child_env_flag="DTX_DSVC_SUPERVISED")
         if rc is not None:
             if rc != 0:
@@ -370,6 +385,64 @@ def run_ps_cluster_task(
     # doubles as the coordinator (tokens, shutdown signal).
     shard_addrs = entries[:n_shards]
     host, port = shard_addrs[0]
+
+    if job == "serve":
+        # Online inference replica (r10): hot-track the parameter store
+        # these same shard servers host and serve micro-batched
+        # predictions.  Same supervised-restart contract as the PS and
+        # data-service tasks — a killed replica comes back on the same
+        # port, re-pulls the CURRENT params from the PS (the store is the
+        # rendezvous; zero coordination) and rejoins the client rotation.
+        from .. import serve as serve_pkg
+        from ..utils.flags import parse_hostports
+
+        if predict_fn is None:
+            raise ValueError(
+                "--job_name=serve needs a predict_fn (the row-wise "
+                "inference apply) passed through run_ps_emulation / "
+                "run_ps_cluster_task"
+            )
+        sv_hosts = getattr(FLAGS, "serve_hosts", "") or ""
+        if not sv_hosts:
+            raise ValueError(
+                "--job_name=serve needs --serve_hosts (host:port this "
+                "replica binds)"
+            )
+        sv_entries = parse_hostports(sv_hosts, "--serve_hosts")
+        my_host, my_port = sv_entries[
+            min(FLAGS.task_index, len(sv_entries) - 1)
+        ]
+        listen_all = _resolve_listen_all(FLAGS, my_host, "--serve_hosts")
+        rc = _supervised_reexec(FLAGS, child_env_flag="DTX_SERVE_SUPERVISED")
+        if rc is not None:
+            if rc != 0:
+                raise SystemExit(rc)
+            return None
+        for sh, sp in shard_addrs:
+            if not _probe_ps(sh, sp, 120.0):
+                raise ConnectionError(
+                    f"no PS service at {sh}:{sp} after 120 s (the serve "
+                    "replica pulls its params from there)"
+                )
+        bound = serve_pkg.host_serve_task(
+            init_fn=init_fn,
+            predict_fn=predict_fn,
+            ps_addrs=shard_addrs,
+            port=int(my_port),
+            loopback_only=not listen_all,
+            max_batch=int(getattr(FLAGS, "serve_max_batch", 32)),
+            max_wait_ms=float(getattr(FLAGS, "serve_max_wait_ms", 5.0)),
+            queue_depth=int(getattr(FLAGS, "serve_queue_depth", 128)),
+            refresh_ms=float(getattr(FLAGS, "serve_refresh_ms", 50.0)),
+            metrics_dir=(
+                os.path.join(FLAGS.log_dir, f"serve{FLAGS.task_index}")
+                if getattr(FLAGS, "log_dir", None)
+                else None
+            ),
+        )
+        print(f"SERVE_DONE port={bound}")
+        return None
+
     acfg = _ps_cfg(FLAGS, mode, n_workers)
     if acfg.fixed_interleave:
         # Real processes free-run — there is no scheduler to fix their

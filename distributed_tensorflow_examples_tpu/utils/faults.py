@@ -36,11 +36,13 @@ Kinds:
 Every spec takes ``role=`` (fnmatch glob, default ``*``) matched against
 the process role — set by launchers via the ``DTX_FAULT_ROLE`` env var or
 :func:`set_role` (``ps0``, ``chief0``, ``worker1``, ``data_service0``,
-``task2``...).  Per-connection client roles derive from the process role:
-a worker's prefetch PS connection is ``worker<i>_pf`` and its data-service
-connections are ``<role>_ds`` (``data/data_service.py``), so plans can
-target one transport of a process without firing on the others; broad
-globs (``worker0*``) still match them all.  Client
+``serve0``, ``task2``...).  Per-connection client roles derive from the
+process role: a worker's prefetch PS connection is ``worker<i>_pf``, its
+data-service connections are ``<role>_ds`` (``data/data_service.py``) and
+a process's serving-wire connections are ``<role>_sv``
+(``serve/client.py``), so plans can target one transport of a process
+without firing on the others; broad globs (``worker0*``) still match them
+all.  Client
 faults additionally take ``p=``/``seed=`` for probabilistic injection: the
 RNG is seeded from ``(seed, role, op-kind)``, and op indices count LOGICAL
 client ops (chunk re-issues of one blocking op don't advance the counter),

@@ -194,6 +194,50 @@ def define_legacy_cluster_flags():
         "task restarts under --ps_restarts like the PS task.",
     )
     _define(
+        "string",
+        "serve_hosts",
+        "",
+        "Online inference plane (r10): host:port list where --job_name="
+        "serve model replicas listen (entry [task_index] is this task's "
+        "bind address).  Each replica hot-tracks the (sharded) parameter "
+        "store at --ps_hosts with versioned pulls and serves micro-batched "
+        "predictions under the msrv service tag; clients load-balance "
+        "round-robin over the full list (serve.ServePool).  Exposure rules "
+        "follow --ps_listen_all; the task restarts under --ps_restarts "
+        "like the PS and data-service tasks.",
+    )
+    _define(
+        "integer",
+        "serve_max_batch",
+        32,
+        "Serving replicas: max rows coalesced into one jitted apply "
+        "(the dynamic micro-batcher's row budget).",
+    )
+    _define(
+        "float",
+        "serve_max_wait_ms",
+        5.0,
+        "Serving replicas: how long a non-full micro-batch waits for more "
+        "requests after its first one arrived — the latency spent buying "
+        "coalescing.",
+    )
+    _define(
+        "integer",
+        "serve_queue_depth",
+        128,
+        "Serving replicas: max in-system predict requests before the "
+        "replica answers an explicit OVERLOAD status (admission control; "
+        "resilient clients rotate/back off instead of piling on).",
+    )
+    _define(
+        "float",
+        "serve_refresh_ms",
+        50.0,
+        "Serving replicas: parameter-store poll cadence.  Each poll is one "
+        "O(header) round trip per shard while the published step is "
+        "unchanged (PSTORE_GET_IF_NEWER), so tight cadences stay cheap.",
+    )
+    _define(
         "integer",
         "replicas_to_aggregate",
         0,
@@ -217,9 +261,15 @@ def is_cross_process_ps(FLAGS) -> bool:
     native state service (native/ps_server.cc) listens.  The
     ``data_service`` job is a task of the same launch pattern: a dedicated
     input-worker process serving batches (data/data_service.py) — it needs
-    only ``--data_service_hosts``, not a PS service."""
+    only ``--data_service_hosts``, not a PS service.  The ``serve`` job
+    (r10) is a model replica of the inference plane: it needs BOTH a bind
+    address (``--serve_hosts``) and the PS topology it pulls params from."""
     if getattr(FLAGS, "job_name", "") == "data_service":
         return bool(getattr(FLAGS, "data_service_hosts", ""))
+    if getattr(FLAGS, "job_name", "") == "serve":
+        return bool(getattr(FLAGS, "serve_hosts", "")) and bool(
+            getattr(FLAGS, "ps_hosts", "")
+        )
     return (
         getattr(FLAGS, "job_name", "") in ("chief", "worker", "ps")
         and bool(getattr(FLAGS, "ps_hosts", ""))

@@ -10,7 +10,10 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
+
+import numpy as np
 
 
 def shard_scalars(kind: str, ms_per_shard) -> dict[str, float]:
@@ -23,6 +26,63 @@ def shard_scalars(kind: str, ms_per_shard) -> dict[str, float]:
         f"ps/{kind}_ms_shard{i}": float(ms)
         for i, ms in enumerate(ms_per_shard)
     }
+
+
+class LatencyRecorder:
+    """Ring buffer of recent op wall times -> latency/throughput scalars
+    (r10 satellite, the serving plane's ``serve/latency_*`` family).
+
+    ``record(seconds)`` is O(1) and thread-safe (many connection handlers
+    record concurrently); :meth:`percentile_scalars` reduces the retained
+    window into ``<prefix>/latency_p50_ms`` / ``p90`` / ``p99`` plus
+    ``<prefix>/qps`` (events per second across the window's wall-time
+    span).  Same naming convention as :func:`shard_scalars` — one emitter,
+    one tag family, so dashboards glob ``serve/latency_*`` the way they
+    glob ``ps/pull_ms_shard*``."""
+
+    def __init__(self, capacity: int = 2048):
+        if capacity < 2:
+            raise ValueError(f"capacity must be >= 2, got {capacity}")
+        self._cap = int(capacity)
+        self._dur = np.zeros(self._cap, np.float64)
+        self._at = np.zeros(self._cap, np.float64)
+        self._n = 0  # total ever recorded; ring index is _n % _cap
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float, *, at: float | None = None) -> None:
+        """Record one op's wall time.  ``at`` (monotonic seconds) defaults
+        to now — tests pass explicit stamps for deterministic qps."""
+        with self._lock:
+            i = self._n % self._cap
+            self._dur[i] = seconds
+            self._at[i] = time.monotonic() if at is None else at
+            self._n += 1
+
+    def __len__(self) -> int:
+        return min(self._n, self._cap)
+
+    @property
+    def total(self) -> int:
+        """Ops ever recorded (the ring only bounds the percentile window)."""
+        return self._n
+
+    def percentile_scalars(self, prefix: str) -> dict[str, float]:
+        """The retained window as scalar tags; empty dict when nothing has
+        been recorded yet (emitters skip the write instead of publishing
+        zeros that read as impossibly fast ops)."""
+        with self._lock:
+            m = min(self._n, self._cap)
+            if m == 0:
+                return {}
+            dur = self._dur[:m].copy()
+            at = self._at[:m].copy()
+        out = {
+            f"{prefix}/latency_p{p}_ms": float(np.percentile(dur, p) * 1e3)
+            for p in (50, 90, 99)
+        }
+        span = float(at.max() - at.min())
+        out[f"{prefix}/qps"] = (m - 1) / span if m >= 2 and span > 0 else 0.0
+        return out
 
 
 class MetricsWriter:

@@ -82,6 +82,8 @@ def main(argv):
                 ds.test,
                 FLAGS.batch_size,
             ),
+            # Row-wise inference apply for --job_name=serve replicas (r10).
+            predict_fn=lambda p, b: models.cnn.apply(cfg, p, b["image"]),
         )
         return
 

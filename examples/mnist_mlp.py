@@ -76,6 +76,9 @@ def main(argv):
                 ds.test,
                 FLAGS.batch_size,
             ),
+            # Row-wise inference apply for --job_name=serve replicas (r10):
+            # the online inference plane serves this model hot off the PS.
+            predict_fn=lambda p, b: models.mlp.apply(cfg, p, b["image"]),
         )
         return
 

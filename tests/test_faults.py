@@ -67,7 +67,7 @@ def _eval_loss(params) -> float:
 def _run_socket_training(
     *, steps=40, mode="async", plan="", ps_addr=None, ps_addrs=None,
     n_workers=2, shards=1, reconnect_deadline_s=60.0, join_timeout=180.0,
-    wire_dtype="f32",
+    wire_dtype="f32", stop_servers=None,
 ):
     """One async-PS training run over the socket transport, chief + worker
     threads in THIS process (the thread/2-process fault path): cheap enough
@@ -145,7 +145,10 @@ def _run_socket_training(
         return chief
     finally:
         os.environ.pop("DTX_FAULT_PLAN", None)
-        if ps_addr is None:
+        # stop_servers=False keeps THIS process's shard servers alive after
+        # training — the serving e2e's PS keeps publishing params to
+        # replicas that outlive the training run.
+        if stop_servers if stop_servers is not None else (ps_addr is None):
             ps_service.stop_server()
 
 
@@ -740,6 +743,333 @@ def test_data_service_kill_mid_epoch_heals_via_supervised_restart(tmp_path, capl
     assert "event=supervisor_healed_plan" in task_log, task_log[-2000:]
     assert "DSVC_DONE" in task_log, task_log[-2000:]
     assert proc.returncode == 0, task_log[-2000:]
+
+
+def test_serve_client_faults_heal(caplog):
+    """r10 fault matrix, serving leg: connection drops AND delays targeted
+    at the serving-wire client roles (``<role>_sv``) — predict is pure, so
+    the client reconnects and REPLAYS it safely; answers stay correct and
+    stamped with the served model_step throughout."""
+    caplog.set_level("INFO", logger="dtx.faults")
+    from distributed_tensorflow_examples_tpu import serve
+    from distributed_tensorflow_examples_tpu.parallel import ps_shard
+
+    port = ps_service.start_server(0)
+    addrs = [("127.0.0.1", port)]
+    group = ps_shard.ShardedPSClients(addrs, role="pub", op_timeout_s=10.0)
+    pstore = ps_shard.ShardedParamStore(
+        group, "params", ps_shard.ShardLayout(12, 1)
+    )
+    flat = np.arange(12, dtype=np.float32)
+    pstore.set(3, flat)
+
+    def init_fn(rng):
+        import jax.numpy as jnp
+
+        return {"w": jnp.zeros((4, 3), jnp.float32)}
+
+    srv = serve.ModelReplicaServer(
+        init_fn, lambda p, b: b["x"] @ p["w"], addrs, max_batch=4,
+        max_wait_ms=2.0, refresh_ms=10.0, role="srv_f",
+    )
+    os.environ["DTX_FAULT_PLAN"] = (
+        "drop_conn:role=cl0_sv,op=3;drop_conn:role=cl1_sv,op=5,count=2;"
+        "delay:role=cl*_sv,op=2,count=4,ms=10"
+    )
+    try:
+        assert srv.wait_for_model(30.0)
+        x = np.eye(4, dtype=np.float32)
+        want = x @ flat.reshape(4, 3)
+        errors: list = []
+
+        def client_body(i):
+            try:
+                c = serve.ServeClient(
+                    "127.0.0.1", srv.port, role=f"cl{i}_sv",
+                    op_timeout_s=10.0, reconnect_deadline_s=30.0,
+                )
+                for _ in range(8):
+                    step, out = c.predict({"x": x})
+                    assert step == 3
+                    np.testing.assert_allclose(out["output"], want, rtol=1e-6)
+                c.close()
+            except BaseException as e:  # noqa: BLE001
+                errors.append((i, e))
+
+        ts = [threading.Thread(target=client_body, args=(i,)) for i in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in ts), "serve clients hung"
+        assert not errors, errors
+        events = [
+            r.getMessage() for r in caplog.records if "dtx.faults" in r.getMessage()
+        ]
+        assert any(
+            "inject_drop_conn" in m and "role=cl0_sv" in m for m in events
+        ), events
+        assert any("inject_delay" in m and "_sv" in m for m in events), events
+        assert any(
+            "event=reconnected" in m and "_sv" in m for m in events
+        ), events
+    finally:
+        os.environ.pop("DTX_FAULT_PLAN", None)
+        srv.stop()
+        group.close()
+        ps_service.stop_server()
+
+
+_SERVE_TASK_SCRIPT = """\
+import os, sys
+sys.path.insert(0, {root!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from types import SimpleNamespace
+
+from distributed_tensorflow_examples_tpu import models
+from distributed_tensorflow_examples_tpu.train import ps_experiment
+
+CFG = models.mlp.Config(hidden=(16,), compute_dtype="float32")
+
+FLAGS = SimpleNamespace(
+    job_name="serve", task_index={task_index}, ps_hosts={ps_hosts!r},
+    serve_hosts={serve_hosts!r}, worker_hosts="a:1,b:1", ps_tasks=1,
+    ps_shards=-1, ps_listen_all=False, ps_restarts=2,
+    serve_max_batch=16, serve_max_wait_ms=3.0, serve_queue_depth=256,
+    serve_refresh_ms=25.0,
+    batch_size=8, train_steps=60, log_dir="", checkpoint_every_steps=50,
+    replicas_to_aggregate=0, max_staleness=0, deterministic=False, seed=0,
+    grad_accum=1,
+)
+ps_experiment.run_ps_cluster_task(
+    init_fn=lambda rng: models.mlp.init(CFG, rng),
+    loss_fn=models.mlp.loss_fn(CFG),
+    optimizer=None, batches_for_worker=None, FLAGS=FLAGS, mode="async",
+    eval_fn=None,
+    predict_fn=lambda params, batch: models.mlp.apply(
+        CFG, params, batch["image"]
+    ),
+)
+"""
+
+
+def test_serve_replica_kill_mid_load_heals_via_supervised_restart(tmp_path, caplog):
+    """r10 acceptance (the serving tentpole scenario): a 2-replica serve
+    cluster behind a 2-shard PS serves correct predictions while a REAL
+    training chief (+ 2 workers) publishes new params — every replica's
+    served model_step advances WITHOUT a restart (same incarnation across
+    the advance) — and replica 0 is KILLED mid-load by its fault plan
+    (``die:after_reqs``), its supervisor restarts it (stripping the fired
+    spec), the fresh incarnation re-pulls the CURRENT params straight from
+    the PS (zero coordination) and rejoins the pool's rotation, with ZERO
+    failed client requests across the whole run (the pool's deadline +
+    ejection absorbs the gap)."""
+    caplog.set_level("INFO", logger="dtx.faults")
+    from distributed_tensorflow_examples_tpu import serve
+
+    ps_ports = _free_ports(2)
+    serve_ports = _free_ports(2)
+    # The 2-shard PS lives in THIS process, outliving the training run so
+    # the restarted replica has a live store to re-pull from.
+    for i, p in enumerate(ps_ports):
+        ps_service.start_server(p, shard_id=i, shard_count=2)
+    ps_hosts = ",".join(f"127.0.0.1:{p}" for p in ps_ports)
+    serve_hosts = ",".join(f"127.0.0.1:{p}" for p in serve_ports)
+    env_base = dict(os.environ)
+    env_base["JAX_PLATFORMS"] = "cpu"
+    env_base.pop("PALLAS_AXON_POOL_IPS", None)
+    env_base.pop("DTX_FAULT_PLAN", None)
+    procs, logs = [], []
+    stop_load = threading.Event()
+    load_errors: list = []
+    load_ok = [0]
+    # (incarnation, model_step) samples per replica, appended in time order
+    # by the monitor — the no-restart/advance and restart evidence.
+    samples: dict[int, list[tuple[int, int]]] = {0: [], 1: []}
+    try:
+        for tid in (0, 1):
+            script = tmp_path / f"serve_task_{tid}.py"
+            script.write_text(
+                _SERVE_TASK_SCRIPT.format(
+                    root=ROOT, task_index=tid, ps_hosts=ps_hosts,
+                    serve_hosts=serve_hosts,
+                )
+            )
+            env = dict(env_base)
+            if tid == 0:
+                # Replica 0 dies once it has served 250 requests — mid-load
+                # (the pool's round-robin reaches it within seconds), well
+                # past startup/stats chatter.
+                env["DTX_FAULT_PLAN"] = "die:role=serve0,after_reqs=250"
+            logf = open(tmp_path / f"serve_task_{tid}.log", "w")
+            logs.append(logf)
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, str(script)],
+                    stdout=logf, stderr=subprocess.STDOUT, env=env, cwd=ROOT,
+                )
+            )
+
+        pool = serve.ServePool(
+            [("127.0.0.1", p) for p in serve_ports], role="load_sv",
+            op_timeout_s=10.0, eject_s=1.0, deadline_s=120.0,
+        )
+        x = next(_blob_batches(5, batch=4))["image"]
+
+        def load_body():
+            # Continuous client load: EVERY logical predict must succeed —
+            # overload/unavailable/transport gaps are absorbed by the
+            # pool's rotation + retry, the kill by its ejection window.
+            while not stop_load.is_set():
+                try:
+                    step, out = pool.predict({"image": x})
+                    assert step >= 0 and out["output"].shape == (4, 10)
+                    load_ok[0] += 1
+                except BaseException as e:  # noqa: BLE001
+                    load_errors.append(e)
+                    return
+                time.sleep(0.005)
+
+        def monitor_body():
+            clients: dict[int, object] = {}
+            while not stop_load.is_set():
+                for i, p in enumerate(serve_ports):
+                    try:
+                        c = clients.get(i)
+                        if c is None:
+                            c = serve.ServeClient(
+                                "127.0.0.1", p, role="mon_sv",
+                                op_timeout_s=5.0, reconnect_deadline_s=0.0,
+                            )
+                            clients[i] = c
+                        st = c.stats()
+                        samples[i].append(
+                            (int(st["incarnation"]), int(st["model_step"]))
+                        )
+                    except Exception:
+                        clients.pop(i, None)  # replica down/restarting
+                time.sleep(0.1)
+            for c in clients.values():
+                try:
+                    c.close()
+                except Exception:
+                    pass
+
+        # Both replicas answer stats before load starts (NO_MODEL is fine
+        # at this point — the chief has not published yet).
+        deadline = time.time() + 120
+        for p in serve_ports:
+            while True:
+                try:
+                    c = serve.ServeClient(
+                        "127.0.0.1", p, role="probe_sv",
+                        op_timeout_s=5.0, reconnect_deadline_s=0.0,
+                    )
+                    c.stats()
+                    c.close()
+                    break
+                except (OSError, serve.ServeError):
+                    assert time.time() < deadline, (
+                        f"serve replica at port {p} never came up"
+                    )
+                    time.sleep(0.2)
+
+        loaders = [threading.Thread(target=load_body) for _ in range(2)]
+        mon = threading.Thread(target=monitor_body)
+        for t in loaders:
+            t.start()
+        mon.start()
+
+        # The REAL training run: chief + 2 workers in this process against
+        # the same 2-shard PS the replicas track; every applied update is
+        # published to the store the replicas poll.
+        chief = _run_socket_training(
+            steps=40,
+            ps_addrs=[("127.0.0.1", p) for p in ps_ports],
+            reconnect_deadline_s=90.0, join_timeout=240.0,
+            stop_servers=False,
+        )
+        assert chief.global_step == 40
+
+        # Keep the load running until replica 0's RESTART is visible (a
+        # second incarnation answering stats) and both replicas track the
+        # final published step — then the heal is complete end to end.
+        deadline = time.time() + 150
+        while time.time() < deadline:
+            incs0 = {inc for inc, _ in samples[0]}
+            caught_up = all(
+                any(step == 40 for _, step in samples[i]) for i in (0, 1)
+            )
+            if len(incs0) >= 2 and caught_up and not load_errors:
+                break
+            if load_errors:
+                break
+            time.sleep(0.2)
+
+        # Final correctness: the pool's answer at the final step matches a
+        # local apply of the chief's final params bit-for-bit shape-wise.
+        step, out = pool.predict({"image": x})
+        assert step == 40, step
+        want = np.asarray(models.mlp.apply(CFG, chief.params, x))
+        np.testing.assert_allclose(out["output"], want, rtol=1e-4, atol=1e-5)
+
+        stop_load.set()
+        for t in loaders:
+            t.join(timeout=30)
+        mon.join(timeout=30)
+
+        # ZERO failed client requests across the kill+restart.
+        assert not load_errors, load_errors
+        assert load_ok[0] > 50, load_ok
+        # Every replica's served step ADVANCED within one incarnation (hot
+        # tracking, not restart): some incarnation shows >= 2 distinct
+        # steps.
+        for i in (0, 1):
+            by_inc: dict[int, set[int]] = {}
+            for inc, step in samples[i]:
+                by_inc.setdefault(inc, set()).add(step)
+            assert any(
+                len(steps - {-1}) >= 2 for steps in by_inc.values()
+            ), (i, by_inc)
+        # Replica 0 really restarted (two incarnations seen) and the healed
+        # incarnation re-pulled the current params.
+        incs0 = [inc for inc, _ in samples[0]]
+        assert len(set(incs0)) >= 2, set(incs0)
+        last_inc0 = incs0[-1]
+        assert any(
+            inc == last_inc0 and step == 40 for inc, step in samples[0]
+        ), samples[0][-10:]
+
+        # Clean shutdown of both replicas (the healed second incarnation of
+        # replica 0 included).
+        pool.close()
+        for p in serve_ports:
+            ctl = serve.ServeClient(
+                "127.0.0.1", p, role="ctl_sv", op_timeout_s=10.0,
+            )
+            ctl.shutdown_server()
+            ctl.close()
+        for pr in procs:
+            pr.wait(timeout=60)
+    finally:
+        stop_load.set()
+        for pr in procs:
+            if pr.poll() is None:
+                pr.kill()
+                pr.wait()
+        for f in logs:
+            f.close()
+        ps_service.stop_server()
+    log0 = (tmp_path / "serve_task_0.log").read_text()
+    log1 = (tmp_path / "serve_task_1.log").read_text()
+    # Replica 0: injected death fired, supervisor healed the plan, second
+    # incarnation served to clean shutdown.  Replica 1: no death at all.
+    assert "event=inject_die" in log0, log0[-2000:]
+    assert "event=supervisor_healed_plan" in log0, log0[-2000:]
+    assert "SERVE_DONE" in log0, log0[-2000:]
+    assert "event=inject_die" not in log1, log1[-2000:]
+    assert "SERVE_DONE" in log1, log1[-2000:]
+    assert procs[0].returncode == 0 and procs[1].returncode == 0
 
 
 @pytest.mark.slow

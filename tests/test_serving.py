@@ -1,0 +1,714 @@
+"""Online inference plane (r10 tentpole): batcher semantics, wire service
+identity, PS hot-tracking, and batched/unbatched output parity.
+
+The serving plane is the first consumer of the parameter-store substrate
+that is not a training worker: replicas track the published (step, params)
+snapshot with versioned pulls, coalesce predict requests into one jitted
+apply, and stamp every response with the served ``model_step``.  These
+tests pin the pieces the fault matrix (tests/test_faults.py) then composes:
+
+- DynamicBatcher: coalesce-to-full, flush-on-timeout, bounded-queue
+  OVERLOAD admission control, oversized-request carry, error propagation.
+- HELLO service identity: every wrong-service dial (ps/dsvc/msrv in any
+  pairing) fails the connect loudly naming both ends.
+- ModelReplicaServer: served ``model_step`` advances after a PS publish
+  with NO restart; outputs are byte-identical batched vs unbatched (the
+  padded-apply contract); OVERLOAD surfaces to clients as the typed error.
+- LatencyRecorder: percentile/qps scalar family naming.
+- perf_gate: the serving_qps baseline registration + batched-speedup bound.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_examples_tpu import serve
+from distributed_tensorflow_examples_tpu.data import data_service as dsvc
+from distributed_tensorflow_examples_tpu.parallel import (
+    ps_service,
+    ps_shard,
+    wire,
+)
+from distributed_tensorflow_examples_tpu.serve import batcher as batcher_lib
+from distributed_tensorflow_examples_tpu.utils import metrics
+
+D = 16
+
+
+def _init_fn(rng):
+    import jax.numpy as jnp
+
+    return {"w": jnp.zeros((D, 4), jnp.float32), "b": jnp.zeros((4,), jnp.float32)}
+
+
+def _predict_fn(params, batch):
+    return batch["x"] @ params["w"] + params["b"]
+
+
+def _publish(addrs, step, scale=1.0):
+    """The chief's publish path (ShardedParamStore.set — what
+    RemotePSChief._publish runs) with deterministic step-dependent values."""
+    group = ps_shard.ShardedPSClients(addrs, role="pub", op_timeout_s=10.0)
+    layout = ps_shard.ShardLayout(D * 4 + 4, len(addrs))
+    pstore = ps_shard.ShardedParamStore(group, "params", layout)
+    flat = scale * np.arange(D * 4 + 4, dtype=np.float32) / (D * 4 + 4)
+    pstore.set(step, flat)
+    return group, pstore, flat
+
+
+def _params_of(flat):
+    # jax.tree.flatten orders dict leaves by sorted key: "b" before "w".
+    return {
+        "b": flat[:4],
+        "w": flat[4:].reshape(D, 4),
+    }
+
+
+# ----------------------------------------------------------------------------
+# DynamicBatcher
+# ----------------------------------------------------------------------------
+
+
+def test_batcher_coalesces_concurrent_requests_into_one_apply():
+    applies: list[list] = []
+
+    def run_batch(items):
+        applies.append(items)
+        return [sum(it) for it in items]
+
+    b = batcher_lib.DynamicBatcher(
+        run_batch, max_batch=8, max_wait_ms=500.0, queue_depth=64
+    )
+    try:
+        results = [None] * 8
+        barrier = threading.Barrier(8)
+
+        def submit(i):
+            barrier.wait()
+            results[i] = b.submit([i, i], rows=1).result(timeout_s=10.0)
+
+        ts = [threading.Thread(target=submit, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert results == [2 * i for i in range(8)]
+        # 8 concurrent submits under a 500 ms window with max_batch=8:
+        # ONE full flush, not eight applies.
+        assert len(applies) == 1 and len(applies[0]) == 8
+        s = b.stats()
+        assert s["flush_full"] == 1 and s["batches"] == 1
+        assert s["rows_batched"] == 8 and s["inflight"] == 0
+    finally:
+        b.stop()
+
+
+def test_batcher_flushes_lone_request_on_timeout():
+    b = batcher_lib.DynamicBatcher(
+        lambda items: [len(items)], max_batch=8, max_wait_ms=40.0
+    )
+    try:
+        t0 = time.monotonic()
+        out = b.submit("x").result(timeout_s=10.0)
+        dt = time.monotonic() - t0
+        assert out == 1
+        assert dt >= 0.030, dt  # the window was honored (lone request waits)
+        s = b.stats()
+        assert s["flush_timeout"] == 1 and s["flush_full"] == 0
+        assert s["last_batch_rows"] == 1
+    finally:
+        b.stop()
+
+
+def test_batcher_overload_is_immediate_and_bounded():
+    gate = threading.Event()
+
+    def run_batch(items):
+        gate.wait(timeout=30.0)
+        return list(items)
+
+    b = batcher_lib.DynamicBatcher(
+        run_batch, max_batch=1, max_wait_ms=1.0, queue_depth=2
+    )
+    try:
+        t1 = b.submit("a")
+        t2 = b.submit("b")
+        # Two in-system requests at depth 2: admission control refuses the
+        # third IMMEDIATELY (no queuing, no blocking).
+        t0 = time.monotonic()
+        with pytest.raises(batcher_lib.Overloaded):
+            b.submit("c")
+        assert time.monotonic() - t0 < 1.0
+        assert b.stats()["overloads"] == 1
+        gate.set()
+        assert t1.result(timeout_s=10.0) == "a"
+        assert t2.result(timeout_s=10.0) == "b"
+        # Drained: admission reopens.
+        assert b.submit("d").result(timeout_s=10.0) == "d"
+    finally:
+        gate.set()
+        b.stop()
+
+
+def test_batcher_row_budget_carries_overflow_and_runs_oversized_alone():
+    sizes: list[list[int]] = []
+
+    def run_batch(items):
+        sizes.append([r for r in items])
+        return list(items)
+
+    b = batcher_lib.DynamicBatcher(
+        run_batch, max_batch=4, max_wait_ms=300.0, queue_depth=64
+    )
+    try:
+        # 3 + 3 rows: the second request would overflow the 4-row budget,
+        # so it is CARRIED whole into the next batch — never split.
+        t1 = b.submit(3, rows=3)
+        t2 = b.submit(3, rows=3)
+        assert t1.result(timeout_s=10.0) == 3
+        assert t2.result(timeout_s=10.0) == 3
+        assert sizes == [[3], [3]]
+        # A lone request larger than max_batch runs as its own batch.
+        t3 = b.submit(9, rows=9)
+        assert t3.result(timeout_s=10.0) == 9
+        assert sizes[-1] == [9]
+    finally:
+        b.stop()
+
+
+def test_batcher_apply_error_reaches_every_submitter():
+    def run_batch(items):
+        raise ValueError("bad apply")
+
+    b = batcher_lib.DynamicBatcher(run_batch, max_batch=4, max_wait_ms=50.0)
+    try:
+        t1, t2 = b.submit("a"), b.submit("b")
+        for t in (t1, t2):
+            with pytest.raises(ValueError, match="bad apply"):
+                t.result(timeout_s=10.0)
+        assert b.stats()["inflight"] == 0  # errors still release admission
+    finally:
+        b.stop()
+
+
+# ----------------------------------------------------------------------------
+# HELLO service identity (the r10 wire satellite)
+# ----------------------------------------------------------------------------
+
+
+def test_hello_answer_helper_matrix():
+    V = wire.WIRE_VERSION
+    # Right service, right version: success + tag.
+    st, tag = wire.hello_answer(V, wire.pack_hello_b(0, service="msrv"), service="msrv")
+    assert st == V and tag == b"msrv"
+    # No announcement (legacy): accepted.
+    st, tag = wire.hello_answer(V, 0, service="dsvc")
+    assert st == V and tag == b"dsvc"
+    # Wrong service: refused with a status naming the ANSWERING service.
+    st, tag = wire.hello_answer(V, wire.pack_hello_b(0, service="ps"), service="msrv")
+    assert tag is None and wire.unpack_wrong_service(st) == "msrv"
+    # Bad version / bad dtype: plain -1.
+    assert wire.hello_answer(V + 1, 0, service="msrv")[0] == -1
+    assert wire.hello_answer(V, 1, service="msrv")[0] == -1
+    # The announcement bits coexist with the shard-identity bits.
+    b = wire.pack_hello_b(1, 3, 7, service="ps")
+    assert b & 0xFF == 1
+    assert wire.hello_expected_service(b) == "ps"
+    assert (b >> wire.HELLO_SHARD_ID_SHIFT) & wire.HELLO_SHARD_MASK == 3
+    assert (b >> wire.HELLO_SHARD_COUNT_SHIFT) & wire.HELLO_SHARD_MASK == 7
+    # hello_failure: success answers None, everything else names both ends.
+    assert wire.hello_failure(V, b"msrv", service="msrv", host="h", port=1) is None
+    msg = wire.hello_failure(
+        wire.wrong_service_status("dsvc"), None, service="msrv", host="h", port=1
+    )
+    assert "data service" in msg and "msrv" in msg
+    msg = wire.hello_failure(V, None, service="dsvc", host="h", port=1)
+    assert "PS state service" in msg and "not a data service" in msg
+
+
+def test_every_wrong_service_dial_fails_loudly():
+    """The full 3-service pairing matrix: dialing any service with another
+    service's client fails the CONNECT naming both ends — never misparses
+    op codes, never silently serves."""
+    ps_port = ps_service.start_server(0)
+    dsrv = dsvc.DataServiceServer(
+        [{"image": np.zeros((8, 4), np.uint8), "label": np.zeros(8, np.int64)}],
+        batch_size=4,
+    )
+    msrv = serve.ModelReplicaServer(
+        _init_fn, _predict_fn, [("127.0.0.1", ps_port)], role="srv_t"
+    )
+    try:
+        with pytest.raises(dsvc.DSVCError, match="model-serving"):
+            dsvc.DataServiceClient(
+                "127.0.0.1", msrv.port, role="x_ds", reconnect_deadline_s=0.0
+            )
+        with pytest.raises(serve.ServeError, match="data service"):
+            serve.ServeClient(
+                "127.0.0.1", dsrv.port, role="x_sv", reconnect_deadline_s=0.0
+            )
+        with pytest.raises(serve.ServeError, match="PS state service"):
+            serve.ServeClient(
+                "127.0.0.1", ps_port, role="x_sv", reconnect_deadline_s=0.0
+            )
+        # The PS client HELLOs whenever it carries an expectation (shard or
+        # bf16); both must refuse loudly against a serving replica.
+        with pytest.raises(ps_service.PSError, match="model-serving"):
+            ps_service.PSClient(
+                "127.0.0.1", msrv.port, timeout_s=5.0, expect_shard=(0, 1)
+            )
+        with pytest.raises(ps_service.PSError, match="data service"):
+            ps_service.PSClient(
+                "127.0.0.1", dsrv.port, timeout_s=5.0, wire_dtype="bf16"
+            )
+        # Correct dials still work after the refusals.
+        c = ps_service.PSClient("127.0.0.1", ps_port, timeout_s=5.0,
+                                expect_shard=(0, 1))
+        c.ping()
+        c.close()
+    finally:
+        msrv.stop()
+        dsrv.stop()
+        ps_service.stop_server()
+
+
+# ----------------------------------------------------------------------------
+# ModelReplicaServer: hot-tracking + parity + overload
+# ----------------------------------------------------------------------------
+
+
+def test_model_step_advances_after_publish_without_restart():
+    ports = [ps_service.start_server(0, shard_id=i, shard_count=2) for i in (0, 1)]
+    addrs = [("127.0.0.1", p) for p in ports]
+    group, pstore, flat0 = _publish(addrs, step=0, scale=1.0)
+    srv = serve.ModelReplicaServer(
+        _init_fn, _predict_fn, addrs, max_batch=8, max_wait_ms=2.0,
+        refresh_ms=10.0, role="srv_t",
+    )
+    try:
+        assert srv.wait_for_model(30.0)
+        c = serve.ServeClient("127.0.0.1", srv.port, role="t_sv")
+        x = np.random.default_rng(0).normal(size=(3, D)).astype(np.float32)
+        step, out = c.predict({"x": x})
+        assert step == 0
+        np.testing.assert_allclose(
+            out["output"], x @ _params_of(flat0)["w"] + _params_of(flat0)["b"],
+            rtol=1e-5,
+        )
+        incarnation0 = c.stats()["incarnation"]
+        # The chief publishes a new update: the replica's served step must
+        # advance via the versioned-pull refresher — no restart, same
+        # incarnation.
+        flat7 = 3.0 * flat0
+        pstore.set(7, flat7)
+        deadline = time.monotonic() + 30
+        while True:
+            step, out = c.predict({"x": x})
+            if step == 7:
+                break
+            assert time.monotonic() < deadline, "model_step never advanced"
+            time.sleep(0.02)
+        np.testing.assert_allclose(
+            out["output"], x @ _params_of(flat7)["w"] + _params_of(flat7)["b"],
+            rtol=1e-5,
+        )
+        st = c.stats()
+        assert st["incarnation"] == incarnation0  # hot update, not restart
+        assert st["model_step"] == 7
+        assert st["refreshes"] >= 2
+        # The latency family rides the STATS payload under the
+        # shard_scalars-style naming (dashboards glob serve/latency_*).
+        assert "serve/latency_p50_ms" in st and "serve/qps" in st
+        c.close()
+    finally:
+        srv.stop()
+        group.close()
+        ps_service.stop_server()
+
+
+def test_extension_dtype_predict_round_trips_bf16():
+    """The example models compute in bf16 by default, so the serving wire
+    must move ml_dtypes extension dtypes BOTH ways: PEP 3118 has no format
+    code for them (memoryview casts raise), and their ``dtype.str`` is a
+    void '<V2' that would silently decode as raw bytes — the codec must
+    use uint8 views and the registered dtype NAME instead (the r10 CLI
+    drive caught exactly this)."""
+    import ml_dtypes
+
+    ports = [ps_service.start_server(0, shard_id=0, shard_count=1)]
+    addrs = [("127.0.0.1", p) for p in ports]
+    group, pstore, flat0 = _publish(addrs, step=0, scale=1.0)
+
+    def bf16_predict(params, batch):
+        import jax.numpy as jnp
+
+        x = batch["x"].astype(jnp.bfloat16)
+        return (x @ params["w"].astype(jnp.bfloat16)).astype(jnp.bfloat16)
+
+    srv = serve.ModelReplicaServer(
+        _init_fn, bf16_predict, addrs, max_batch=8, max_wait_ms=2.0,
+        refresh_ms=10.0, role="srv_bf",
+    )
+    try:
+        assert srv.wait_for_model(30.0)
+        c = serve.ServeClient("127.0.0.1", srv.port, role="bf_sv")
+        x = np.random.default_rng(3).normal(size=(4, D)).astype(np.float32)
+        # bf16 INPUTS must survive the client-side encode too.
+        xb = x.astype(ml_dtypes.bfloat16)
+        step, out = c.predict({"x": xb})
+        assert step == 0
+        assert out["output"].dtype == np.dtype(ml_dtypes.bfloat16)
+        expect = (
+            xb.astype(np.float32) @ _params_of(flat0)["w"]
+        ).astype(ml_dtypes.bfloat16)
+        np.testing.assert_allclose(
+            out["output"].astype(np.float32), expect.astype(np.float32),
+            rtol=0.05, atol=0.05,
+        )
+        c.close()
+    finally:
+        srv.stop()
+        group.close()
+        ps_service.stop_server()
+
+
+def test_batched_and_unbatched_outputs_byte_identical():
+    """The padded-apply contract: a request's output rows are bitwise
+    identical whether it was served alone or coalesced with 7 peers —
+    padding keeps every apply at ONE shape, and row-wise models make the
+    other rows inert."""
+    port = ps_service.start_server(0)
+    addrs = [("127.0.0.1", port)]
+    group, _, _ = _publish(addrs, step=0)
+    srv = serve.ModelReplicaServer(
+        _init_fn, _predict_fn, addrs, max_batch=8, max_wait_ms=60.0,
+        refresh_ms=10.0, role="srv_t",
+    )
+    try:
+        assert srv.wait_for_model(30.0)
+        rng = np.random.default_rng(1)
+        xs = [rng.normal(size=(1, D)).astype(np.float32) for _ in range(8)]
+        # Unbatched reference: one connection, strictly sequential — each
+        # request flushes alone (on the generous window, as a 1-row batch).
+        solo = serve.ServeClient("127.0.0.1", srv.port, role="solo_sv")
+        ref = [solo.predict({"x": x})[1]["output"] for x in xs]
+        flushes_before = srv.stats()["batcher_batches"]
+        # Batched: 8 concurrent clients, coalesced into one full apply.
+        outs: list = [None] * 8
+        barrier = threading.Barrier(8)
+
+        def body(i):
+            c = serve.ServeClient("127.0.0.1", srv.port, role=f"b{i}_sv")
+            barrier.wait()
+            outs[i] = c.predict({"x": xs[i]})[1]["output"]
+            c.close()
+
+        ts = [threading.Thread(target=body, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert all(o is not None for o in outs)
+        for i in range(8):
+            # Byte-identical, not allclose: same padded shape, same kernel,
+            # row-independent math.
+            assert np.array_equal(ref[i], outs[i]), i
+        st = srv.stats()
+        assert st["batcher_flush_full"] >= 1  # the 8 really coalesced
+        assert st["batcher_batches"] >= flushes_before + 1
+        solo.close()
+    finally:
+        srv.stop()
+        group.close()
+        ps_service.stop_server()
+
+
+def test_overload_answers_explicit_status_and_recovers():
+    port = ps_service.start_server(0)
+    addrs = [("127.0.0.1", port)]
+    group, _, _ = _publish(addrs, step=0)
+    # A slow apply + depth 2: concurrent load must trip admission control.
+    import jax.numpy as jnp
+
+    def slow_predict(params, batch):
+        return batch["x"] @ params["w"] + params["b"] + 0 * jnp.sum(
+            batch["x"] ** 2
+        )
+
+    srv = serve.ModelReplicaServer(
+        _init_fn, slow_predict, addrs, max_batch=1, max_wait_ms=1.0,
+        queue_depth=2, refresh_ms=10.0, role="srv_t",
+    )
+    try:
+        assert srv.wait_for_model(30.0)
+        x = np.ones((1, D), np.float32)
+        n_overload = [0]
+        n_ok = [0]
+
+        def hammer(i):
+            c = serve.ServeClient("127.0.0.1", srv.port, role=f"h{i}_sv")
+            for _ in range(25):
+                try:
+                    c.predict({"x": x})
+                    n_ok[0] += 1
+                except serve.ServeOverloadError:
+                    n_overload[0] += 1
+            c.close()
+
+        ts = [threading.Thread(target=hammer, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert n_ok[0] > 0
+        assert n_overload[0] > 0, "depth-2 admission control never tripped"
+        assert srv.stats()["overloads"] == n_overload[0]
+        # The replica recovers once load stops: a fresh request succeeds.
+        c = serve.ServeClient("127.0.0.1", srv.port, role="after_sv")
+        step, out = c.predict({"x": x})
+        assert step == 0 and out["output"].shape == (1, 4)
+        c.close()
+    finally:
+        srv.stop()
+        group.close()
+        ps_service.stop_server()
+
+
+def test_pool_round_robins_and_ejects_dead_replica():
+    port = ps_service.start_server(0)
+    addrs = [("127.0.0.1", port)]
+    group, _, _ = _publish(addrs, step=0)
+    srv1 = serve.ModelReplicaServer(
+        _init_fn, _predict_fn, addrs, max_wait_ms=2.0, refresh_ms=10.0,
+        role="srv_a",
+    )
+    srv2 = serve.ModelReplicaServer(
+        _init_fn, _predict_fn, addrs, max_wait_ms=2.0, refresh_ms=10.0,
+        role="srv_b",
+    )
+    try:
+        assert srv1.wait_for_model(30.0) and srv2.wait_for_model(30.0)
+        pool = serve.ServePool(
+            [("127.0.0.1", srv1.port), ("127.0.0.1", srv2.port)],
+            role="pool_sv", op_timeout_s=5.0, eject_s=0.5, deadline_s=30.0,
+        )
+        x = np.ones((2, D), np.float32)
+        seen = set()
+        for _ in range(6):
+            pool.predict({"x": x})
+            seen.add(pool.last_replica)
+        assert seen == {0, 1}  # round-robin reached both replicas
+        # Kill replica 0: the pool ejects it and every request still
+        # succeeds on the survivor — zero failed client requests.
+        srv1.stop()
+        for _ in range(10):
+            step, out = pool.predict({"x": x})
+            assert step == 0 and out["output"].shape == (2, 4)
+        assert pool.ejections >= 1
+        assert pool.last_replica == 1
+        pool.close()
+    finally:
+        for s in (srv1, srv2):
+            try:
+                s.stop()
+            except Exception:
+                pass
+        group.close()
+        ps_service.stop_server()
+
+
+def test_mismatched_schema_cannot_poison_a_neighbours_batch():
+    """Requests coalesce only with schema-identical neighbours: a client
+    sending the wrong trailing shape fails ALONE (typed rejection), while
+    schema-matched concurrent requests keep succeeding — and at the
+    batcher level, differing keys land in separate applies."""
+    applies: list[list] = []
+
+    def run_batch(items):
+        applies.append(list(items))
+        return items
+
+    b = batcher_lib.DynamicBatcher(
+        run_batch, max_batch=8, max_wait_ms=50.0, queue_depth=64
+    )
+    try:
+        ts = [
+            b.submit(f"a{i}" if i % 2 == 0 else f"b{i}",
+                     key="A" if i % 2 == 0 else "B")
+            for i in range(6)
+        ]
+        for t in ts:
+            t.result(timeout_s=10.0)
+        assert len(applies) >= 2  # alternating keys can never share one
+        for batch in applies:
+            assert len({it[0] for it in batch}) == 1  # key-homogeneous
+    finally:
+        b.stop()
+
+    port = ps_service.start_server(0)
+    addrs = [("127.0.0.1", port)]
+    group, _, _ = _publish(addrs, step=0)
+    srv = serve.ModelReplicaServer(
+        _init_fn, _predict_fn, addrs, max_batch=8, max_wait_ms=20.0,
+        refresh_ms=10.0, role="srv_mix",
+    )
+    try:
+        assert srv.wait_for_model(30.0)
+        good = serve.ServeClient("127.0.0.1", srv.port, role="good_sv")
+        bad = serve.ServeClient("127.0.0.1", srv.port, role="bad_sv")
+        x = np.ones((2, D), np.float32)
+        stop = threading.Event()
+        failures: list[BaseException] = []
+
+        def good_loop():
+            while not stop.is_set():
+                try:
+                    step, out = good.predict({"x": x})
+                    assert out["output"].shape == (2, 4)
+                except BaseException as e:  # noqa: BLE001 — the assertion
+                    failures.append(e)
+                    return
+
+        th = threading.Thread(target=good_loop)
+        th.start()
+        try:
+            # Wrong trailing dim: same field name, so only the schema key
+            # keeps it out of the good client's batches.  It must fail
+            # alone, every time, while the good stream never errors.
+            for _ in range(20):
+                with pytest.raises(serve.ServeRejectedError):
+                    bad.predict({"x": np.ones((2, D + 1), np.float32)})
+        finally:
+            stop.set()
+            th.join(timeout=30.0)
+        assert not failures, f"well-formed neighbour failed: {failures[0]!r}"
+        good.close()
+        bad.close()
+    finally:
+        srv.stop()
+        group.close()
+        ps_service.stop_server()
+
+
+def test_pool_surfaces_rejection_immediately_without_ejecting():
+    """An application-level rejection (the replica ANSWERED: bad request)
+    must reach the caller as ServeRejectedError at once — not bench the
+    healthy replica, not replay on peers until the deadline."""
+    port = ps_service.start_server(0)
+    addrs = [("127.0.0.1", port)]
+    group, _, _ = _publish(addrs, step=0)
+    srv = serve.ModelReplicaServer(
+        _init_fn, _predict_fn, addrs, max_wait_ms=2.0, refresh_ms=10.0,
+        role="srv_rej",
+    )
+    try:
+        assert srv.wait_for_model(30.0)
+        pool = serve.ServePool(
+            [("127.0.0.1", srv.port)], role="rej_sv", op_timeout_s=5.0,
+            deadline_s=30.0,
+        )
+        # Mismatched per-field leading dims: the replica's own validation
+        # answers ERR.
+        t0 = time.monotonic()
+        with pytest.raises(serve.ServeRejectedError):
+            pool.predict({
+                "x": np.ones((2, D), np.float32),
+                "y": np.ones((3, D), np.float32),
+            })
+        assert time.monotonic() - t0 < 5.0  # no deadline-long replay loop
+        assert pool.ejections == 0  # the healthy replica was not benched
+        step, out = pool.predict({"x": np.ones((2, D), np.float32)})
+        assert step == 0 and out["output"].shape == (2, 4)
+        pool.close()
+    finally:
+        srv.stop()
+        group.close()
+        ps_service.stop_server()
+
+
+# ----------------------------------------------------------------------------
+# LatencyRecorder (r10 metrics satellite)
+# ----------------------------------------------------------------------------
+
+
+def test_latency_recorder_percentiles_qps_and_naming():
+    r = metrics.LatencyRecorder(capacity=64)
+    assert r.percentile_scalars("serve") == {}  # empty: emit nothing
+    # 100 ops over 10 seconds of (synthetic) wall time, 1..100 ms.
+    for i in range(100):
+        r.record((i + 1) / 1e3, at=i * 0.1)
+    s = r.percentile_scalars("serve")
+    # The ring keeps the newest 64 (37..100 ms): percentiles over THAT
+    # window, qps over its timestamps (63 intervals across 6.3 s).
+    assert set(s) == {
+        "serve/latency_p50_ms", "serve/latency_p90_ms",
+        "serve/latency_p99_ms", "serve/qps",
+    }
+    assert s["serve/latency_p50_ms"] == pytest.approx(68.5, abs=1.0)
+    assert s["serve/latency_p99_ms"] <= 100.0
+    assert s["serve/qps"] == pytest.approx(10.0, rel=0.01)
+    assert len(r) == 64 and r.total == 100
+    # One op: percentiles defined, qps degrades to 0 (no interval).
+    r2 = metrics.LatencyRecorder()
+    r2.record(0.005)
+    s2 = r2.percentile_scalars("x")
+    assert s2["x/latency_p50_ms"] == pytest.approx(5.0)
+    assert s2["x/qps"] == 0.0
+
+
+# ----------------------------------------------------------------------------
+# perf_gate: serving registration + speedup bound
+# ----------------------------------------------------------------------------
+
+
+def test_perf_gate_serving_registration_and_speedup_bound():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate",
+        os.path.join(os.path.dirname(__file__), "..", "tools", "perf_gate.py"),
+    )
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+    assert pg.BASELINES["serving_qps"] == "serving_baseline.json"
+    good = {
+        "metric": "serving_qps",
+        "detail": {
+            "max_batch": 32,
+            "single": {"qps": 60.0, "stream_mbs_frac_memcpy": 4e-5},
+            "batched": {"qps": 600.0, "stream_mbs_frac_memcpy": 4e-4},
+            "batched_speedup": 10.0,
+        },
+    }
+    kw = dict(tolerance=0.25, if_newer_ratio=20.0)
+    assert pg.gate(good, good, **kw) == []
+    # A coalescing collapse (one apply per request) trips the bound from
+    # the result alone.
+    bad = {
+        "metric": "serving_qps",
+        "detail": {**good["detail"], "batched_speedup": 1.1},
+    }
+    fails = pg.gate(bad, good, **kw)
+    assert any("batched_speedup" in f for f in fails), fails
+    # A result that silently DROPPED the batched row also fails.
+    dropped = {"metric": "serving_qps", "detail": {
+        "max_batch": 32, "single": good["detail"]["single"],
+        "batched_speedup": None,
+    }}
+    fails = pg.gate(dropped, good, **kw)
+    assert any("missing" in f for f in fails), fails
+    # The memcpy-normalized floor still applies to the serving rows.
+    slow = {
+        "metric": "serving_qps",
+        "detail": {
+            **good["detail"],
+            "batched": {"qps": 600.0, "stream_mbs_frac_memcpy": 4e-6},
+        },
+    }
+    fails = pg.gate(slow, good, **kw)
+    assert any("batched.stream_mbs_frac_memcpy" in f for f in fails), fails

@@ -44,8 +44,9 @@ def fmt_bench(rec: dict, ok: str) -> str:
 
 def fmt_transport(rec: dict, ok: str) -> str:
     """Host-side transport/streaming benches (ps_transport_bench,
-    data_service_bench): one line per detail row, memcpy-normalized
-    fractions included — the numbers perf_gate compares."""
+    data_service_bench, serving_bench): one line per detail row,
+    memcpy-normalized fractions included — the numbers perf_gate
+    compares."""
     j = rec.get("json") or {}
     d = j.get("detail", {})
     if not j:
@@ -63,6 +64,11 @@ def fmt_transport(rec: dict, ok: str) -> str:
             f"    - remote_over_local={d['remote_over_local']} "
             "(disaggregation bound: >= 0.5)"
         )
+    if "batched_speedup" in d:
+        lines.append(
+            f"    - batched_speedup={d['batched_speedup']} "
+            "(micro-batching bound: >= 3.0 at max_batch=32)"
+        )
     return "\n".join(lines)
 
 
@@ -75,7 +81,7 @@ def main():
     for rec in state.get("steps", []):
         name = rec["name"]
         ok = "ok" if rec["rc"] == 0 else f"FAILED rc={rec['rc']}" + (" (timeout)" if rec.get("timed_out") else "")
-        if name in ("ps_transport_bench", "data_service_bench"):
+        if name in ("ps_transport_bench", "data_service_bench", "serving_bench"):
             print(fmt_transport(rec, ok))
         elif name.startswith("bench_"):
             print(fmt_bench(rec, ok))

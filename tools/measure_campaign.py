@@ -147,6 +147,12 @@ def steps_plan() -> list[dict]:
         dict(name="data_service_bench",
              cmd=[PY, "tools/data_service_bench.py"], timeout=900,
              cpu_ok=True),
+        # Online inference plane bench (r10): single vs micro-batched
+        # predict throughput through a PS-tracking replica on loopback —
+        # JAX-on-CPU only, so also a cpu_ok pre-wait step.
+        dict(name="serving_bench",
+             cmd=[PY, "tools/serving_bench.py"], timeout=900,
+             cpu_ok=True),
     ]
     return plan
 

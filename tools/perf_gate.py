@@ -34,6 +34,12 @@ Three kinds of checks, all deliberately host-portable:
    catastrophic regressions: a serialized gather that re-pulls the full
    vector per shard halves the row).  The bench records ``cpus`` for
    this; on >= 4-core hosts the full 1.3x bound applies.
+5. **serving batched speedup** (r10, ``tools/serving_bench.py`` results) —
+   micro-batched throughput under N concurrent clients must be at least
+   ``--serving-speedup`` (default 3.0: the ISSUE 5 acceptance bound) x the
+   single-client one-at-a-time throughput at ``max_batch`` >= 32, from the
+   result file alone: one jitted apply per coalesced batch, not one per
+   request.
 
 The default tolerance is generous (0.25: flag only when a normalized row
 drops below a QUARTER of baseline) — this is a tripwire for structural
@@ -57,6 +63,7 @@ import sys
 BASELINES = {
     "ps_transport_set_get_mbs": "ps_transport_baseline.json",
     "data_service_stream_mbs": "data_service_baseline.json",
+    "serving_qps": "serving_baseline.json",
 }
 
 
@@ -67,10 +74,36 @@ def _detail(rec: dict) -> dict:
 def gate(
     result: dict, baseline: dict, *, tolerance: float, if_newer_ratio: float,
     remote_local_ratio: float = 0.5, sharded_speedup: float = 1.3,
+    serving_speedup: float = 3.0,
 ) -> list[str]:
     """Returns a list of human-readable regression lines (empty = pass)."""
     res, base = _detail(result), _detail(baseline)
     failures: list[str] = []
+    # The r10 serving acceptance bound, from the result alone: coalescing
+    # concurrent requests into one jitted apply must genuinely amortize —
+    # batched (N concurrent clients) throughput >= serving_speedup x the
+    # one-at-a-time single-client throughput at the full max_batch=32
+    # budget.  A batcher that stopped coalescing (one apply per request)
+    # collapses this to ~1x no matter the host.
+    if (
+        isinstance(res.get("batched"), dict)
+        and isinstance(res.get("single"), dict)
+        and res.get("batched_speedup") is not None
+        and res.get("max_batch", 0) >= 32
+    ):
+        sp = res["batched_speedup"]
+        if sp < serving_speedup:
+            failures.append(
+                f"batched_speedup: {sp:.2f} < {serving_speedup} — "
+                "micro-batching no longer amortizing the apply "
+                "(coalescing broken?)"
+            )
+    if (
+        isinstance(base.get("batched"), dict)
+        and not isinstance(res.get("batched"), dict)
+        and base.get("batched_speedup") is not None
+    ):
+        failures.append("batched: row missing from result")
     # The r9 shard-scaling acceptance bound, from the result alone: the
     # sharded cold pull must genuinely parallelize.  Gated only at the
     # full 64 MB payload (the acceptance size); hosts too small to express
@@ -157,6 +190,7 @@ def main():
     ap.add_argument("--if-newer-ratio", type=float, default=20.0)
     ap.add_argument("--remote-local-ratio", type=float, default=0.5)
     ap.add_argument("--sharded-speedup", type=float, default=1.3)
+    ap.add_argument("--serving-speedup", type=float, default=3.0)
     args = ap.parse_args()
     with open(args.result) as f:
         result = json.load(f)
@@ -164,8 +198,14 @@ def main():
     if not baseline_path:
         name = BASELINES.get(result.get("metric", ""))
         if name is None:
-            print(f"PERF_GATE FAIL\n  unknown metric {result.get('metric')!r} "
-                  "and no --baseline given")
+            # Name the registered fields: an auto-select miss is almost
+            # always a typo'd/renamed metric, and the fix is picking one of
+            # these — a bare error would send the operator source-diving.
+            print(
+                f"PERF_GATE FAIL\n  unknown metric {result.get('metric')!r} "
+                "and no --baseline given\n  registered metric fields: "
+                + ", ".join(sorted(BASELINES))
+            )
             sys.exit(1)
         baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
     with open(baseline_path) as f:
@@ -175,6 +215,7 @@ def main():
         tolerance=args.tolerance, if_newer_ratio=args.if_newer_ratio,
         remote_local_ratio=args.remote_local_ratio,
         sharded_speedup=args.sharded_speedup,
+        serving_speedup=args.serving_speedup,
     )
     if failures:
         print("PERF_GATE FAIL")
