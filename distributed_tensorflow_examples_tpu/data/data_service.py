@@ -76,31 +76,34 @@ from . import filestream
 
 log = logging.getLogger("dtx.data_service")
 
-# Op codes (DSVC_*).  Disjoint from the PS server's 1..27 range except the
-# shared HELLO code point, so a frame sent to the wrong service is refused,
-# never misinterpreted.
-DSVC_HELLO = wire.HELLO_OP
-DSVC_REGISTER = 64
-DSVC_GET_SPLIT = 65
-DSVC_CLAIM_SPLIT = 66
-DSVC_GET_BATCH = 67
-DSVC_HEARTBEAT = 68
-DSVC_STATS = 69
-DSVC_GET_EVAL = 70
-DSVC_SHUTDOWN = 71
+# Op codes (DSVC_*) — aliases into the ONE registry (wire.DSVC_OPS).
+# Disjoint from the PS server's 1..27 range except the shared HELLO code
+# point, so a frame sent to the wrong service is refused, never
+# misinterpreted.  tools/dtxlint enforces the disjointness and refuses a
+# restated numeric literal outside parallel/wire.py.
+DSVC_HELLO = wire.DSVC_OPS["HELLO"]
+DSVC_REGISTER = wire.DSVC_OPS["REGISTER"]
+DSVC_GET_SPLIT = wire.DSVC_OPS["GET_SPLIT"]
+DSVC_CLAIM_SPLIT = wire.DSVC_OPS["CLAIM_SPLIT"]
+DSVC_GET_BATCH = wire.DSVC_OPS["GET_BATCH"]
+DSVC_HEARTBEAT = wire.DSVC_OPS["HEARTBEAT"]
+DSVC_STATS = wire.DSVC_OPS["STATS"]
+DSVC_GET_EVAL = wire.DSVC_OPS["GET_EVAL"]
+DSVC_SHUTDOWN = wire.DSVC_OPS["SHUTDOWN"]
 
 #: HELLO answer payload: the service tag a client must verify (one shared
 #: registry in parallel/wire.py — r10).
 SERVICE_TAG = wire.SERVICE_TAGS["dsvc"]
 
-# Response statuses (non-assignment ops: 0 ok, >0 op-specific, <0 error).
-OK = 0
-END_OF_SPLIT = 1  # GET_BATCH index past the split; GET_EVAL with no chunk
-CLAIM_DONE = 1  # CLAIM_SPLIT: already completed this epoch — skip it
-CLAIM_TAKEN = 2  # CLAIM_SPLIT: assigned to another live worker
-WAIT = -3  # GET_SPLIT: nothing pending right now — poll again
-EPOCH_ROLLED = -4  # GET_SPLIT: the epoch the client constrained to is over
-ERR = -2  # bad op / bad operands
+# Response statuses (non-assignment ops: 0 ok, >0 op-specific, <0 error) —
+# aliases into wire.DSVC_STATUS, the one definition site.
+OK = wire.DSVC_STATUS["OK"]
+END_OF_SPLIT = wire.DSVC_STATUS["END_OF_SPLIT"]
+CLAIM_DONE = wire.DSVC_STATUS["CLAIM_DONE"]
+CLAIM_TAKEN = wire.DSVC_STATUS["CLAIM_TAKEN"]
+WAIT = wire.DSVC_STATUS["WAIT"]
+EPOCH_ROLLED = wire.DSVC_STATUS["EPOCH_ROLLED"]
+ERR = wire.DSVC_STATUS["ERR"]
 
 
 class DSVCError(RuntimeError):
@@ -951,11 +954,18 @@ class RemoteDatasetSource:
                 index=self._cur[2],
             )
             return  # keep streaming the same split at the same index
-        # Completed already (an ack raced ahead) or taken by another worker:
-        # this split is no longer ours — drop it and move on.
+        # This split is no longer ours — drop it and move on.  The named
+        # claim statuses make the log line actionable: CLAIM_DONE means an
+        # ack raced ahead (the work already counted), CLAIM_TAKEN means a
+        # peer claimed it across the failover (at-least-once duplicate).
+        reason = (
+            "completed" if status == CLAIM_DONE
+            else "taken" if status == CLAIM_TAKEN
+            else f"status_{status}"
+        )
         faults.log_event(
             "dsvc_reclaim_lost", role=self._client.role, split=split,
-            status=status,
+            status=status, reason=reason,
         )
         self._cur = None
 

@@ -81,16 +81,36 @@ from .. import native
 from ..utils import faults
 from . import wire
 
-# Op codes (must match native/ps_server.cc).
-_ACC_GET, _ACC_APPLY, _ACC_TAKE, _ACC_SET_STEP, _ACC_DROPPED = 1, 2, 3, 4, 5
-_TQ_GET, _TQ_PUSH, _TQ_POP = 6, 7, 8
-_GQ_GET, _GQ_PUSH, _GQ_POP, _GQ_SET_MIN, _GQ_DROPPED = 9, 10, 11, 12, 13
-_CANCEL_ALL, _PING = 14, 15
-_PSTORE_GET_OBJ, _PSTORE_SET, _PSTORE_GET = 16, 17, 18
-_INCARNATION, _ACC_APPLY_TAGGED, _GQ_PUSH_TAGGED = 19, 20, 21
-_ACC_DEDUPED, _GQ_DEDUPED = 22, 23
-_ACC_RESET_WORKER, _GQ_RESET_WORKER = 24, 25
-_HELLO, _PSTORE_GET_IF_NEWER = wire.HELLO_OP, 27
+# Op codes — aliases into the ONE registry (wire.PS_OPS, the single Python
+# definition site; tools/dtxlint pins it against native/ps_server.cc's
+# enum Op by name and number).  Never restate the numbers here.
+_ACC_GET = wire.PS_OPS["ACC_GET"]
+_ACC_APPLY = wire.PS_OPS["ACC_APPLY"]
+_ACC_TAKE = wire.PS_OPS["ACC_TAKE"]
+_ACC_SET_STEP = wire.PS_OPS["ACC_SET_STEP"]
+_ACC_DROPPED = wire.PS_OPS["ACC_DROPPED"]
+_TQ_GET = wire.PS_OPS["TQ_GET"]
+_TQ_PUSH = wire.PS_OPS["TQ_PUSH"]
+_TQ_POP = wire.PS_OPS["TQ_POP"]
+_GQ_GET = wire.PS_OPS["GQ_GET"]
+_GQ_PUSH = wire.PS_OPS["GQ_PUSH"]
+_GQ_POP = wire.PS_OPS["GQ_POP"]
+_GQ_SET_MIN = wire.PS_OPS["GQ_SET_MIN"]
+_GQ_DROPPED = wire.PS_OPS["GQ_DROPPED"]
+_CANCEL_ALL = wire.PS_OPS["CANCEL_ALL"]
+_PING = wire.PS_OPS["PING"]
+_PSTORE_GET_OBJ = wire.PS_OPS["PSTORE_GET_OBJ"]
+_PSTORE_SET = wire.PS_OPS["PSTORE_SET"]
+_PSTORE_GET = wire.PS_OPS["PSTORE_GET"]
+_INCARNATION = wire.PS_OPS["INCARNATION"]
+_ACC_APPLY_TAGGED = wire.PS_OPS["ACC_APPLY_TAGGED"]
+_GQ_PUSH_TAGGED = wire.PS_OPS["GQ_PUSH_TAGGED"]
+_ACC_DEDUPED = wire.PS_OPS["ACC_DEDUPED"]
+_GQ_DEDUPED = wire.PS_OPS["GQ_DEDUPED"]
+_ACC_RESET_WORKER = wire.PS_OPS["ACC_RESET_WORKER"]
+_GQ_RESET_WORKER = wire.PS_OPS["GQ_RESET_WORKER"]
+_HELLO = wire.PS_OPS["HELLO"]
+_PSTORE_GET_IF_NEWER = wire.PS_OPS["PSTORE_GET_IF_NEWER"]
 
 #: Wire protocol version this client speaks (ps_server.cc kWireVersion).
 WIRE_VERSION = wire.WIRE_VERSION

@@ -157,15 +157,19 @@ class _ShardPool:
     of a scatter/gather.  Persistent (not per-op spawn) so the
     unchanged-step fast path — N parallel O(header) round trips — isn't
     dominated by thread start-up, and daemon so a leaked pool can never
-    wedge interpreter shutdown behind a blocked socket.  ``run`` is
-    serialized (one scatter/gather at a time per pool): each sharded
-    object owns its own pool, and its callers are single-threaded by
-    contract (the worker/chief loops)."""
+    wedge interpreter shutdown behind a blocked socket.
+
+    Each ``run`` call carries its OWN completion queue (r11, a dtxlint
+    blocking-under-lock fix): the pre-r11 pool serialized ``run`` with a
+    lock held across the blocking result gather, so one wedged shard leg
+    convoyed every other caller of the pool behind an unbounded wait.
+    Routing results by per-call queue needs no lock at all — concurrent
+    ``run`` calls can never cross-read each other's results, and per-shard
+    ordering still holds (each shard thread drains its task queue in FIFO
+    order)."""
 
     def __init__(self, n: int, name: str):
         self._tasks: list[queue.SimpleQueue] = [queue.SimpleQueue() for _ in range(n)]
-        self._done: queue.SimpleQueue = queue.SimpleQueue()
-        self._run_lock = threading.Lock()
         self._threads = [
             threading.Thread(
                 target=self._loop, args=(i,), daemon=True, name=f"{name}-s{i}"
@@ -177,32 +181,33 @@ class _ShardPool:
 
     def _loop(self, i: int) -> None:
         while True:
-            fn = self._tasks[i].get()
-            if fn is None:
+            item = self._tasks[i].get()
+            if item is None:
                 return
+            fn, done = item
             try:
-                self._done.put((i, fn(), None))
+                done.put((i, fn(), None))
             except BaseException as e:  # noqa: BLE001 — re-raised in run()
-                self._done.put((i, None, e))
+                done.put((i, None, e))
 
     def run(self, fns: dict[int, object]) -> dict[int, object]:
         """Execute ``fns[i]`` on shard thread ``i`` concurrently; returns
         the per-shard results.  The first per-shard exception is re-raised
         AFTER every leg completes (a half-landed scatter must not leave
         stray worker threads racing the caller's next op)."""
-        with self._run_lock:
-            for i, fn in fns.items():
-                self._tasks[i].put(fn)
-            out: dict[int, object] = {}
-            first_exc: BaseException | None = None
-            for _ in range(len(fns)):
-                i, r, e = self._done.get()
-                if e is not None and first_exc is None:
-                    first_exc = e
-                out[i] = r
-            if first_exc is not None:
-                raise first_exc
-            return out
+        done: queue.SimpleQueue = queue.SimpleQueue()
+        for i, fn in fns.items():
+            self._tasks[i].put((fn, done))
+        out: dict[int, object] = {}
+        first_exc: BaseException | None = None
+        for _ in range(len(fns)):
+            i, r, e = done.get()
+            if e is not None and first_exc is None:
+                first_exc = e
+            out[i] = r
+        if first_exc is not None:
+            raise first_exc
+        return out
 
     def close(self) -> None:
         for q in self._tasks:

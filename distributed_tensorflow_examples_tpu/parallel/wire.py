@@ -53,9 +53,95 @@ WIRE_VERSION = 2
 #: to wire v1; bf16 halves payload bytes and REQUIRES a negotiated peer.
 WIRE_DTYPES = {"f32": 0, "bf16": 1}
 
-#: The shared HELLO op code (ps_server.cc op 26; the data service reserves
-#: the same code point so one negotiation routine serves both wires).
-HELLO_OP = 26
+# ----------------------------------------------------------------------------
+# Protocol registries (r11): the ONE Python definition site for every op
+# code and service status the three wires speak.  Service modules alias
+# these names — they must never restate the numbers.  The native server's
+# ``enum Op`` is the C++ mirror of PS_OPS; ``tools/dtxlint``'s
+# wire-conformance pass pins the two against each other (names AND
+# numbers), checks that every client-sent opcode has a server dispatch case,
+# and refuses op/status collisions across services, so a renumbering in
+# one place can never silently drift.
+# ----------------------------------------------------------------------------
+
+#: PS state-service op codes (native/ps_server.cc ``enum Op``).
+PS_OPS: dict[str, int] = {
+    "ACC_GET": 1,
+    "ACC_APPLY": 2,
+    "ACC_TAKE": 3,
+    "ACC_SET_STEP": 4,
+    "ACC_DROPPED": 5,
+    "TQ_GET": 6,
+    "TQ_PUSH": 7,
+    "TQ_POP": 8,
+    "GQ_GET": 9,
+    "GQ_PUSH": 10,
+    "GQ_POP": 11,
+    "GQ_SET_MIN": 12,
+    "GQ_DROPPED": 13,
+    "CANCEL_ALL": 14,
+    "PING": 15,
+    "PSTORE_GET_OBJ": 16,
+    "PSTORE_SET": 17,
+    "PSTORE_GET": 18,
+    "INCARNATION": 19,
+    "ACC_APPLY_TAGGED": 20,
+    "GQ_PUSH_TAGGED": 21,
+    "ACC_DEDUPED": 22,
+    "GQ_DEDUPED": 23,
+    "ACC_RESET_WORKER": 24,
+    "GQ_RESET_WORKER": 25,
+    "HELLO": 26,
+    "PSTORE_GET_IF_NEWER": 27,
+}
+
+#: Data-service op codes (data/data_service.py).  Disjoint from the PS
+#: range except the shared HELLO code point, so a frame sent to the wrong
+#: service is refused, never misinterpreted.
+DSVC_OPS: dict[str, int] = {
+    "HELLO": 26,
+    "REGISTER": 64,
+    "GET_SPLIT": 65,
+    "CLAIM_SPLIT": 66,
+    "GET_BATCH": 67,
+    "HEARTBEAT": 68,
+    "STATS": 69,
+    "GET_EVAL": 70,
+    "SHUTDOWN": 71,
+}
+
+#: Serving-replica op codes (serve/model_server.py), disjoint from both.
+SRV_OPS: dict[str, int] = {
+    "HELLO": 26,
+    "PREDICT": 96,
+    "STATS": 97,
+    "SHUTDOWN": 98,
+}
+
+#: Data-service response statuses.  Positive codes are per-op results
+#: (END_OF_SPLIT and CLAIM_DONE deliberately share 1 — they answer
+#: different ops); negative codes are the error band and must stay unique.
+DSVC_STATUS: dict[str, int] = {
+    "OK": 0,
+    "END_OF_SPLIT": 1,  # GET_BATCH index past the split; GET_EVAL w/o chunk
+    "CLAIM_DONE": 1,  # CLAIM_SPLIT: already completed this epoch
+    "CLAIM_TAKEN": 2,  # CLAIM_SPLIT: assigned to another live worker
+    "ERR": -2,  # bad op / bad operands / handler failure
+    "WAIT": -3,  # GET_SPLIT: nothing pending right now — poll again
+    "EPOCH_ROLLED": -4,  # GET_SPLIT: the constrained epoch is over
+}
+
+#: Serving-replica response statuses.  PREDICT success answers the served
+#: model_step (>= 0) as the status, so only the error band is enumerated.
+SRV_STATUS: dict[str, int] = {
+    "ERR": -2,  # bad request / failed apply
+    "OVERLOAD": -7,  # admission control: queue full, back off / try a peer
+    "NO_MODEL": -8,  # replica up but no published snapshot yet (warming)
+}
+
+#: The shared HELLO op code (one code point for every service, so one
+#: negotiation routine serves all three wires).
+HELLO_OP = PS_OPS["HELLO"]
 
 # Sharded PS (r9): HELLO's b operand carries the SHARD IDENTITY the client
 # expects of the server it dialed — dtype code in bits 0..7, expected shard

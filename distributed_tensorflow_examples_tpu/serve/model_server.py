@@ -61,17 +61,19 @@ log = logging.getLogger("dtx.serve")
 SERVICE = "msrv"
 SERVICE_TAG = wire.SERVICE_TAGS[SERVICE]
 
-# Op codes (SRV_*), disjoint from the PS server's 1..27 and DSVC's 64..71.
-SRV_HELLO = wire.HELLO_OP
-SRV_PREDICT = 96
-SRV_STATS = 97
-SRV_SHUTDOWN = 98
+# Op codes (SRV_*) — aliases into the ONE registry (wire.SRV_OPS), disjoint
+# from the PS server's 1..27 and DSVC's 64..71 (dtxlint-enforced).
+SRV_HELLO = wire.SRV_OPS["HELLO"]
+SRV_PREDICT = wire.SRV_OPS["PREDICT"]
+SRV_STATS = wire.SRV_OPS["STATS"]
+SRV_SHUTDOWN = wire.SRV_OPS["SHUTDOWN"]
 
-# Response statuses.  PREDICT success answers the served model_step (>= 0)
-# as the status — the per-response staleness stamp costs zero extra bytes.
-ERR = -2
-OVERLOAD = -7  # admission control: queue full, back off / try a peer
-NO_MODEL = -8  # replica up but no published snapshot pulled yet (warming)
+# Response statuses (wire.SRV_STATUS aliases).  PREDICT success answers the
+# served model_step (>= 0) as the status — the per-response staleness stamp
+# costs zero extra bytes.
+ERR = wire.SRV_STATUS["ERR"]
+OVERLOAD = wire.SRV_STATUS["OVERLOAD"]
+NO_MODEL = wire.SRV_STATUS["NO_MODEL"]
 
 
 def flat_param_spec(init_fn):

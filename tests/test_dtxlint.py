@@ -1,0 +1,871 @@
+"""dtxlint (r11): the repo must lint clean, and each pass must actually
+catch the violation class it exists for.
+
+Two layers:
+
+- **Repo gate** — ``python -m tools.dtxlint`` over the real tree exits 0
+  with no active findings and no stale suppressions.  This is the tier-1
+  guardrail the unified-runtime/replication refactors (ROADMAP 1–2) lean
+  on: an opcode renumbering, a new blocking call under a lock, an
+  uncovered fault role or a drifted flag fails CI here, not in
+  production.
+- **Detector proofs** — synthetic mini-repo fixtures, one injected
+  violation per test, asserting the exact finding code fires.  A linter
+  whose checks silently stopped matching (AST shape drift, regex rot) is
+  worse than no linter — these tests are the linter's linter.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools import dtxlint  # noqa: E402
+from tools.dtxlint import LintConfig, apply_baseline, load_baseline  # noqa: E402
+from tools.dtxlint.__main__ import main as dtxlint_main  # noqa: E402
+
+# ---------------------------------------------------------------------------
+# Synthetic fixture repo: minimal but CLEAN under all four passes.  Each
+# test overrides exactly one file to inject exactly one violation.
+# ---------------------------------------------------------------------------
+
+_WIRE_PY = textwrap.dedent(
+    '''
+    WIRE_VERSION = 2
+    HELLO_SHARD_ID_SHIFT = 8
+    HELLO_SHARD_COUNT_SHIFT = 24
+    HELLO_SHARD_MASK = 0xFFFF
+    HELLO_SHARD_MISMATCH = -5
+    WRONG_SERVICE_BASE = -40
+    SERVICE_IDS = {"ps": 1, "dsvc": 2, "msrv": 3}
+    PS_OPS = {"PING": 15, "PSTORE_GET": 18, "HELLO": 26}
+    DSVC_OPS = {"HELLO": 26, "GET_BATCH": 67}
+    SRV_OPS = {"HELLO": 26, "PREDICT": 96}
+    DSVC_STATUS = {"OK": 0, "ERR": -2}
+    SRV_STATUS = {"ERR": -2, "OVERLOAD": -7}
+    '''
+)
+
+_PS_SERVER_CC = textwrap.dedent(
+    """
+    constexpr int kWireVersion = 2;
+    constexpr int kHelloShardIdShift = 8;
+    constexpr int kHelloShardCountShift = 24;
+    constexpr int kHelloShardMask = 0xFFFF;
+    constexpr int kTagWorkerShift = 40;
+    enum Op : int {
+      PING = 15,
+      PSTORE_GET = 18,
+      HELLO = 26,
+    };
+    int dispatch(int op) {
+      int status = 0;
+      switch (op) {
+        case PING:
+          break;
+        case PSTORE_GET:
+          break;
+        case HELLO:
+          status = -5 - 1;  // shard-identity mismatch answer
+          break;
+      }
+      return status;
+    }
+    """
+)
+
+_NATIVE_INIT_PY = textwrap.dedent(
+    """
+    def _tag(worker, seq):
+        assert 0 <= worker < (1 << 23)
+        return (worker << 40) | seq
+    """
+)
+
+_PS_SERVICE_PY = textwrap.dedent(
+    '''
+    from . import wire
+
+    _PING = wire.PS_OPS["PING"]
+    _PSTORE_GET = wire.PS_OPS["PSTORE_GET"]
+    _HELLO = wire.PS_OPS["HELLO"]
+
+
+    class PSClient:
+        def ping(self):
+            return self.call(_PING, 0, 0)
+
+        def get(self):
+            return self.call(_PSTORE_GET, 0, 0)
+
+        def hello(self):
+            return self.call(_HELLO, 0, 0)
+    '''
+)
+
+_DSVC_PY = textwrap.dedent(
+    '''
+    from . import wire
+
+    DSVC_HELLO = wire.DSVC_OPS["HELLO"]
+    DSVC_GET_BATCH = wire.DSVC_OPS["GET_BATCH"]
+    OK = wire.DSVC_STATUS["OK"]
+    ERR = wire.DSVC_STATUS["ERR"]
+
+
+    class DataServer:
+        def handle(self, op):
+            if op == DSVC_GET_BATCH:
+                return OK
+            if op == DSVC_HELLO:
+                return OK
+            return ERR
+
+
+    class DataServiceClient:
+        def get_batch(self):
+            status = self.call(DSVC_GET_BATCH, 0)
+            if status == ERR:
+                raise RuntimeError("err")
+            assert status == OK
+            return status
+    '''
+)
+
+_MSRV_PY = textwrap.dedent(
+    '''
+    from . import wire
+
+    SRV_HELLO = wire.SRV_OPS["HELLO"]
+    SRV_PREDICT = wire.SRV_OPS["PREDICT"]
+    ERR = wire.SRV_STATUS["ERR"]
+
+
+    class ModelReplicaServer:
+        def handle(self, op):
+            if op == SRV_PREDICT:
+                return 0
+            if op == SRV_HELLO:
+                return 0
+            return ERR
+    '''
+)
+
+_SERVE_CLIENT_PY = textwrap.dedent(
+    '''
+    from . import wire
+
+    SRV_PREDICT = wire.SRV_OPS["PREDICT"]
+    ERR = wire.SRV_STATUS["ERR"]
+    OVERLOAD = wire.SRV_STATUS["OVERLOAD"]
+
+
+    class ServeClient:
+        def predict(self):
+            status = self.call(SRV_PREDICT, 0)
+            if status == OVERLOAD:
+                raise RuntimeError("overload")
+            if status == ERR:
+                raise RuntimeError("err")
+            return status
+    '''
+)
+
+_CONC_PY = textwrap.dedent(
+    """
+    import threading
+    import time
+
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._aux_lock = threading.Lock()
+
+        def step(self):
+            with self._lock:
+                x = 1
+            time.sleep(0.0)
+            return x
+
+        def both(self):
+            with self._lock:
+                with self._aux_lock:
+                    return 2
+    """
+)
+
+_FAULTS_PY = textwrap.dedent(
+    """
+    _CLIENT_KINDS = ("drop_conn", "delay")
+    _KINDS = _CLIENT_KINDS + ("die",)
+    """
+)
+
+_ROLES_PY = textwrap.dedent(
+    """
+    def make_clients(role, shard):
+        prefetch = f"{role}_pf"
+        data = role + "_ds"
+        per_shard = f"{role}_s{shard}"
+        return prefetch, data, per_shard
+    """
+)
+
+_FAULT_TESTS_PY = textwrap.dedent(
+    """
+    PLANS = [
+        "drop_conn:role=worker0_pf",
+        "delay:role=worker0_ds,ms=5",
+        "die:role=ps0,after_reqs=3",
+        "drop_conn:role=worker0_s1",
+    ]
+    """
+)
+
+_FLAGS_PY = textwrap.dedent(
+    '''
+    from absl import flags
+
+    FLAGS = flags.FLAGS
+
+
+    def _define(kind, name, default, help_):
+        getattr(flags, "DEFINE_" + kind)(name, default, help_)
+
+
+    _define("integer", "train_steps", 100, "steps to run")
+    _define("string", "ps_hosts", "", "parameter server hostports")
+    '''
+)
+
+_FLAG_USE_PY = textwrap.dedent(
+    """
+    from utils.flags import FLAGS
+
+
+    def main():
+        print(FLAGS.train_steps)
+        print(FLAGS.ps_hosts)
+    """
+)
+
+_RUNBOOK_MD = textwrap.dedent(
+    """
+    # Runbook
+
+    Run with `--train_steps` and point `--ps_hosts` at the servers.
+    """
+)
+
+_FILES = {
+    "pkg/parallel/wire.py": _WIRE_PY,
+    "pkg/native/ps_server.cc": _PS_SERVER_CC,
+    "pkg/native/__init__.py": _NATIVE_INIT_PY,
+    "pkg/parallel/ps_service.py": _PS_SERVICE_PY,
+    "pkg/data/data_service.py": _DSVC_PY,
+    "pkg/serve/model_server.py": _MSRV_PY,
+    "pkg/serve/client.py": _SERVE_CLIENT_PY,
+    "pkg/conc/worker.py": _CONC_PY,
+    "pkg/utils/faults.py": _FAULTS_PY,
+    "pkg/roles/transport.py": _ROLES_PY,
+    "tests/test_faults.py": _FAULT_TESTS_PY,
+    "pkg/utils/flags.py": _FLAGS_PY,
+    "use/consume.py": _FLAG_USE_PY,
+    "RUNBOOK.md": _RUNBOOK_MD,
+}
+
+
+def make_cfg(tmp_path: Path, overrides: dict[str, str] | None = None) -> LintConfig:
+    """Write the fixture repo (plus per-test overrides) and wire a
+    LintConfig at it."""
+    files = dict(_FILES)
+    files.update(overrides or {})
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+    pkg = tmp_path / "pkg"
+    return LintConfig(
+        root=tmp_path,
+        wire_py=pkg / "parallel" / "wire.py",
+        ps_server_cc=pkg / "native" / "ps_server.cc",
+        native_init_py=pkg / "native" / "__init__.py",
+        ps_service_py=pkg / "parallel" / "ps_service.py",
+        service_files=[
+            pkg / "parallel" / "ps_service.py",
+            pkg / "data" / "data_service.py",
+            pkg / "serve" / "model_server.py",
+            pkg / "serve" / "client.py",
+        ],
+        dsvc_py=pkg / "data" / "data_service.py",
+        msrv_py=pkg / "serve" / "model_server.py",
+        serve_client_py=pkg / "serve" / "client.py",
+        concurrency_dirs=[pkg / "conc"],
+        faults_py=pkg / "utils" / "faults.py",
+        role_source_dirs=[pkg / "roles"],
+        fault_test_files=[tmp_path / "tests" / "test_faults.py"],
+        flags_py=pkg / "utils" / "flags.py",
+        runbook_md=tmp_path / "RUNBOOK.md",
+        flag_reference_dirs=[tmp_path / "use"],
+    )
+
+
+def run_pass(tmp_path, pass_name, overrides=None):
+    cfg = make_cfg(tmp_path, overrides)
+    return dtxlint.run_passes(cfg, only=pass_name)[pass_name]
+
+
+def codes(findings):
+    return {f.code for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# The fixture itself must be clean — otherwise every injection test below
+# proves nothing.
+# ---------------------------------------------------------------------------
+
+
+def test_fixture_repo_is_clean(tmp_path):
+    cfg = make_cfg(tmp_path)
+    results = dtxlint.run_passes(cfg)
+    flat = [f for fs in results.values() for f in fs]
+    assert flat == [], [f.to_dict() for f in flat]
+
+
+# ---------------------------------------------------------------------------
+# Pass 1: wire conformance
+# ---------------------------------------------------------------------------
+
+
+def test_wire_detects_python_cpp_number_drift(tmp_path):
+    findings = run_pass(tmp_path, "wire", {
+        "pkg/native/ps_server.cc": _PS_SERVER_CC.replace("PING = 15", "PING = 16"),
+    })
+    drift = [f for f in findings if f.code == "op-drift"]
+    assert len(drift) == 1 and drift[0].symbol == "PING"
+    assert "15" in drift[0].message and "16" in drift[0].message
+
+
+def test_wire_detects_missing_enum_entry(tmp_path):
+    cc = _PS_SERVER_CC.replace("  PSTORE_GET = 18,\n", "").replace(
+        "    case PSTORE_GET:\n      break;\n", ""
+    )
+    findings = run_pass(tmp_path, "wire", {"pkg/native/ps_server.cc": cc})
+    # Gone from the enum (op-missing) AND the client still sends it with no
+    # C++ case to land on (dispatch-missing).
+    assert {"op-missing", "dispatch-missing"} <= codes(findings)
+
+
+def test_wire_detects_undispatched_enum_op(tmp_path):
+    cc = _PS_SERVER_CC.replace("    case PSTORE_GET:\n      break;\n", "")
+    findings = run_pass(tmp_path, "wire", {"pkg/native/ps_server.cc": cc})
+    missing = [f for f in findings if f.code == "case-missing"]
+    assert [f.symbol for f in missing] == ["PSTORE_GET"]
+
+
+def test_wire_detects_layout_const_drift(tmp_path):
+    findings = run_pass(tmp_path, "wire", {
+        "pkg/native/ps_server.cc": _PS_SERVER_CC.replace(
+            "kWireVersion = 2", "kWireVersion = 3"
+        ),
+    })
+    assert any(
+        f.code == "const-drift" and f.symbol == "WIRE_VERSION" for f in findings
+    )
+
+
+def test_wire_parses_last_enum_entry_without_trailing_comma(tmp_path):
+    """The final C++ enum member is legal without a trailing comma —
+    dropping it would misreport the op as absent from the enum."""
+    cc = _PS_SERVER_CC.replace("  HELLO = 26,\n", "  HELLO = 26\n")
+    findings = run_pass(tmp_path, "wire", {"pkg/native/ps_server.cc": cc})
+    assert findings == []
+
+
+def test_wire_detects_cross_service_op_collision(tmp_path):
+    # DSVC claims 96, which SRV_OPS already owns for PREDICT.
+    wire = _WIRE_PY.replace('"GET_BATCH": 67', '"GET_BATCH": 96')
+    findings = run_pass(tmp_path, "wire", {"pkg/parallel/wire.py": wire})
+    coll = [f for f in findings if f.code == "op-collision"]
+    assert coll and any("96" in f.message for f in coll)
+
+
+def test_wire_shared_hello_code_point_is_not_a_collision(tmp_path):
+    findings = run_pass(tmp_path, "wire")
+    assert not any("HELLO" in f.symbol for f in findings if f.code == "op-collision")
+
+
+def test_wire_detects_duplicate_error_status(tmp_path):
+    wire = _WIRE_PY.replace('"OVERLOAD": -7', '"OVERLOAD": -2')
+    findings = run_pass(tmp_path, "wire", {"pkg/parallel/wire.py": wire})
+    assert "status-collision" in codes(findings)
+
+
+def test_wire_wrong_service_band_excludes_its_base(tmp_path):
+    """Wrong-service answers are base - id for ids 1..N: the base itself
+    (-40 here) is unreserved and must not be a false collision, while
+    base-1 (-41) is inside the band."""
+    wire_ok = _WIRE_PY.replace('"ERR": -2}', '"ERR": -2, "FULL": -40}')
+    dsvc = _DSVC_PY.replace(
+        'ERR = wire.DSVC_STATUS["ERR"]',
+        'ERR = wire.DSVC_STATUS["ERR"]\nFULL = wire.DSVC_STATUS["FULL"]',
+    ).replace(
+        "if status == ERR:",
+        "if status == FULL:\n            pass\n        if status == ERR:",
+    )
+    findings = run_pass(tmp_path, "wire", {
+        "pkg/parallel/wire.py": wire_ok, "pkg/data/data_service.py": dsvc,
+    })
+    assert not any(f.code == "status-collision" for f in findings)
+    wire_bad = _WIRE_PY.replace('"ERR": -2}', '"ERR": -2, "FULL": -41}')
+    dsvc_bad = dsvc  # same client handling; only the number moved
+    findings = run_pass(tmp_path, "wire", {
+        "pkg/parallel/wire.py": wire_bad, "pkg/data/data_service.py": dsvc_bad,
+    })
+    assert any(
+        f.code == "status-collision" and "FULL" in f.symbol for f in findings
+    )
+
+
+def test_wire_detects_unhandled_server_status(tmp_path):
+    # The server can now answer NO_MODEL but no client branch looks at it.
+    wire = _WIRE_PY.replace(
+        '"OVERLOAD": -7', '"OVERLOAD": -7, "NO_MODEL": -8'
+    )
+    findings = run_pass(tmp_path, "wire", {"pkg/parallel/wire.py": wire})
+    unhandled = [f for f in findings if f.code == "status-unhandled"]
+    assert [f.symbol for f in unhandled] == ["SRV_STATUS.NO_MODEL"]
+
+
+def test_wire_detects_restated_protocol_literal(tmp_path):
+    msrv = _MSRV_PY.replace(
+        'SRV_PREDICT = wire.SRV_OPS["PREDICT"]', "SRV_PREDICT = 96"
+    )
+    findings = run_pass(tmp_path, "wire", {"pkg/serve/model_server.py": msrv})
+    restated = [f for f in findings if f.code == "literal-restated"]
+    assert len(restated) == 1 and restated[0].symbol == "SRV_PREDICT"
+    assert restated[0].line > 0
+
+
+def test_wire_protocol_adjacent_config_constants_are_not_restated(tmp_path):
+    """Constants that merely SHARE a prefix substring with the protocol
+    namespaces (``_ACCEPT_BACKLOG``, ``_PING_INTERVAL_S``) are config, not
+    restated op numbers — while a true new ``_PSTORE_*`` literal is."""
+    svc = _PS_SERVICE_PY.replace(
+        '_HELLO = wire.PS_OPS["HELLO"]',
+        '_HELLO = wire.PS_OPS["HELLO"]\n'
+        "_ACCEPT_BACKLOG = 128\n"
+        "_PING_INTERVAL_S = 5\n"
+        "_PSTORE_DELETE = 28",
+    )
+    findings = run_pass(tmp_path, "wire", {"pkg/parallel/ps_service.py": svc})
+    restated = [f for f in findings if f.code == "literal-restated"]
+    assert [f.symbol for f in restated] == ["_PSTORE_DELETE"]
+
+
+def test_wire_detects_dispatch_missing_in_python_server(tmp_path):
+    # The serve client sends STATS; the server never compares op to it.
+    client = _SERVE_CLIENT_PY.replace(
+        'SRV_PREDICT = wire.SRV_OPS["PREDICT"]',
+        'SRV_PREDICT = wire.SRV_OPS["PREDICT"]\n'
+        'SRV_STATS = wire.SRV_OPS["STATS"]',
+    ) + textwrap.dedent(
+        """
+        class StatsProbe:
+            def stats(self):
+                return self.call(SRV_STATS, 0)
+        """
+    )
+    wire = _WIRE_PY.replace('"PREDICT": 96', '"PREDICT": 96, "STATS": 97')
+    findings = run_pass(tmp_path, "wire", {
+        "pkg/serve/client.py": client, "pkg/parallel/wire.py": wire,
+    })
+    missing = [f for f in findings if f.code == "dispatch-missing"]
+    assert [f.symbol for f in missing] == ["SRV_STATS"]
+
+
+# ---------------------------------------------------------------------------
+# Pass 2: concurrency
+# ---------------------------------------------------------------------------
+
+
+def test_concurrency_detects_blocking_call_under_lock(tmp_path):
+    conc = _CONC_PY.replace(
+        "with self._lock:\n            x = 1",
+        "with self._lock:\n            x = 1\n"
+        "            time.sleep(0.5)",
+    )
+    findings = run_pass(tmp_path, "concurrency", {"pkg/conc/worker.py": conc})
+    blocked = [f for f in findings if f.code == "blocking-under-lock"]
+    assert len(blocked) == 1
+    assert "Worker.step" in blocked[0].symbol and "sleep" in blocked[0].symbol
+
+
+def test_concurrency_detects_naked_queue_get_under_lock(tmp_path):
+    conc = _CONC_PY.replace(
+        "with self._lock:\n            x = 1",
+        "with self._lock:\n            x = self._q.get()",
+    )
+    findings = run_pass(tmp_path, "concurrency", {"pkg/conc/worker.py": conc})
+    assert "blocking-under-lock" in codes(findings)
+
+
+def test_concurrency_timeout_get_under_lock_is_clean(tmp_path):
+    conc = _CONC_PY.replace(
+        "with self._lock:\n            x = 1",
+        "with self._lock:\n            x = self._q.get(timeout=1.0)",
+    )
+    findings = run_pass(tmp_path, "concurrency", {"pkg/conc/worker.py": conc})
+    assert findings == []
+
+
+def test_concurrency_detects_blocking_with_item_under_lock(tmp_path):
+    """A blocking call used AS a with-item context expression still runs
+    under the enclosing lock (`with self._lock:` then
+    `with conn.accept() as c:` accepts while holding it)."""
+    conc = _CONC_PY.replace(
+        "with self._lock:\n            x = 1",
+        "with self._lock:\n            with self._conn.accept() as x:\n"
+        "                pass",
+    )
+    findings = run_pass(tmp_path, "concurrency", {"pkg/conc/worker.py": conc})
+    blocked = [f for f in findings if f.code == "blocking-under-lock"]
+    assert len(blocked) == 1 and "accept" in blocked[0].symbol
+
+
+def test_concurrency_deferred_lambda_under_lock_is_clean(tmp_path):
+    """A lambda BUILT under a lock runs later, lock released — flagging
+    `jobs.append(lambda: q.get())` would fail the lint on the exact shape
+    ps_shard's per-shard closures use."""
+    conc = _CONC_PY.replace(
+        "with self._lock:\n            x = 1",
+        "with self._lock:\n            x = lambda: self._q.get()",
+    )
+    findings = run_pass(tmp_path, "concurrency", {"pkg/conc/worker.py": conc})
+    assert findings == []
+
+
+def test_concurrency_detects_bare_acquire_in_except_handler(tmp_path):
+    """Error-recovery paths leak locks too: an unpaired acquire inside an
+    except body must be found."""
+    conc = _CONC_PY + textwrap.dedent(
+        """
+
+        def recover(worker):
+            try:
+                return compute()
+            except OSError:
+                worker._lock.acquire()
+                return reconnect()
+        """
+    )
+    findings = run_pass(tmp_path, "concurrency", {"pkg/conc/worker.py": conc})
+    bare = [f for f in findings if f.code == "acquire-outside-with"]
+    assert len(bare) == 1 and "recover" in bare[0].symbol
+
+
+def test_concurrency_detects_bare_acquire(tmp_path):
+    conc = _CONC_PY + textwrap.dedent(
+        """
+
+        def leaky(worker):
+            worker._lock.acquire()
+            value = compute()
+            worker._lock.release()
+            return value
+        """
+    )
+    findings = run_pass(tmp_path, "concurrency", {"pkg/conc/worker.py": conc})
+    bare = [f for f in findings if f.code == "acquire-outside-with"]
+    assert len(bare) == 1 and "leaky" in bare[0].symbol
+
+
+def test_concurrency_acquire_with_try_finally_is_clean(tmp_path):
+    conc = _CONC_PY + textwrap.dedent(
+        """
+
+        def careful(worker):
+            worker._lock.acquire()
+            try:
+                return compute()
+            finally:
+                worker._lock.release()
+        """
+    )
+    findings = run_pass(tmp_path, "concurrency", {"pkg/conc/worker.py": conc})
+    assert findings == []
+
+
+def test_concurrency_nested_bare_acquire_reported_once(tmp_path):
+    """A bare acquire inside a nested function belongs to the nested
+    function's own lint — the enclosing function's walk must not double-
+    report it under a second qualname (one defect, one baseline key)."""
+    conc = _CONC_PY + textwrap.dedent(
+        """
+
+        def outer(worker):
+            def inner():
+                if worker:
+                    worker._lock.acquire()
+            return inner
+        """
+    )
+    findings = run_pass(tmp_path, "concurrency", {"pkg/conc/worker.py": conc})
+    bare = [f for f in findings if f.code == "acquire-outside-with"]
+    assert len(bare) == 1 and "outer.inner" in bare[0].symbol
+
+
+def test_concurrency_detects_lock_order_inversion(tmp_path):
+    conc = _CONC_PY.replace(
+        "def both(self):",
+        textwrap.dedent(
+            """\
+            def inverted(self):
+                    with self._aux_lock:
+                        with self._lock:
+                            return 3
+
+                def both(self):"""
+        ),
+    )
+    findings = run_pass(tmp_path, "concurrency", {"pkg/conc/worker.py": conc})
+    order = [f for f in findings if f.code == "lock-order"]
+    assert len(order) == 1
+    assert "_lock" in order[0].symbol and "_aux_lock" in order[0].symbol
+
+
+# ---------------------------------------------------------------------------
+# Pass 3: fault coverage
+# ---------------------------------------------------------------------------
+
+
+def test_fault_coverage_detects_uncovered_role_suffix(tmp_path):
+    roles = _ROLES_PY.replace(
+        "return prefetch, data, per_shard",
+        'extra = role + "_zz"\n    return prefetch, data, per_shard, extra',
+    )
+    findings = run_pass(
+        tmp_path, "fault_coverage", {"pkg/roles/transport.py": roles}
+    )
+    uncovered = [f for f in findings if f.code == "role-uncovered"]
+    assert [f.symbol for f in uncovered] == ["_zz"]
+
+
+def test_fault_coverage_parameterized_shard_suffix_matches_any_digit(tmp_path):
+    # `_s<i>` is covered by the concrete worker0_s1 run in the matrix; drop
+    # that run and the parameterized site must surface.
+    tests = _FAULT_TESTS_PY.replace('    "drop_conn:role=worker0_s1",\n', "")
+    findings = run_pass(
+        tmp_path, "fault_coverage", {"tests/test_faults.py": tests}
+    )
+    assert [f.symbol for f in findings] == ["_s<i>"]
+
+
+def test_fault_coverage_helper_identifier_is_not_role_coverage(tmp_path):
+    """A helper named ``_dsvc_splits`` contains the substring ``_ds`` but
+    is NOT a fault-matrix entry — dropping the real ``_ds`` run must still
+    surface role-uncovered."""
+    tests = _FAULT_TESTS_PY.replace(
+        '"delay:role=worker0_ds,ms=5"', '"delay:role=worker0,ms=5"'
+    ) + "\n\ndef _dsvc_splits():\n    return []\n"
+    findings = run_pass(
+        tmp_path, "fault_coverage", {"tests/test_faults.py": tests}
+    )
+    assert [f.symbol for f in findings] == ["_ds"]
+
+
+def test_fault_coverage_detects_untested_fault_kind(tmp_path):
+    faults = _FAULTS_PY.replace('("die",)', '("die", "pause")')
+    findings = run_pass(
+        tmp_path, "fault_coverage", {"pkg/utils/faults.py": faults}
+    )
+    uncovered = [f for f in findings if f.code == "kind-uncovered"]
+    assert [f.symbol for f in uncovered] == ["pause"]
+
+
+# ---------------------------------------------------------------------------
+# Pass 4: flag drift
+# ---------------------------------------------------------------------------
+
+
+def test_flag_drift_detects_orphan_flag(tmp_path):
+    flags = _FLAGS_PY + '_define("integer", "dead_knob", 0, "unused")\n'
+    findings = run_pass(tmp_path, "flag_drift", {"pkg/utils/flags.py": flags})
+    orphans = [f for f in findings if f.code == "flag-orphan"]
+    assert [f.symbol for f in orphans] == ["dead_knob"]
+
+
+def test_flag_drift_documented_but_dead_flag_is_still_orphan(tmp_path):
+    """A RUNBOOK mention is documentation, not a use: it must satisfy the
+    undocumented check without masking the orphan check (else a dead flag
+    becomes undetectable the moment it is documented)."""
+    flags = _FLAGS_PY + '_define("integer", "dead_knob", 0, "unused")\n'
+    runbook = _RUNBOOK_MD + "\nAlso see `--dead_knob`.\n"
+    findings = run_pass(tmp_path, "flag_drift", {
+        "pkg/utils/flags.py": flags, "RUNBOOK.md": runbook,
+    })
+    assert [(f.code, f.symbol) for f in findings] == [("flag-orphan", "dead_knob")]
+
+
+def test_flag_drift_detects_undocumented_flag(tmp_path):
+    runbook = _RUNBOOK_MD.replace(" and point `--ps_hosts` at the servers", "")
+    findings = run_pass(tmp_path, "flag_drift", {"RUNBOOK.md": runbook})
+    undoc = [f for f in findings if f.code == "flag-undocumented"]
+    assert [f.symbol for f in undoc] == ["ps_hosts"]
+
+
+def test_flag_drift_detects_undefined_flag_access(tmp_path):
+    use = _FLAG_USE_PY + "\n\ndef extra():\n    return FLAGS.mystery_knob\n"
+    findings = run_pass(tmp_path, "flag_drift", {"use/consume.py": use})
+    undef = [f for f in findings if f.code == "flag-undefined"]
+    assert [f.symbol for f in undef] == ["mystery_knob"]
+
+
+def test_flag_drift_absl_builtin_access_is_clean(tmp_path):
+    use = _FLAG_USE_PY + "\n\ndef extra():\n    return FLAGS.log_dir\n"
+    findings = run_pass(tmp_path, "flag_drift", {"use/consume.py": use})
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# Baseline mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_suppresses_by_key_and_reports_stale(tmp_path):
+    msrv = _MSRV_PY.replace(
+        'SRV_PREDICT = wire.SRV_OPS["PREDICT"]', "SRV_PREDICT = 96"
+    )
+    cfg = make_cfg(tmp_path, {"pkg/serve/model_server.py": msrv})
+    results = dtxlint.run_passes(cfg, only="wire")
+    (finding,) = results["wire"]
+    active, suppressed, stale = apply_baseline(
+        results, {finding.key: "pinned for the test", "wire:gone:x:y": "stale"}
+    )
+    assert active == [] and [f.key for f in suppressed] == [finding.key]
+    assert stale == ["wire:gone:x:y"]
+
+
+def test_baseline_rejects_unjustified_suppression(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"suppressions": [{"key": "wire:x:y:z"}]}))
+    with pytest.raises(ValueError, match="reason"):
+        load_baseline(path)
+
+
+def test_baseline_rejects_non_object_document_as_value_error(tmp_path):
+    """A top-level JSON array (not an object) is the same rc=2 ValueError
+    path, not an AttributeError on data.get."""
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps([{"key": "w:x:y:z", "reason": "r"}]))
+    with pytest.raises(ValueError, match="JSON object"):
+        load_baseline(path)
+
+
+@pytest.mark.parametrize("entry", [
+    {"key": "wire:x:y:z", "reason": None},
+    {"key": "wire:x:y:z", "reason": 7},
+    {"key": None, "reason": "why"},
+    "not-a-dict",
+])
+def test_baseline_rejects_malformed_entries_as_value_error(tmp_path, entry):
+    """A hand-edited baseline with a null/number reason must surface as the
+    CLI's rc=2 bad-baseline error (ValueError), never an AttributeError
+    traceback that exits looking like rc=1 findings."""
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"suppressions": [entry]}))
+    with pytest.raises(ValueError):
+        load_baseline(path)
+
+
+def test_baseline_keys_are_line_stable(tmp_path):
+    """Reformatting (line shifts) must not invalidate a suppression: the
+    key has no line component."""
+    msrv = _MSRV_PY.replace(
+        'SRV_PREDICT = wire.SRV_OPS["PREDICT"]', "SRV_PREDICT = 96"
+    )
+    key1 = run_pass(tmp_path, "wire", {"pkg/serve/model_server.py": msrv})[0].key
+    shifted = "\n\n\n" + msrv
+    key2 = run_pass(tmp_path, "wire", {"pkg/serve/model_server.py": shifted})[0].key
+    assert key1 == key2
+
+
+# ---------------------------------------------------------------------------
+# CLI + --json schema, and the real-repo gate
+# ---------------------------------------------------------------------------
+
+
+def test_cli_json_schema_and_repo_is_clean(capsys):
+    """THE tier-1 gate: the real repo lints clean, and the --json document
+    holds the schema campaign_report and external consumers parse."""
+    rc = dtxlint_main(["--json", "--root", ROOT])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0, report["findings"]
+    assert report["schema_version"] == dtxlint.JSON_SCHEMA_VERSION == 1
+    assert report["ok"] is True
+    assert set(report["passes"]) == set(dtxlint.PASS_NAMES)
+    assert set(report["counts"]) == {"active", "suppressed", "stale_suppressions"}
+    assert report["counts"]["active"] == 0
+    assert report["counts"]["stale_suppressions"] == 0
+    assert report["findings"] == []
+    # Suppressions carry the full finding shape so the report names what
+    # was deliberately allowed.
+    for f in report["suppressed"]:
+        assert set(f) == {
+            "key", "pass", "code", "path", "line", "symbol", "message",
+        }
+        assert f["key"] in {
+            e["key"]
+            for e in json.load(
+                open(os.path.join(ROOT, "tools", "dtxlint_baseline.json"))
+            )["suppressions"]
+        }
+
+
+def test_cli_compact_json_is_one_line(capsys):
+    rc = dtxlint_main(["--json", "--compact", "--root", ROOT])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert len(out.strip().splitlines()) == 1
+    assert json.loads(out)["ok"] is True
+
+
+def test_cli_findings_exit_nonzero(tmp_path, capsys):
+    """A dirty tree exits 1 and renders each finding humanly."""
+    make_cfg(tmp_path)  # writes the fixture tree under tmp_path
+    # Point the CLI at the fixture root: the default layout misses, which
+    # must be a loud rc=2 (linter failure), never a silent pass.
+    rc = dtxlint_main(["--root", str(tmp_path)])
+    assert rc == 2
+    assert "error" in capsys.readouterr().err
+
+
+def test_cli_single_pass_does_not_report_other_passes_suppressions(capsys):
+    """--pass wire keeps the wire suppressions live but must not flag the
+    other passes' baseline entries as stale (they did not run)."""
+    rc = dtxlint_main(["--pass", "flag_drift", "--root", ROOT])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "stale" not in out.split("dtxlint:")[0]
+
+
+def test_campaign_plan_runs_dtxlint_as_cpu_step():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import measure_campaign as mc
+    finally:
+        sys.path.pop(0)
+    steps = {s["name"]: s for s in mc.steps_plan()}
+    assert "dtxlint" in steps, "campaign lost the static-analysis step"
+    assert steps["dtxlint"].get("cpu_ok") is True
+    assert os.path.exists(os.path.join(ROOT, steps["dtxlint"]["cmd"][1]))

@@ -304,3 +304,81 @@ def test_sharded_store_bf16_wire():
     assert s == 1
     np.testing.assert_array_equal(out, expect)
     group.close()
+
+
+# ---------------------------------------------------------------------------
+# _ShardPool concurrency (r11 dtxlint fix): pre-r11 the pool serialized
+# run() under a lock held across the blocking result gather, so one wedged
+# shard leg convoyed every other caller.  The fix routes results through a
+# per-call completion queue — these tests pin both halves of the contract.
+# ---------------------------------------------------------------------------
+
+
+def test_shard_pool_concurrent_runs_do_not_convoy():
+    """A run() wedged on one shard must not block a concurrent run() that
+    only touches other shards (the dtxlint blocking-under-lock finding)."""
+    import threading
+    import time
+
+    pool = ps_shard._ShardPool(2, "convoy-test")
+    try:
+        entered, release = threading.Event(), threading.Event()
+        slow_result: dict = {}
+
+        def slow():
+            entered.set()
+            release.wait(10.0)
+            return "slow"
+
+        t = threading.Thread(
+            target=lambda: slow_result.update(out=pool.run({0: slow}))
+        )
+        t.start()
+        assert entered.wait(10.0), "slow leg never started"
+        # Shard 0 is now wedged mid-run.  A run over shard 1 only must
+        # complete promptly (pre-fix: blocks on the pool-wide run lock
+        # until the slow leg releases).
+        t0 = time.monotonic()
+        out = pool.run({1: lambda: "fast"})
+        elapsed = time.monotonic() - t0
+        assert out == {1: "fast"}
+        assert elapsed < 5.0, f"fast run convoyed behind the wedged leg ({elapsed:.1f}s)"
+        release.set()
+        t.join(10.0)
+        assert slow_result["out"] == {0: "slow"}
+    finally:
+        release.set()
+        pool.close()
+
+
+def test_shard_pool_concurrent_runs_route_results_to_their_caller():
+    """Per-call completion queues must never cross-deliver: two callers
+    hammering the same shards each get exactly their own results."""
+    import threading
+
+    pool = ps_shard._ShardPool(2, "route-test")
+    try:
+        start = threading.Barrier(3)
+        outs: dict[str, dict] = {}
+
+        def caller(tag: str):
+            start.wait(10.0)
+            for _ in range(50):
+                got = pool.run({0: lambda: f"{tag}-a", 1: lambda: f"{tag}-b"})
+                assert got == {0: f"{tag}-a", 1: f"{tag}-b"}, got
+            outs[tag] = got
+
+        threads = [
+            threading.Thread(target=caller, args=(tag,)) for tag in ("x", "y")
+        ]
+        for t in threads:
+            t.start()
+        start.wait(10.0)
+        for t in threads:
+            t.join(30.0)
+        assert outs == {
+            "x": {0: "x-a", 1: "x-b"},
+            "y": {0: "y-a", 1: "y-b"},
+        }
+    finally:
+        pool.close()

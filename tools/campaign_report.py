@@ -72,6 +72,28 @@ def fmt_transport(rec: dict, ok: str) -> str:
     return "\n".join(lines)
 
 
+def fmt_dtxlint(rec: dict, ok: str) -> str:
+    """Static-analysis step (r11): clean/dirty verdict plus the offending
+    finding keys — a drifted wire invariant must be readable from the
+    report without re-running the linter."""
+    j = rec.get("json") or {}
+    if not j:
+        return f"- `dtxlint` [{ok}]: NO JSON ({rec['seconds']}s)"
+    counts = j.get("counts", {})
+    lines = [
+        f"- `dtxlint` [{ok}]: {'clean' if j.get('ok') else 'FINDINGS'} — "
+        f"{counts.get('active', '?')} active, "
+        f"{counts.get('suppressed', '?')} suppressed, "
+        f"{counts.get('stale_suppressions', '?')} stale "
+        f"(schema v{j.get('schema_version')}; {rec['seconds']}s wall)"
+    ]
+    for f in j.get("findings", []):
+        lines.append(f"    - {f.get('key')}: {f.get('message')}")
+    for key in j.get("stale_suppressions", []):
+        lines.append(f"    - stale suppression: {key}")
+    return "\n".join(lines)
+
+
 def main():
     path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(ROOT, "CAMPAIGN_r05.json")
     with open(path) as f:
@@ -83,6 +105,8 @@ def main():
         ok = "ok" if rec["rc"] == 0 else f"FAILED rc={rec['rc']}" + (" (timeout)" if rec.get("timed_out") else "")
         if name in ("ps_transport_bench", "data_service_bench", "serving_bench"):
             print(fmt_transport(rec, ok))
+        elif name == "dtxlint":
+            print(fmt_dtxlint(rec, ok))
         elif name.startswith("bench_"):
             print(fmt_bench(rec, ok))
         elif name == "flash_parity":

@@ -1,0 +1,221 @@
+"""dtxlint — repo-specific static analysis for the distributed wire stack.
+
+PRs 1–10 grew a three-service distributed system (the native PS state
+service, the data service ``dsvc`` and the serving plane ``msrv``) whose
+correctness rests on hand-maintained invariants: op/status numbers shared
+between Python and ``native/ps_server.cc``, HELLO bit-field layouts, lock
+discipline around ~30 threading primitives, fault-plan role strings the
+test matrix must mirror, and a flag surface RUNBOOK.md documents.  This
+package machine-checks those invariants so the unified-runtime and
+replication refactors (ROADMAP items 1–2) can move fast without silently
+breaking the wire.
+
+Four passes (each a module exposing ``run(cfg) -> list[Finding]``):
+
+- ``wire_conformance`` — extracts the protocol registries from
+  ``parallel/wire.py`` (Python AST) and the ``enum Op`` / ``constexpr`` /
+  ``case`` sites from ``native/ps_server.cc`` (C++ parse), then
+  cross-checks: no op/status collisions, no Python<->C++ numeric drift,
+  every client-sent op has a server dispatch case, every server status is
+  handled (or allowlisted) client-side, and no service module restates a
+  protocol number outside ``wire.py``.
+- ``concurrency`` — AST lint over the ``serve/``, ``parallel/`` and
+  ``data/`` packages: blocking calls made while lexically holding a lock,
+  ``.acquire()`` outside ``with``/try-finally, and inconsistent pairwise
+  lock-acquisition order.
+- ``fault_coverage`` — every client-role suffix constructed in source
+  (``_pf``, ``_ds``, ``_sv``, ``_s<i>``) must appear in the
+  ``tests/test_faults.py`` matrix, and every ``DTX_FAULT_PLAN`` spec kind
+  parsed by ``utils/faults.py`` must have at least one test exercising it.
+- ``flag_drift`` — every flag defined in ``utils/flags.py`` is referenced
+  outside its definition and mentioned in RUNBOOK.md; no undefined flag is
+  referenced anywhere.
+
+CLI: ``python -m tools.dtxlint [--json] [--baseline FILE] [--root DIR]
+[--pass NAME]``.  Exit 0 iff no non-suppressed findings.  The baseline
+file (``tools/dtxlint_baseline.json``) carries DELIBERATE suppressions,
+each keyed by the finding's stable key and carrying a justification —
+an empty/justified baseline is the acceptance bar, not a dumping ground.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from pathlib import Path
+
+#: --json schema version (tests pin it).
+JSON_SCHEMA_VERSION = 1
+
+PASS_NAMES = ("wire", "concurrency", "fault_coverage", "flag_drift")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding.
+
+    ``key`` (pass:code:path:symbol) is the STABLE identity baselines match
+    on — deliberately line-free, so reformatting never invalidates a
+    suppression; ``line`` is advisory, for the human report.
+    """
+
+    pass_name: str
+    code: str  # short kebab-case finding type, e.g. "op-drift"
+    path: str  # repo-relative path of the offending file
+    symbol: str  # the symbol/qualname the finding anchors to
+    message: str
+    line: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.pass_name}:{self.code}:{self.path}:{self.symbol}"
+
+    def to_dict(self) -> dict:
+        return {
+            "key": self.key,
+            "pass": self.pass_name,
+            "code": self.code,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+        }
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """Paths each pass reads.  ``default(root)`` wires the real repo
+    layout; tests point individual fields at synthetic fixtures."""
+
+    root: Path
+    # wire conformance
+    wire_py: Path
+    ps_server_cc: Path
+    native_init_py: Path
+    ps_service_py: Path
+    service_files: list[Path]  # modules that must not restate protocol numbers
+    dsvc_py: Path
+    msrv_py: Path
+    serve_client_py: Path
+    # concurrency
+    concurrency_dirs: list[Path]
+    # fault coverage
+    faults_py: Path
+    role_source_dirs: list[Path]
+    fault_test_files: list[Path]
+    # flag drift
+    flags_py: Path
+    runbook_md: Path
+    flag_reference_dirs: list[Path]
+
+    @classmethod
+    def default(cls, root: str | os.PathLike) -> "LintConfig":
+        root = Path(root)
+        pkg = root / "distributed_tensorflow_examples_tpu"
+        return cls(
+            root=root,
+            wire_py=pkg / "parallel" / "wire.py",
+            ps_server_cc=pkg / "native" / "ps_server.cc",
+            native_init_py=pkg / "native" / "__init__.py",
+            ps_service_py=pkg / "parallel" / "ps_service.py",
+            service_files=[
+                pkg / "parallel" / "ps_service.py",
+                pkg / "parallel" / "ps_shard.py",
+                pkg / "data" / "data_service.py",
+                pkg / "serve" / "model_server.py",
+                pkg / "serve" / "client.py",
+            ],
+            dsvc_py=pkg / "data" / "data_service.py",
+            msrv_py=pkg / "serve" / "model_server.py",
+            serve_client_py=pkg / "serve" / "client.py",
+            concurrency_dirs=[pkg / "serve", pkg / "parallel", pkg / "data"],
+            faults_py=pkg / "utils" / "faults.py",
+            role_source_dirs=[
+                pkg / "parallel", pkg / "data", pkg / "serve", pkg / "train",
+            ],
+            fault_test_files=[root / "tests" / "test_faults.py"],
+            flags_py=pkg / "utils" / "flags.py",
+            runbook_md=root / "RUNBOOK.md",
+            flag_reference_dirs=[
+                pkg, root / "examples", root / "tools", root / "tests",
+            ],
+        )
+
+    def rel(self, path: Path) -> str:
+        try:
+            return str(Path(path).relative_to(self.root))
+        except ValueError:
+            return str(path)
+
+
+def load_baseline(path: str | os.PathLike | None) -> dict[str, str]:
+    """``{finding key: justification}`` from a baseline file (missing file
+    == empty baseline).  Entries without a non-empty ``reason`` are
+    rejected: a suppression must say WHY or it is just hidden drift."""
+    if path is None or not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict):
+        raise ValueError(
+            f"baseline must be a JSON object, got {type(data).__name__}"
+        )
+    out: dict[str, str] = {}
+    suppressions = data.get("suppressions", [])
+    if not isinstance(suppressions, list):
+        raise ValueError("baseline 'suppressions' must be a list")
+    for entry in suppressions:
+        key = entry.get("key") if isinstance(entry, dict) else None
+        reason = entry.get("reason") if isinstance(entry, dict) else None
+        # Type-check before use: a hand-edited null/number reason must be
+        # the rc=2 bad-baseline error, never an AttributeError traceback
+        # that exits looking like rc=1 "findings".
+        if not isinstance(key, str) or not key or \
+                not isinstance(reason, str) or not reason.strip():
+            raise ValueError(
+                f"baseline entry {entry!r} needs both a string 'key' and a "
+                "non-empty string 'reason' — unjustified suppressions are "
+                "not allowed"
+            )
+        out[key] = reason
+    return out
+
+
+def run_passes(
+    cfg: LintConfig, only: str | None = None
+) -> dict[str, list[Finding]]:
+    """Run the requested passes; returns ``{pass name: findings}``."""
+    from . import concurrency, fault_coverage, flag_drift, wire_conformance
+
+    passes = {
+        "wire": wire_conformance.run,
+        "concurrency": concurrency.run,
+        "fault_coverage": fault_coverage.run,
+        "flag_drift": flag_drift.run,
+    }
+    if only is not None:
+        if only not in passes:
+            raise ValueError(f"unknown pass {only!r} (have {sorted(passes)})")
+        passes = {only: passes[only]}
+    return {name: fn(cfg) for name, fn in passes.items()}
+
+
+def apply_baseline(
+    results: dict[str, list[Finding]], baseline: dict[str, str]
+) -> tuple[list[Finding], list[Finding], list[str]]:
+    """Partition findings into (active, suppressed) and report baseline
+    entries that matched nothing (stale suppressions must be pruned, or
+    they hide the next genuine finding with the same key)."""
+    active: list[Finding] = []
+    suppressed: list[Finding] = []
+    seen: set[str] = set()
+    for findings in results.values():
+        for f in findings:
+            if f.key in baseline:
+                suppressed.append(f)
+                seen.add(f.key)
+            else:
+                active.append(f)
+    stale = sorted(set(baseline) - seen)
+    return active, suppressed, stale

@@ -1,0 +1,286 @@
+"""Pass 2: concurrency lint over ``serve/``, ``parallel/`` and ``data/``.
+
+Three intraprocedural checks (lexical scope only — a blocking call reached
+through a helper method is the helper's finding, at its own site):
+
+- ``blocking-under-lock`` — a blocking primitive called while lexically
+  inside a ``with <lock>:`` block.  Blocking primitives: socket
+  ``recv*``/``sendall``/``sendmsg``/``accept``/``connect`` (and the
+  ``wire.py`` helpers built on them), ``time.sleep``, and the
+  bounded-unless-naked trio ``get``/``join``/``wait`` when called with no
+  timeout.  Holding a lock across any of these stalls every peer of that
+  lock for as long as the kernel (or a dead peer) pleases — the classic
+  convoy that turns one wedged connection into a wedged service.
+- ``acquire-outside-with`` — ``<lock>.acquire()`` not used as a context
+  manager and not immediately followed by a ``try/finally`` that releases:
+  an exception between acquire and release leaks the lock forever.
+- ``lock-order`` — inconsistent pairwise acquisition order: if one
+  function nests ``with A: with B:`` and another nests ``with B: with
+  A:``, the two can deadlock; every observed ordered pair is collected
+  across all scanned files and inversions are reported (both sites named).
+
+A lock is any ``with`` context expression whose final name contains
+``lock`` (``self._lock``, ``self._run_lock``, module ``_role_lock``...) —
+matching the repo's uniform naming.  Lock identity for the order check is
+``<file-stem>.<ClassName>.<attr>`` so the same attribute on different
+classes is never conflated.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from . import Finding, LintConfig
+
+PASS = "concurrency"
+
+#: Calls that block unconditionally (no timeout parameter can save them
+#: at this call site).
+BLOCKING_ALWAYS = {
+    "recv", "recv_into", "recvmsg", "sendall", "sendmsg", "accept",
+    "connect", "create_connection", "recv_exact", "send_frames",
+    "send_frame", "read_batch", "read_request", "sleep",
+}
+
+#: Calls that block only when called with neither a positional timeout nor
+#: a ``timeout``/``timeout_s`` keyword.  ``get`` additionally requires
+#: ZERO positional args to count (``d.get(key)`` is a dict lookup).
+BLOCKING_IF_NAKED = {"get", "join", "wait"}
+
+
+def _call_name(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _expr_name(node: ast.expr) -> str:
+    """Dotted spelling of a name/attribute chain (best effort)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_lock_expr(node: ast.expr) -> bool:
+    name = _expr_name(node)
+    last = name.rsplit(".", 1)[-1] if name else ""
+    return "lock" in last.lower()
+
+
+def _is_blocking(node: ast.Call) -> str | None:
+    """The reason string when this call is a blocking primitive."""
+    name = _call_name(node)
+    if name in BLOCKING_ALWAYS:
+        return name
+    if name in BLOCKING_IF_NAKED:
+        has_timeout = any(
+            kw.arg in ("timeout", "timeout_s", "timeout_ms")
+            for kw in node.keywords
+        )
+        if name == "get":
+            if not node.args and not has_timeout:
+                return "get() with no timeout"
+            return None
+        if not node.args and not has_timeout:
+            return f"{name}() with no timeout"
+    return None
+
+
+class _FuncVisitor(ast.NodeVisitor):
+    """Walks one function body tracking lexically-held locks."""
+
+    def __init__(self, linter: "_FileLinter", qualname: str):
+        self.linter = linter
+        self.qualname = qualname
+        self.held: list[str] = []  # lock ids, outermost first
+
+    # Nested defs get their own visitor (their body doesn't run under the
+    # enclosing with at def time).
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.linter.lint_function(node, f"{self.qualname}.{node.name}")
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            ctx = item.context_expr
+            # The context expression itself runs while every PREVIOUS
+            # item's lock is already held (`with self._lock, conn.accept()
+            # as c:` accepts under the lock) — visit it before pushing
+            # this item's own lock.
+            self.visit(ctx)
+            target = ctx.func if isinstance(ctx, ast.Call) else ctx
+            if isinstance(target, ast.expr) and _is_lock_expr(target):
+                lock_id = self.linter.lock_id(target)
+                for outer in self.held:
+                    if outer != lock_id:
+                        self.linter.order_pairs.setdefault(
+                            (outer, lock_id), []
+                        ).append((self.qualname, node.lineno))
+                self.held.append(lock_id)
+                pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # A lambda body runs when CALLED, not where it is built — a
+        # deferred `lambda: q.get()` constructed under a lock is not a
+        # blocking call under that lock.  Don't descend.
+        pass
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            reason = _is_blocking(node)
+            if reason is not None:
+                self.linter.findings.append(Finding(
+                    PASS, "blocking-under-lock", self.linter.relpath,
+                    f"{self.qualname}:{reason}",
+                    f"{self.qualname} calls {reason} while holding "
+                    f"{self.held[-1]} — the lock convoys every peer for "
+                    "the full wait",
+                    line=node.lineno,
+                ))
+        self.generic_visit(node)
+
+
+class _FileLinter:
+    def __init__(self, path: Path, relpath: str, order_pairs: dict):
+        self.path, self.relpath = path, relpath
+        self.findings: list[Finding] = []
+        self.order_pairs = order_pairs  # (outer, inner) -> [(qualname, line)]
+        self._class_stack: list[str] = []
+
+    def lock_id(self, expr: ast.expr) -> str:
+        name = _expr_name(expr)
+        attr = name.rsplit(".", 1)[-1]
+        owner = self._class_stack[-1] if self._class_stack else self.path.stem
+        if name.startswith("self."):
+            return f"{self.path.stem}.{owner}.{attr}"
+        return f"{self.path.stem}.{name}"
+
+    def lint(self) -> list[Finding]:
+        tree = ast.parse(self.path.read_text())
+        self._walk_body(tree.body)
+        return self.findings
+
+    def _walk_body(self, body) -> None:
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                self._class_stack.append(node.name)
+                self._walk_body(node.body)
+                self._class_stack.pop()
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(self._class_stack + [node.name])
+                self.lint_function(node, qual)
+
+    def lint_function(self, node, qualname: str) -> None:
+        self._check_bare_acquire(node, qualname)
+        v = _FuncVisitor(self, qualname)
+        for stmt in node.body:
+            v.visit(stmt)
+
+    def _check_bare_acquire(self, func, qualname: str) -> None:
+        """Flag ``lock.acquire()`` statements not immediately followed by a
+        try/finally that releases the same lock."""
+        bodies = [func.body]
+        # Walk THIS function's statements only — nested defs get their own
+        # lint_function call, so descending into them here would report the
+        # same acquire twice under two qualnames (two baseline keys for one
+        # defect).
+        stack: list = list(func.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(node, (ast.If, ast.For, ast.While, ast.With)):
+                bodies.append(node.body)
+                if getattr(node, "orelse", None):
+                    bodies.append(node.orelse)
+            elif isinstance(node, ast.Try):
+                bodies.extend([node.body, node.finalbody, node.orelse])
+                # Exception paths leak locks too — error-recovery code is
+                # the MOST likely place for an unpaired acquire.
+                bodies.extend(h.body for h in node.handlers)
+            stack.extend(ast.iter_child_nodes(node))
+        for body in bodies:
+            for i, stmt in enumerate(body):
+                call = None
+                if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+                    call = stmt.value
+                elif isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+                    call = stmt.value
+                if call is None or _call_name(call) != "acquire":
+                    continue
+                if not isinstance(call.func, ast.Attribute) or not _is_lock_expr(
+                    call.func.value
+                ):
+                    continue
+                lock_name = _expr_name(call.func.value)
+                nxt = body[i + 1] if i + 1 < len(body) else None
+                if isinstance(nxt, ast.Try) and nxt.finalbody and any(
+                    isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Call)
+                    and _call_name(s.value) == "release"
+                    and _expr_name(s.value.func.value) == lock_name
+                    for s in nxt.finalbody
+                    if isinstance(s, ast.Expr) and isinstance(s.value, ast.Call)
+                    and isinstance(s.value.func, ast.Attribute)
+                ):
+                    continue
+                self.findings.append(Finding(
+                    PASS, "acquire-outside-with", self.relpath,
+                    f"{qualname}:{lock_name}",
+                    f"{qualname} calls {lock_name}.acquire() without a "
+                    "with-statement or an immediate try/finally release — "
+                    "an exception in between leaks the lock forever",
+                    line=stmt.lineno,
+                ))
+
+
+def run(cfg: LintConfig) -> list[Finding]:
+    findings: list[Finding] = []
+    order_pairs: dict[tuple[str, str], list[tuple[str, int]]] = {}
+    files: list[Path] = []
+    for d in cfg.concurrency_dirs:
+        if d.is_file():
+            files.append(d)
+        else:
+            files.extend(sorted(d.glob("*.py")))
+    rels: dict[tuple[str, str], str] = {}
+    for path in files:
+        rel = cfg.rel(path)
+        linter = _FileLinter(path, rel, order_pairs)
+        findings.extend(linter.lint())
+        for pair in order_pairs:
+            rels.setdefault(pair, rel)
+    # Lock-order inversions across the whole corpus.
+    reported: set[frozenset] = set()
+    for (a, b), sites in sorted(order_pairs.items()):
+        inv = order_pairs.get((b, a))
+        if not inv:
+            continue
+        pair_key = frozenset((a, b))
+        if pair_key in reported:
+            continue
+        reported.add(pair_key)
+        findings.append(Finding(
+            PASS, "lock-order", rels.get((a, b), ""),
+            f"{a}<->{b}",
+            f"inconsistent lock order: {sites[0][0]} takes {a} then {b} "
+            f"(line {sites[0][1]}) but {inv[0][0]} takes {b} then {a} "
+            f"(line {inv[0][1]}) — the two can deadlock",
+            line=sites[0][1],
+        ))
+    return findings

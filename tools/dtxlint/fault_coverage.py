@@ -1,0 +1,190 @@
+"""Pass 3: fault-plan coverage — source-constructed roles and spec kinds
+must be exercised by the tests/test_faults.py matrix.
+
+Two invariants ride on naming conventions only:
+
+- Per-connection client ROLES derive from the process role by suffixing
+  (``worker0_pf``, ``worker1_ds``, ``client0_sv``, ``worker0_s1``...).
+  The fault tests target those strings literally: a new transport whose
+  suffix never appears in the matrix has zero kill/drop/delay coverage and
+  nobody notices.  This pass extracts every suffix CONSTRUCTED in source
+  (f-strings / string concatenation building on a role expression) and
+  demands each appears in the fault-test files.
+- ``DTX_FAULT_PLAN`` spec KINDS are an open enum in ``utils/faults.py``
+  (``_KINDS``): a kind added there without a matrix run is untested
+  injection machinery.  Each parsed kind must appear as ``<kind>:`` inside
+  the fault-test files.
+
+Finding codes: ``role-uncovered``, ``kind-uncovered``, ``kinds-missing``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from . import Finding, LintConfig
+
+PASS = "fault_coverage"
+
+#: A role suffix is a short ``_xx`` tail glued onto a role expression.
+_SUFFIX_RE = re.compile(r"^_([a-z]{1,4})$")
+
+
+def _expr_mentions_role(node: ast.expr) -> bool:
+    """True when the expression the suffix is glued to involves a role
+    (a ``role`` name/attribute or ``current_role()``) — filters decorative
+    ``_``-strings out of the suffix hunt."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and "role" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "role" in sub.attr.lower():
+            return True
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and sub.value == "client":
+            # the ``(current_role() or "client") + "_xx"`` fallback shape
+            return True
+    return False
+
+
+def constructed_suffixes(paths: list[Path]) -> dict[str, tuple[str, int]]:
+    """``{suffix: (relpath-less file name, line)}`` for every client-role
+    suffix constructed in the given files.  A suffix followed by a
+    formatted value (``f"{role}_s{i}"``) is parameterized and recorded as
+    ``_s<i>``."""
+    out: dict[str, tuple[str, int]] = {}
+    for path in paths:
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            # f"{role}_pf" / f"{role}_s{i}"
+            if isinstance(node, ast.JoinedStr):
+                vals = node.values
+                if not any(
+                    isinstance(v, ast.FormattedValue)
+                    and _expr_mentions_role(v.value)
+                    for v in vals
+                ):
+                    continue
+                for i, v in enumerate(vals):
+                    if not (
+                        isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)
+                    ):
+                        continue
+                    m = _SUFFIX_RE.match(v.value)
+                    if not m:
+                        continue
+                    parameterized = i + 1 < len(vals) and isinstance(
+                        vals[i + 1], ast.FormattedValue
+                    )
+                    suffix = v.value + ("<i>" if parameterized else "")
+                    out.setdefault(suffix, (str(path), node.lineno))
+            # (role expr) + "_ds"
+            elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+                right = node.right
+                if (
+                    isinstance(right, ast.Constant)
+                    and isinstance(right.value, str)
+                    and _SUFFIX_RE.match(right.value)
+                    and _expr_mentions_role(node.left)
+                ):
+                    out.setdefault(right.value, (str(path), node.lineno))
+    return out
+
+
+def fault_kinds(faults_py: Path) -> list[str]:
+    """The spec kinds ``utils/faults.py`` parses: the union of every
+    top-level tuple-of-strings assigned to a ``_KINDS``-style name
+    (handles ``_KINDS = _CLIENT_KINDS + ("die",)``)."""
+    tree = ast.parse(faults_py.read_text())
+    tuples: dict[str, list[str]] = {}
+
+    def resolve(node) -> list[str] | None:
+        if isinstance(node, ast.Tuple) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in node.elts
+        ):
+            return [e.value for e in node.elts]
+        if isinstance(node, ast.Name):
+            return tuples.get(node.id)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            left, right = resolve(node.left), resolve(node.right)
+            if left is not None and right is not None:
+                return left + right
+        return None
+
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if "KINDS" not in name.upper():
+                continue
+            vals = resolve(node.value)
+            if vals is not None:
+                tuples[name] = vals
+    kinds: list[str] = []
+    for vals in tuples.values():
+        for k in vals:
+            if k not in kinds:
+                kinds.append(k)
+    return kinds
+
+
+def run(cfg: LintConfig) -> list[Finding]:
+    findings: list[Finding] = []
+    source_files: list[Path] = []
+    for d in cfg.role_source_dirs:
+        if d.is_file():
+            source_files.append(d)
+        elif d.is_dir():
+            source_files.extend(sorted(d.glob("*.py")))
+    test_text = "\n".join(
+        p.read_text() for p in cfg.fault_test_files if p.exists()
+    )
+    if not test_text:
+        findings.append(Finding(
+            PASS, "kinds-missing", cfg.rel(cfg.fault_test_files[0]),
+            "test-file", "fault-test file missing or empty — the whole "
+            "matrix is uncovered",
+        ))
+        return findings
+
+    for suffix, (src, line) in sorted(constructed_suffixes(source_files).items()):
+        if suffix.endswith("<i>"):
+            # Parameterized shard suffix: any concrete _s<digit> role in
+            # the matrix covers the construction site.
+            pat = re.escape(suffix[:-3]) + r"\d"
+        else:
+            # Delimited match: a helper identifier like ``_dsvc_splits``
+            # must not count as ``_ds`` coverage — the suffix has to END
+            # there (quote, colon, comma...), like a real role string does.
+            pat = re.escape(suffix) + r"\b"
+        covered = re.search(pat, test_text) is not None
+        if not covered:
+            rel = cfg.rel(Path(src))
+            findings.append(Finding(
+                PASS, "role-uncovered", rel, suffix,
+                f"client-role suffix {suffix!r} (constructed at {rel}:"
+                f"{line}) never appears in the fault-test matrix — that "
+                "transport has zero injected-fault coverage",
+                line=line,
+            ))
+
+    kinds = fault_kinds(cfg.faults_py)
+    if not kinds:
+        findings.append(Finding(
+            PASS, "kinds-missing", cfg.rel(cfg.faults_py), "_KINDS",
+            "could not extract any fault kinds from the faults module",
+        ))
+    for kind in kinds:
+        if not re.search(rf"\b{re.escape(kind)}:", test_text):
+            findings.append(Finding(
+                PASS, "kind-uncovered", cfg.rel(cfg.faults_py), kind,
+                f"DTX_FAULT_PLAN kind {kind!r} has no test exercising it "
+                "(no '<kind>:' spec in the fault-test files)",
+            ))
+    return findings

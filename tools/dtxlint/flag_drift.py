@@ -1,0 +1,222 @@
+"""Pass 4: flag drift — the ``utils/flags.py`` surface vs reality.
+
+Three checks:
+
+- ``flag-orphan``      a flag defined in ``utils/flags.py`` that nothing
+                       outside its definition references (no ``FLAGS.x``,
+                       no ``getattr(FLAGS, "x")``, no ``--x`` in code or
+                       scripts): dead surface that silently rots.
+- ``flag-undocumented``a defined flag with no ``--x`` mention in
+                       RUNBOOK.md: operators can't discover it.
+- ``flag-undefined``   a ``FLAGS.x`` / ``getattr(FLAGS, "x")`` access for
+                       an ``x`` no ``_define``/``DEFINE_*`` call in the
+                       repo (and no absl built-in) defines: an AttributeError
+                       waiting for the first run that reaches it.
+
+Scanned reference corpus: every ``.py``/``.sh``/``.md`` under the
+configured reference dirs plus the repo-root scripts; the flags module
+itself is excluded for the orphan check (a definition is not a use).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from . import Finding, LintConfig
+
+PASS = "flag_drift"
+
+#: Flags absl itself (or its logging integration) defines — accesses to
+#: these are never "undefined", and utils/flags.py deliberately adopts
+#: some of their names (--log_dir).
+ABSL_BUILTINS = {
+    "alsologtostderr", "logtostderr", "log_dir", "verbosity", "v",
+    "stderrthreshold", "showprefixforinfo", "only_check_args",
+    "run_with_pdb", "pdb", "pdb_post_mortem", "run_with_profiling",
+    "profile_file", "use_cprofile_for_profiling", "logger_levels",
+    "log_file", "help", "helpfull", "helpshort", "helpxml", "flagfile",
+    "undefok",
+}
+
+
+def defined_flags(flags_py: Path) -> dict[str, int]:
+    """``{flag name: line}`` for every ``_define(kind, "name", ...)`` and
+    direct ``flags.DEFINE_*("name", ...)`` in the flags module."""
+    tree = ast.parse(flags_py.read_text())
+    out: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        fname = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else ""
+        )
+        name_arg = None
+        if fname == "_define" and len(node.args) >= 2:
+            name_arg = node.args[1]
+        elif fname.startswith("DEFINE_") and node.args:
+            name_arg = node.args[0]
+        if isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str):
+            out.setdefault(name_arg.value, node.lineno)
+    return out
+
+
+def repo_defined_flags(files: list[Path]) -> set[str]:
+    """Flag names defined ANYWHERE in the corpus via ``DEFINE_*`` /
+    ``_define`` (examples define their own model flags)."""
+    names: set[str] = set()
+    # Two shapes: direct absl DEFINE_* (flag name first) and the local
+    # ``_define(kind, name, ...)`` helper (kind string first).  Separate
+    # patterns — one combined optional-group regex would let the kind
+    # group swallow a DEFINE_enum's flag name and capture its default.
+    pats = [
+        re.compile(r"DEFINE_\w+\(\s*[\"']([a-z][a-z0-9_]*)[\"']"),
+        re.compile(
+            r"_define\(\s*[\"']\w+[\"']\s*,\s*[\"']([a-z][a-z0-9_]*)[\"']"
+        ),
+    ]
+    for path in files:
+        if path.suffix != ".py":
+            continue
+        try:
+            text = path.read_text()
+        except OSError:
+            continue
+        for pat in pats:
+            names.update(pat.findall(text))
+    return names
+
+
+# The FLAGS object travels under several spellings (module-level FLAGS, a
+# ``flags``/``flags_obj`` parameter) — getattr matching is case-insensitive
+# on any identifier containing "flags".
+_ACCESS_RES = [
+    re.compile(r"\bFLAGS\.([a-z][a-z0-9_]*)"),
+    re.compile(
+        r"getattr\(\s*[\w.]*flags[\w.]*\s*,\s*[\"']([a-z][a-z0-9_]*)[\"']",
+        re.IGNORECASE,
+    ),
+]
+
+
+def flag_accesses(files: list[Path]) -> dict[str, list[tuple[str, int]]]:
+    """``{flag: [(file, line)]}`` for every FLAGS attribute access."""
+    out: dict[str, list[tuple[str, int]]] = {}
+    for path in files:
+        if path.suffix != ".py":
+            continue
+        try:
+            lines = path.read_text().splitlines()
+        except OSError:
+            continue
+        for i, line in enumerate(lines, 1):
+            for rex in _ACCESS_RES:
+                for m in rex.finditer(line):
+                    out.setdefault(m.group(1), []).append((str(path), i))
+    return out
+
+
+def _corpus(cfg: LintConfig) -> list[Path]:
+    files: list[Path] = []
+    seen: set[Path] = set()
+    for d in cfg.flag_reference_dirs:
+        if d.is_file():
+            cand = [d]
+        else:
+            cand = [
+                p for p in sorted(d.rglob("*"))
+                if p.suffix in (".py", ".sh", ".md") and p.is_file()
+                and "__pycache__" not in p.parts
+                # the linter's own sources (and its tests' fixture
+                # strings) mention flag spellings as DATA
+                and not any("dtxlint" in part for part in p.parts)
+            ]
+        for p in cand:
+            if p not in seen:
+                seen.add(p)
+                files.append(p)
+    for extra in sorted(cfg.root.glob("*.py")) + sorted(cfg.root.glob("*.md")):
+        if extra not in seen:
+            seen.add(extra)
+            files.append(extra)
+    return files
+
+
+def run(cfg: LintConfig) -> list[Finding]:
+    findings: list[Finding] = []
+    flags_rel = cfg.rel(cfg.flags_py)
+    defined = defined_flags(cfg.flags_py)
+    corpus = _corpus(cfg)
+    non_def_corpus = [p for p in corpus if p.resolve() != cfg.flags_py.resolve()]
+
+    # Reference text per file (flag module excluded for the orphan check).
+    # Docs are excluded too: a RUNBOOK/README mention is documentation, not
+    # a use — counting it would make the orphan check vacuous for exactly
+    # the flags the undocumented check forces into RUNBOOK.md.
+    texts: dict[Path, str] = {}
+    for p in non_def_corpus:
+        if p.suffix == ".md":
+            continue
+        try:
+            texts[p] = p.read_text()
+        except OSError:
+            continue
+    flags_py_text = cfg.flags_py.read_text()
+
+    runbook_text = (
+        cfg.runbook_md.read_text() if cfg.runbook_md.exists() else ""
+    )
+
+    for name, line in sorted(defined.items()):
+        ref_res = [
+            re.compile(rf"\bFLAGS\.{name}\b"),
+            re.compile(
+                rf"getattr\(\s*[\w.]*flags[\w.]*\s*,\s*[\"']{name}[\"']",
+                re.IGNORECASE,
+            ),
+            re.compile(rf"--{name}\b"),
+            re.compile(rf"[\"']{name}[\"']\s*(?:in|not in)\s+\w*FLAGS"),
+        ]
+        referenced = any(
+            rex.search(text) for text in texts.values() for rex in ref_res
+        )
+        if not referenced:
+            # A self-reference elsewhere in flags.py (resolve_legacy_cluster
+            # etc.) also counts — but only OUTSIDE the defining call, which
+            # the FLAGS./getattr forms guarantee.
+            referenced = any(
+                rex.search(flags_py_text) for rex in ref_res[:2]
+            ) or re.search(rf"[\"']{name}[\"']\s*(?:in|not in)\s+\w*FLAGS",
+                           flags_py_text)
+        if not referenced:
+            findings.append(Finding(
+                PASS, "flag-orphan", flags_rel, name,
+                f"flag --{name} (defined at {flags_rel}:{line}) is never "
+                "referenced outside its definition — dead surface",
+                line=line,
+            ))
+        if not re.search(rf"--{name}\b", runbook_text):
+            findings.append(Finding(
+                PASS, "flag-undocumented", cfg.rel(cfg.runbook_md), name,
+                f"flag --{name} is not mentioned in RUNBOOK.md — operators "
+                "cannot discover it",
+                line=line,
+            ))
+
+    all_defined = (
+        set(defined) | repo_defined_flags(corpus) | ABSL_BUILTINS
+    )
+    for name, sites in sorted(flag_accesses(non_def_corpus).items()):
+        if name in all_defined:
+            continue
+        src, line = sites[0]
+        findings.append(Finding(
+            PASS, "flag-undefined", cfg.rel(Path(src)), name,
+            f"FLAGS.{name} is referenced (first at {cfg.rel(Path(src))}:"
+            f"{line}, {len(sites)} site(s)) but no DEFINE/_define in the "
+            "repo defines it",
+            line=line,
+        ))
+    return findings

@@ -1,0 +1,556 @@
+"""Pass 1: wire-protocol conformance (Python registries vs C++ server).
+
+Sources of truth:
+
+- ``parallel/wire.py`` owns every op/status number (``PS_OPS``,
+  ``DSVC_OPS``, ``SRV_OPS``, ``DSVC_STATUS``, ``SRV_STATUS``) plus the
+  HELLO bit-field layout constants.
+- ``native/ps_server.cc`` is the independently-written C++ mirror: its
+  ``enum Op``, ``constexpr`` layout constants and ``case`` dispatch labels
+  are parsed here and pinned against the Python side.
+
+Checks (finding codes):
+
+- ``op-drift`` / ``op-missing``   PS_OPS vs enum Op name+number parity,
+                                  both directions.
+- ``case-missing``                an enum op with no ``case`` in the C++
+                                  dispatch switch (a client could send it
+                                  and silently get -2).
+- ``const-drift``                 WIRE_VERSION / HELLO shard shifts+mask /
+                                  shard-mismatch base / dedup-tag layout
+                                  disagree between the sides.
+- ``op-collision``                op numbers overlapping across services
+                                  (HELLO's shared code point excepted) or
+                                  duplicated within one registry.
+- ``status-collision``            duplicate negative statuses within a
+                                  service, or a service status inside the
+                                  reserved wrong-service band.
+- ``dispatch-missing``            a Python client sends an op its Python
+                                  server never compares against.
+- ``status-unhandled``            a server status constant no client-side
+                                  code references (allowlist via baseline).
+- ``literal-restated``            a service module binds a protocol-looking
+                                  name to a numeric literal instead of
+                                  aliasing the wire.py registry.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from . import Finding, LintConfig
+
+PASS = "wire"
+
+#: Python wire.py names checked against C++ constexprs, by pair.
+_CONST_PAIRS = {
+    "WIRE_VERSION": "kWireVersion",
+    "HELLO_SHARD_ID_SHIFT": "kHelloShardIdShift",
+    "HELLO_SHARD_COUNT_SHIFT": "kHelloShardCountShift",
+    "HELLO_SHARD_MASK": "kHelloShardMask",
+}
+
+#: Registry-name prefixes per service, for the literal-restated check and
+#: the client-op collection.  Namespace prefixes (ACC_/TQ_/GQ_/PSTORE_)
+#: require the underscore; standalone ops must match exactly — else
+#: innocent constants like ``_ACCEPT_BACKLOG`` or ``_PING_INTERVAL_S``
+#: read as restated protocol numbers and fail the lint.
+_PS_NAME = re.compile(
+    r"^_?(?:(?:ACC|TQ|GQ|PSTORE)_\w+|CANCEL_ALL|PING|INCARNATION|HELLO)$"
+)
+_DSVC_NAME = re.compile(r"^DSVC_\w+$")
+_SRV_NAME = re.compile(r"^SRV_\w+$")
+
+
+# ----------------------------------------------------------------------------
+# Extraction — Python side
+# ----------------------------------------------------------------------------
+
+
+def module_int_dicts(path: Path) -> dict[str, dict[str, int]]:
+    """Top-level ``NAME = {"K": int, ...}`` dict literals of a module
+    (plain and annotated assignments; values may be negative literals)."""
+    tree = ast.parse(path.read_text())
+    out: dict[str, dict[str, int]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            tgt, val = node.targets[0], node.value
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            tgt, val = node.target, node.value
+        else:
+            continue
+        if not isinstance(val, ast.Dict):
+            continue
+        d: dict[str, int] = {}
+        ok = True
+        for k, v in zip(val.keys, val.values):
+            vi = _const_int(v)
+            if (
+                isinstance(k, ast.Constant)
+                and isinstance(k.value, str)
+                and vi is not None
+            ):
+                d[k.value] = vi
+            else:
+                ok = False
+                break
+        if ok and d:
+            out[tgt.id] = d
+    return out
+
+
+def module_int_consts(path: Path) -> dict[str, int]:
+    """Top-level ``NAME = <int literal>`` (incl. unary minus) constants."""
+    tree = ast.parse(path.read_text())
+    out: dict[str, int] = {}
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            targets, value = [node.target], node.value
+        else:
+            continue
+        v = _const_int(value)
+        if v is None:
+            continue
+        for t in targets:
+            out[t.id] = v
+    return out
+
+
+def _const_int(node) -> int | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if (
+        isinstance(node, ast.UnaryOp)
+        and isinstance(node.op, ast.USub)
+        and isinstance(node.operand, ast.Constant)
+        and isinstance(node.operand.value, int)
+    ):
+        return -node.operand.value
+    return None
+
+
+def tag_layout(native_init_py: Path) -> tuple[int | None, int | None]:
+    """``(worker_shift, worker_bits)`` from ``native.__init__._tag``: the
+    ``worker << N`` shift and the ``1 << B`` worker range bound."""
+    tree = ast.parse(native_init_py.read_text())
+    shift = bits = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "_tag":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.LShift):
+                    left, right = sub.left, sub.right
+                    if (
+                        isinstance(left, ast.Name)
+                        and left.id == "worker"
+                        and isinstance(right, ast.Constant)
+                    ):
+                        shift = right.value
+                    elif (
+                        isinstance(left, ast.Constant)
+                        and left.value == 1
+                        and isinstance(right, ast.Constant)
+                        and bits is None
+                    ):
+                        # first ``1 << B`` is the worker range check
+                        bits = right.value
+    return shift, bits
+
+
+# ----------------------------------------------------------------------------
+# Extraction — C++ side (regex parse; the server is one translation unit)
+# ----------------------------------------------------------------------------
+
+_ENUM_RE = re.compile(r"enum\s+Op\s*:\s*\w+\s*\{(.*?)\};", re.S)
+_ENUM_ENTRY_RE = re.compile(
+    # Trailing comma optional: the LAST enum entry is legal without one,
+    # and silently dropping it would misreport the op as absent.
+    r"^\s*([A-Z][A-Z0-9_]*)\s*=\s*(\d+)\s*(?:,|$)", re.M
+)
+_CONSTEXPR_RE = re.compile(
+    r"constexpr\s+(?:u?int\d*_t|int|unsigned|size_t)\s+(k\w+)\s*=\s*([^;]+);"
+)
+_CASE_RE = re.compile(r"^\s*case\s+([A-Z][A-Z0-9_]*)\s*:", re.M)
+_MISMATCH_BASE_RE = re.compile(r"status\s*=\s*(-\d+)\s*-")
+
+
+def parse_cc(path: Path) -> dict:
+    """``{"ops": {...}, "consts": {...}, "cases": set, "mismatch_base"}``"""
+    text = path.read_text()
+    ops: dict[str, int] = {}
+    m = _ENUM_RE.search(text)
+    if m:
+        for name, num in _ENUM_ENTRY_RE.findall(m.group(1)):
+            ops[name] = int(num)
+    consts: dict[str, int] = {}
+    for name, expr in _CONSTEXPR_RE.findall(text):
+        expr = expr.strip()
+        try:
+            consts[name] = int(expr, 0)
+        except ValueError:
+            continue  # computed expression (masks built from shifts): skip
+    cases = set(_CASE_RE.findall(text))
+    mm = _MISMATCH_BASE_RE.search(text)
+    return {
+        "ops": ops,
+        "consts": consts,
+        "cases": cases,
+        "mismatch_base": int(mm.group(1)) if mm else None,
+    }
+
+
+# ----------------------------------------------------------------------------
+# Extraction — Python client/server op usage
+# ----------------------------------------------------------------------------
+
+_CALL_METHODS = {"call", "_attempt", "ensure_object", "timed_blocking"}
+
+
+def client_sent_ops(path: Path, name_re: re.Pattern) -> set[str]:
+    """Protocol-op NAMES passed (positionally or as ``op=``/``a`` keyword
+    spellings aside — the op is always the first argument) to transport
+    call methods anywhere in ``path``."""
+    tree = ast.parse(path.read_text())
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        mname = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else ""
+        )
+        if mname not in _CALL_METHODS:
+            continue
+        args = list(node.args)
+        if not args and node.keywords:
+            args = [kw.value for kw in node.keywords if kw.arg == "op"]
+        if not args:
+            continue
+        op = args[0]
+        if isinstance(op, ast.Name) and name_re.match(op.id):
+            used.add(op.id)
+        elif (
+            isinstance(op, ast.Attribute)
+            and name_re.match(op.attr)
+        ):
+            used.add(op.attr)
+    return used
+
+
+def server_handled_ops(path: Path, name_re: re.Pattern) -> set[str]:
+    """Protocol-op NAMES a Python server compares its ``op`` against
+    (``op == NAME`` / ``op in (...)`` inside the module)."""
+    tree = ast.parse(path.read_text())
+    handled: set[str] = set()
+
+    def names_of(node):
+        if isinstance(node, ast.Name) and name_re.match(node.id):
+            yield node.id
+        elif isinstance(node, ast.Attribute) and name_re.match(node.attr):
+            yield node.attr
+        elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            for elt in node.elts:
+                yield from names_of(elt)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        if not any(isinstance(s, ast.Name) and s.id == "op" for s in sides):
+            continue
+        for s in sides:
+            handled.update(names_of(s))
+    return handled
+
+
+def class_referenced_names(path: Path, class_names: set[str]) -> set[str]:
+    """Every bare Name (and trailing attribute) referenced inside the given
+    classes — the 'does client code look at this status' corpus."""
+    tree = ast.parse(path.read_text())
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name in class_names:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+                elif isinstance(sub, ast.Attribute):
+                    out.add(sub.attr)
+    return out
+
+
+def restated_literals(path: Path, registry_names: set[str]) -> list[tuple[str, int]]:
+    """``(name, line)`` for module-level assignments binding a protocol-ish
+    NAME to a bare numeric literal (or tuple of them) — the drift the
+    registries exist to prevent.  Aliases (``X = wire.PS_OPS["..."]``) and
+    non-module-level code are fine."""
+    tree = ast.parse(path.read_text())
+    bad: list[tuple[str, int]] = []
+    for node in tree.body:
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        value = node.value
+        flat: list[tuple[ast.expr, ast.expr]] = []
+        for t in targets:
+            if isinstance(t, ast.Tuple) and isinstance(value, ast.Tuple):
+                flat.extend(zip(t.elts, value.elts))
+            else:
+                flat.append((t, value))
+        for t, v in flat:
+            if not isinstance(t, ast.Name):
+                continue
+            base = t.id.lstrip("_")
+            if base not in registry_names and not (
+                _PS_NAME.match(t.id) or _DSVC_NAME.match(t.id) or _SRV_NAME.match(t.id)
+            ):
+                continue
+            if _const_int(v) is not None:
+                bad.append((t.id, t.lineno))
+    return bad
+
+
+# ----------------------------------------------------------------------------
+# The pass
+# ----------------------------------------------------------------------------
+
+
+def run(cfg: LintConfig) -> list[Finding]:
+    findings: list[Finding] = []
+    wire_rel = cfg.rel(cfg.wire_py)
+    cc_rel = cfg.rel(cfg.ps_server_cc)
+
+    dicts = module_int_dicts(cfg.wire_py)
+    consts = module_int_consts(cfg.wire_py)
+    ps_ops = dicts.get("PS_OPS", {})
+    dsvc_ops = dicts.get("DSVC_OPS", {})
+    srv_ops = dicts.get("SRV_OPS", {})
+    dsvc_status = dicts.get("DSVC_STATUS", {})
+    srv_status = dicts.get("SRV_STATUS", {})
+
+    for name, d in (
+        ("PS_OPS", ps_ops), ("DSVC_OPS", dsvc_ops), ("SRV_OPS", srv_ops),
+        ("DSVC_STATUS", dsvc_status), ("SRV_STATUS", srv_status),
+    ):
+        if not d:
+            findings.append(Finding(
+                PASS, "registry-missing", wire_rel, name,
+                f"{name} not found as an int-dict literal in {wire_rel}",
+            ))
+    cc = parse_cc(cfg.ps_server_cc)
+
+    # -- PS_OPS <-> enum Op parity, both directions --------------------------
+    for name, num in sorted(ps_ops.items()):
+        if name not in cc["ops"]:
+            findings.append(Finding(
+                PASS, "op-missing", cc_rel, name,
+                f"PS op {name}={num} has no enum Op entry in {cc_rel}",
+            ))
+        elif cc["ops"][name] != num:
+            findings.append(Finding(
+                PASS, "op-drift", cc_rel, name,
+                f"PS op {name}: Python says {num}, C++ enum says "
+                f"{cc['ops'][name]}",
+            ))
+    for name, num in sorted(cc["ops"].items()):
+        if name not in ps_ops:
+            findings.append(Finding(
+                PASS, "op-missing", wire_rel, name,
+                f"C++ enum op {name}={num} is absent from wire.PS_OPS",
+            ))
+
+    # -- every enum op must have a dispatch case -----------------------------
+    for name in sorted(cc["ops"]):
+        if name not in cc["cases"]:
+            findings.append(Finding(
+                PASS, "case-missing", cc_rel, name,
+                f"op {name} has no `case {name}:` in the C++ dispatch "
+                "switch — a client sending it gets a silent -2",
+            ))
+
+    # -- layout constant parity ---------------------------------------------
+    for py_name, cc_name in _CONST_PAIRS.items():
+        if py_name not in consts:
+            findings.append(Finding(
+                PASS, "const-drift", wire_rel, py_name,
+                f"{py_name} not found as an int literal in {wire_rel}",
+            ))
+        elif cc_name not in cc["consts"]:
+            findings.append(Finding(
+                PASS, "const-drift", cc_rel, py_name,
+                f"{cc_name} not found as a parseable constexpr in {cc_rel}",
+            ))
+        elif consts[py_name] != cc["consts"][cc_name]:
+            findings.append(Finding(
+                PASS, "const-drift", cc_rel, py_name,
+                f"{py_name}={consts[py_name]} (Python) vs "
+                f"{cc_name}={cc['consts'][cc_name]} (C++)",
+            ))
+    mm_base = consts.get("HELLO_SHARD_MISMATCH")
+    if mm_base is not None and cc["mismatch_base"] is not None:
+        if mm_base != cc["mismatch_base"]:
+            findings.append(Finding(
+                PASS, "const-drift", cc_rel, "HELLO_SHARD_MISMATCH",
+                f"shard-mismatch status base: Python {mm_base} vs C++ "
+                f"{cc['mismatch_base']}",
+            ))
+
+    # -- dedup-tag layout ----------------------------------------------------
+    shift, bits = tag_layout(cfg.native_init_py)
+    cc_shift = cc["consts"].get("kTagWorkerShift")
+    if shift is not None and cc_shift is not None and shift != cc_shift:
+        findings.append(Finding(
+            PASS, "const-drift", cfg.rel(cfg.native_init_py), "tag-shift",
+            f"_tag packs worker at bit {shift}, C++ kTagWorkerShift is "
+            f"{cc_shift}",
+        ))
+    if bits is not None and cc_shift is not None and bits != 63 - cc_shift:
+        findings.append(Finding(
+            PASS, "const-drift", cfg.rel(cfg.native_init_py), "tag-bits",
+            f"_tag allows {bits}-bit workers; the signed-i64 wire layout "
+            f"allows {63 - cc_shift} (63 - kTagWorkerShift)",
+        ))
+
+    # -- op collisions -------------------------------------------------------
+    registries = {"PS_OPS": ps_ops, "DSVC_OPS": dsvc_ops, "SRV_OPS": srv_ops}
+    for rname, reg in registries.items():
+        by_num: dict[int, list[str]] = {}
+        for name, num in reg.items():
+            by_num.setdefault(num, []).append(name)
+        for num, names in sorted(by_num.items()):
+            if len(names) > 1:
+                findings.append(Finding(
+                    PASS, "op-collision", wire_rel, f"{rname}:{num}",
+                    f"{rname} maps {sorted(names)} all to {num}",
+                ))
+    reg_items = list(registries.items())
+    for i, (an, a) in enumerate(reg_items):
+        for bn, b in reg_items[i + 1:]:
+            for name, num in sorted(a.items()):
+                for name2, num2 in sorted(b.items()):
+                    if num != num2:
+                        continue
+                    if name == "HELLO" and name2 == "HELLO":
+                        continue  # the ONE deliberately shared code point
+                    findings.append(Finding(
+                        PASS, "op-collision", wire_rel,
+                        f"{an}.{name}/{bn}.{name2}",
+                        f"op number {num} is claimed by both {an}[{name!r}] "
+                        f"and {bn}[{name2!r}] — a frame reaching the wrong "
+                        "service would be EXECUTED, not refused",
+                    ))
+    # HELLO must be the same code point everywhere it exists.
+    hellos = {
+        rn: reg["HELLO"] for rn, reg in registries.items() if "HELLO" in reg
+    }
+    if len(set(hellos.values())) > 1:
+        findings.append(Finding(
+            PASS, "op-collision", wire_rel, "HELLO",
+            f"HELLO code point differs across services: {hellos}",
+        ))
+
+    # -- status collisions ---------------------------------------------------
+    wrong_base = consts.get("WRONG_SERVICE_BASE")
+    service_ids = dicts.get("SERVICE_IDS", {})
+    # Wrong-service answers are ``base - service_id`` for ids 1..N — the
+    # base itself is NOT a reserved code point.
+    band = (
+        set(range(wrong_base - len(service_ids), wrong_base))
+        if wrong_base is not None and service_ids
+        else set()
+    )
+    for sname, statuses in (
+        ("DSVC_STATUS", dsvc_status), ("SRV_STATUS", srv_status)
+    ):
+        neg: dict[int, list[str]] = {}
+        for name, num in statuses.items():
+            if num < 0:
+                neg.setdefault(num, []).append(name)
+            if num in band:
+                findings.append(Finding(
+                    PASS, "status-collision", wire_rel, f"{sname}.{name}",
+                    f"{sname}[{name!r}]={num} sits inside the reserved "
+                    f"wrong-service band around {wrong_base}",
+                ))
+        for num, names in sorted(neg.items()):
+            if len(names) > 1:
+                findings.append(Finding(
+                    PASS, "status-collision", wire_rel, f"{sname}:{num}",
+                    f"{sname} maps {sorted(names)} all to {num} — error "
+                    "statuses must be distinguishable",
+                ))
+
+    # -- client-sent ops must be dispatched ----------------------------------
+    # Native PS wire: ops ps_service.py sends vs the C++ case labels.
+    ps_client_ops = client_sent_ops(cfg.ps_service_py, _PS_NAME)
+    for op_name in sorted(ps_client_ops):
+        canon = op_name.lstrip("_")
+        if canon in ps_ops and canon not in cc["cases"]:
+            findings.append(Finding(
+                PASS, "dispatch-missing", cc_rel, canon,
+                f"client sends {canon} but the C++ server has no case for it",
+            ))
+    # Python services: dsvc and msrv clients vs their servers.
+    for client_files, server_file, name_re, what in (
+        ([cfg.dsvc_py], cfg.dsvc_py, _DSVC_NAME, "dsvc"),
+        ([cfg.serve_client_py], cfg.msrv_py, _SRV_NAME, "msrv"),
+    ):
+        sent: set[str] = set()
+        for f in client_files:
+            sent |= client_sent_ops(f, name_re)
+        handled = server_handled_ops(server_file, name_re)
+        for op_name in sorted(sent - handled):
+            findings.append(Finding(
+                PASS, "dispatch-missing", cfg.rel(server_file), op_name,
+                f"{what} client sends {op_name} but the server never "
+                "compares op against it — the request would fall through "
+                "to the generic ERR reply",
+            ))
+
+    # -- server statuses must be consumed client-side ------------------------
+    dsvc_client_names = class_referenced_names(
+        cfg.dsvc_py,
+        {"DataServiceClient", "RemoteDatasetSource", "_BatchPrefetcher"},
+    )
+    msrv_client_names = class_referenced_names(
+        cfg.serve_client_py, {"ServeClient", "ServePool"}
+    )
+    for sname, statuses, corpus, where in (
+        ("DSVC_STATUS", dsvc_status, dsvc_client_names, cfg.rel(cfg.dsvc_py)),
+        (
+            "SRV_STATUS", srv_status, msrv_client_names,
+            cfg.rel(cfg.serve_client_py),
+        ),
+    ):
+        for name in sorted(statuses):
+            if name not in corpus:
+                findings.append(Finding(
+                    PASS, "status-unhandled", where, f"{sname}.{name}",
+                    f"server status {name} is never referenced by the "
+                    "client-side classes — handle it or allowlist it in "
+                    "the baseline with a reason",
+                ))
+
+    # -- no protocol literal outside wire.py ---------------------------------
+    registry_names = (
+        set(ps_ops) | set(dsvc_ops) | set(srv_ops)
+        | set(dsvc_status) | set(srv_status)
+        | {f"DSVC_{k}" for k in dsvc_ops} | {f"SRV_{k}" for k in srv_ops}
+    )
+    for path in cfg.service_files:
+        for name, line in restated_literals(path, registry_names):
+            findings.append(Finding(
+                PASS, "literal-restated", cfg.rel(path), name,
+                f"{name} is bound to a numeric literal here — protocol "
+                "numbers live in parallel/wire.py only (alias the registry)",
+                line=line,
+            ))
+    return findings

@@ -1,0 +1,23 @@
+"""Campaign entry point for dtxlint (r11).
+
+The campaign plan invokes steps as ``python <script path>`` (the plan
+smoke test asserts every target exists on disk), but dtxlint is a package
+with relative imports, so ``python tools/dtxlint/__main__.py`` would not
+import.  This shim bridges the two: it puts the repo root on sys.path and
+runs the package CLI in compact-JSON mode, whose single output line is
+what ``measure_campaign.last_json_line`` records for ``campaign_report``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.dtxlint.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(["--json", "--compact"] + sys.argv[1:]))

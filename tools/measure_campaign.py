@@ -153,6 +153,13 @@ def steps_plan() -> list[dict]:
         dict(name="serving_bench",
              cmd=[PY, "tools/serving_bench.py"], timeout=900,
              cpu_ok=True),
+        # Static analysis (r11): wire conformance + concurrency +
+        # fault-coverage + flag drift.  Pure AST/regex work, so cpu_ok; a
+        # non-empty finding set fails the step (rc=1) and the campaign
+        # records exactly which invariant drifted.
+        dict(name="dtxlint",
+             cmd=[PY, "tools/dtxlint_step.py"], timeout=600,
+             cpu_ok=True),
     ]
     return plan
 
