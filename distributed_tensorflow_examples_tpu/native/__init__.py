@@ -136,6 +136,33 @@ def _load() -> ctypes.CDLL:
             ctypes.c_int,
             ctypes.c_int,
         ]
+        lib.ps_server_start_replicated.restype = ctypes.c_int
+        lib.ps_server_start_replicated.argtypes = [
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int64,
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_int64,
+        ]
+        lib.ps_server_set_peer.restype = ctypes.c_int
+        lib.ps_server_set_peer.argtypes = [
+            ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.c_int,
+        ]
+        lib.ps_server_resync_port.restype = ctypes.c_int
+        lib.ps_server_resync_port.argtypes = [ctypes.c_int, ctypes.c_int64]
+        lib.ps_server_set_partitioned.restype = ctypes.c_int
+        lib.ps_server_set_partitioned.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.ps_server_state_token_port.restype = ctypes.c_int64
+        lib.ps_server_state_token_port.argtypes = [ctypes.c_int]
+        lib.ps_server_diverged_port.restype = ctypes.c_int
+        lib.ps_server_diverged_port.argtypes = [ctypes.c_int]
+        lib.ps_server_live_conns_port.restype = ctypes.c_int
+        lib.ps_server_live_conns_port.argtypes = [ctypes.c_int]
         lib.ps_server_incarnation.restype = ctypes.c_int64
         lib.ps_server_requests.restype = ctypes.c_int64
         lib.ps_server_incarnation_port.restype = ctypes.c_int64

@@ -56,6 +56,24 @@ struct DedupTable {
 
   void record(int64_t worker, int64_t seq) { last_seq[worker] = seq; }
 
+  // Replication (r12) export/import: the table IS the replay-idempotence
+  // state, so a backup must mirror it for at-most-once to survive a
+  // failover.  Owner's mutex held by the callers below.
+  int64_t export_to(int64_t* workers, int64_t* seqs, int64_t cap) const {
+    int64_t i = 0;
+    for (const auto& kv : last_seq) {
+      if (i >= cap) return -1;  // caller re-sizes and retries
+      workers[i] = kv.first;
+      seqs[i] = kv.second;
+      ++i;
+    }
+    return i;
+  }
+
+  void import_from(int64_t n, const int64_t* workers, const int64_t* seqs) {
+    for (int64_t i = 0; i < n; ++i) last_seq[workers[i]] = seqs[i];
+  }
+
   // Forget a worker's history: a RESTARTED worker process (fresh client,
   // fresh 0-based sequence counter, same worker id) announces itself so
   // its new stream is not answered "duplicate" against its dead
@@ -170,6 +188,59 @@ void acc_reset_worker(void* h, int64_t worker) {
   auto* a = static_cast<Accumulator*>(h);
   std::lock_guard<std::mutex> lock(a->mu);
   a->dedup.reset_worker(worker);
+}
+
+// --- replication mirror/state ops (r12) -------------------------------------
+// A backup replica mirrors an accumulator's COORDINATION state — dedup
+// table, staleness gate, counters — not its transient sum (in-flight
+// aggregations keep the existing at-most-once posture; the chief's
+// stall-repush heals their loss).  acc_mirror_tagged is the payload-less
+// form of acc_apply_tagged the primary forwards: same dedup/staleness
+// bookkeeping, same return codes, nothing summed.
+
+int acc_mirror_tagged(void* h, int64_t local_step, int64_t worker,
+                      int64_t seq) {
+  auto* a = static_cast<Accumulator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  if (a->dedup.check_duplicate(worker, seq)) return 2;
+  a->dedup.record(worker, seq);
+  if (local_step < a->global_step) {
+    ++a->dropped;
+    return 0;
+  }
+  return 1;
+}
+
+int64_t acc_global_step(void* h) {
+  auto* a = static_cast<Accumulator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return a->global_step;
+}
+
+int64_t acc_dedup_export(void* h, int64_t* workers, int64_t* seqs,
+                         int64_t cap) {
+  auto* a = static_cast<Accumulator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return a->dedup.export_to(workers, seqs, cap);
+}
+
+int64_t acc_dedup_size(void* h) {
+  auto* a = static_cast<Accumulator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return static_cast<int64_t>(a->dedup.last_seq.size());
+}
+
+// Restore a synced-from-peer accumulator's coordination state (REPL_SYNC
+// install path; runs before the restarted server accepts connections).
+void acc_restore(void* h, int64_t global_step, int64_t dropped,
+                 int64_t deduped, int64_t n, const int64_t* workers,
+                 const int64_t* seqs) {
+  auto* a = static_cast<Accumulator*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  a->global_step = global_step;
+  a->dropped = dropped;
+  a->dedup.deduped = deduped;
+  a->dedup.import_from(n, workers, seqs);
 }
 
 // Deadline-bounded take (fault recovery: a waiter must be able to notice a
@@ -355,6 +426,58 @@ void gq_reset_worker(void* h, int64_t worker) {
   auto* q = static_cast<GradQueue*>(h);
   std::lock_guard<std::mutex> lock(q->mu);
   q->dedup.reset_worker(worker);
+}
+
+// --- replication mirror/state ops (r12) — see acc_mirror_tagged -------------
+// Queue CONTENTS are not mirrored (in-flight gradients keep the existing
+// at-most-once posture); the dedup table and staleness gate are, so a push
+// replayed against the surviving replica after a failover is answered
+// "duplicate", never applied twice.
+
+int gq_mirror_tagged(void* h, int64_t local_step, int64_t worker,
+                     int64_t seq) {
+  auto* q = static_cast<GradQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  if (q->dedup.check_duplicate(worker, seq)) return 2;
+  q->dedup.record(worker, seq);
+  if (local_step < q->min_step) {
+    ++q->dropped;
+    return 0;
+  }
+  return 1;
+}
+
+int64_t gq_min_step(void* h) {
+  auto* q = static_cast<GradQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  return q->min_step;
+}
+
+int64_t gq_capacity(void* h) {
+  return static_cast<int64_t>(static_cast<GradQueue*>(h)->capacity);
+}
+
+int64_t gq_dedup_export(void* h, int64_t* workers, int64_t* seqs,
+                        int64_t cap) {
+  auto* q = static_cast<GradQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  return q->dedup.export_to(workers, seqs, cap);
+}
+
+int64_t gq_dedup_size(void* h) {
+  auto* q = static_cast<GradQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  return static_cast<int64_t>(q->dedup.last_seq.size());
+}
+
+void gq_restore(void* h, int64_t min_step, int64_t dropped, int64_t deduped,
+                int64_t n, const int64_t* workers, const int64_t* seqs) {
+  auto* q = static_cast<GradQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->mu);
+  q->min_step = min_step;
+  q->dropped = dropped;
+  q->dedup.deduped = deduped;
+  q->dedup.import_from(n, workers, seqs);
 }
 
 // Deadline-bounded pop: timeout_ms <= 0 blocks forever; returns the

@@ -91,6 +91,20 @@ int64_t pstore_get(void*, float*);
 int64_t pstore_step(void*);
 int64_t pstore_get_if_newer(void*, int64_t, float*);
 int64_t pstore_num_elems(void*);
+// Replication mirror/state ops (r12, accumulator.cc).
+int acc_mirror_tagged(void*, int64_t, int64_t, int64_t);
+int64_t acc_global_step(void*);
+int64_t acc_dedup_size(void*);
+int64_t acc_dedup_export(void*, int64_t*, int64_t*, int64_t);
+void acc_restore(void*, int64_t, int64_t, int64_t, int64_t, const int64_t*,
+                 const int64_t*);
+int gq_mirror_tagged(void*, int64_t, int64_t, int64_t);
+int64_t gq_min_step(void*);
+int64_t gq_capacity(void*);
+int64_t gq_dedup_size(void*);
+int64_t gq_dedup_export(void*, int64_t*, int64_t*, int64_t);
+void gq_restore(void*, int64_t, int64_t, int64_t, int64_t, const int64_t*,
+                const int64_t*);
 }
 
 namespace {
@@ -139,24 +153,50 @@ enum Op : uint8_t {
   // status = current step with an EMPTY payload — an unchanged-step pull
   // costs O(header), not O(params).
   PSTORE_GET_IF_NEWER = 27,
+  // Shard replication (r12).  REPL_SYNC: a (re)starting replica pulls its
+  // peer's full coordination state (objects, param snapshots, dedup
+  // tables, counters, state token) before serving — answered only on a
+  // repl-flagged connection; status = object count, payload = the raw
+  // state blob (4-byte units).  REPL_TOKEN: status = this server's state
+  // token (the state-LINEAGE id — inherited across restarts through
+  // REPL_SYNC, fresh only on a cold/empty start — which is what lets a
+  // client tell "state intact, just fail over / reconnect" from "state
+  // lost everywhere, reseed").
+  REPL_SYNC = 28,
+  REPL_TOKEN = 29,
 };
 
-constexpr int64_t kWireVersion = 2;
+// v3 (r12): HELLO b-word field relayout — see wire.py WIRE_VERSION.
+constexpr int64_t kWireVersion = 3;
 
-// Sharded PS (r9): HELLO's b operand additionally carries the SHARD
-// IDENTITY the client expects of this server — dtype in bits 0..7, the
-// expected shard id in bits 8..31 and the expected shard count in bits
-// 32..55.  shard_count == 0 means "no expectation" (every pre-r9 client:
+// Sharded PS (r9, field layout revised r12): HELLO's b operand
+// additionally carries the SHARD IDENTITY the client expects of this
+// server — dtype in bits 0..7, the expected shard id in bits 8..19, the
+// expected shard count in bits 20..31, the expected LAYOUT VERSION (shard
+// topology epoch) in bits 32..47 and the replication-peer flag at bit 48.
+// shard_count == 0 / layout 0 mean "no expectation" (every pre-r9 client:
 // their dtype codes are < 256, so the high bits are naturally zero).  A
-// non-zero expectation that mismatches the server's own (shard_id,
-// shard_count) answers -5 and leaves the connection's encoding untouched,
-// so a mis-wired dial — shard 2's client reaching shard 0's server, or an
-// N=2 client reaching an N=4 topology — fails loudly at connect instead
-// of silently training against the wrong slice of the parameter vector.
+// non-zero expectation that mismatches the server's own identity answers
+// -5 - packed(identity) and leaves the connection's encoding untouched,
+// so a mis-wired dial — shard 2's client reaching shard 0's server, an
+// N=2 client reaching an N=4 topology, or a stale-epoch client reaching a
+// resharded cluster — fails loudly at connect instead of silently
+// training against the wrong slice of the parameter vector.
 constexpr int64_t kHelloDtypeMask = 0xFF;
 constexpr int kHelloShardIdShift = 8;
-constexpr int kHelloShardCountShift = 32;
-constexpr int64_t kHelloShardMask = 0xFFFFFF;
+constexpr int kHelloShardCountShift = 20;
+constexpr int64_t kHelloShardMask = 0xFFF;
+constexpr int kHelloLayoutShift = 32;
+constexpr int64_t kHelloLayoutMask = 0xFFFF;
+constexpr int kHelloReplShift = 48;
+
+// Replication statuses (r12, parallel/wire.py parity).  kReplRefused: a
+// partitioned server refusing its peer's repl-flagged connection.
+// kReplDiverged: a replica refusing a state-MUTATING client op because it
+// can no longer replicate it — the loud split-brain error (reads still
+// serve; the operator heals the link and the lagging peer re-syncs).
+constexpr int64_t kReplRefused = -6;
+constexpr int64_t kReplDiverged = -7;
 
 // bf16 <-> f32 at the socket boundary (server-side storage stays f32).
 // Round-to-nearest-even, NaN kept quiet (the RNE carry would otherwise
@@ -207,6 +247,42 @@ struct Server {
   // pre-r9 topology).  HELLO validates a client's expectation against it.
   int shard_id = 0;
   int shard_count = 1;
+  // Layout version (r12): the shard-topology epoch this server belongs
+  // to.  0 = unversioned (every pre-r12 topology).  HELLO validates a
+  // client's non-zero expectation against it.
+  int64_t layout_version = 0;
+  // Replication (r12): the peer replica of this shard.  A non-empty peer
+  // makes this server FORWARD state-mutating ops over one repl-flagged
+  // connection (param-store sets with their payload; tagged apply/push as
+  // payload-less dedup/staleness mirrors), and makes a (re)start pull the
+  // peer's full state via REPL_SYNC before serving.
+  std::string peer_host;
+  int peer_port = 0;
+  // State token: the state-LINEAGE id.  Fresh-random on a cold (empty)
+  // start, INHERITED from the peer on a successful REPL_SYNC — so "token
+  // unchanged" tells a reconnecting client its shard's state survived
+  // (somewhere) even though this instance restarted.  Atomic: the live
+  // resync path (ps_server_resync_port) installs it while REPL_TOKEN
+  // handlers read it from serving threads.
+  std::atomic<int64_t> state_token{0};
+  // Partition injection (utils/faults.py `partition` kind): refuse the
+  // peer's repl connections and fail own forwards by policy.
+  std::atomic<bool> partitioned{false};
+  // Divergence latch: set when a forward was REFUSED (peer alive but the
+  // link is down by policy) — mutating client ops then answer
+  // kReplDiverged until the peer re-syncs.  A peer that is simply DEAD
+  // (connect refused / transport error) does NOT diverge: the survivor
+  // serves solo and the peer catches up via REPL_SYNC on restart.
+  std::atomic<bool> diverged{false};
+  // The forward link (serialized: one connection, one in-flight forward).
+  std::mutex fwd_mu;
+  int fwd_fd = -1;
+  std::chrono::steady_clock::time_point fwd_next_try{};
+  // Why the LAST dial failed (a FwdResult): a policy refusal must stay
+  // sticky across the dial-backoff window, or a publish-only workload —
+  // whose every attempt re-arms the backoff — would read FWD_PEER_DOWN
+  // forever and keep writing one-sided past a partitioned peer.
+  int fwd_last_fail = 0;
   // Incarnation id: unique per server instance, so a reconnecting client
   // can tell "same server, transient drop" (replay suffices) from "server
   // restarted, all state lost" (re-create objects, republish, re-seed).
@@ -335,6 +411,360 @@ void cancel_all(Server* s) {
   }
 }
 
+// ---------------------------------------------------------------------------
+// Replication (r12): forward link + REPL_SYNC state blob
+// ---------------------------------------------------------------------------
+
+enum FwdResult { FWD_OK = 0, FWD_PEER_DOWN = 1, FWD_REFUSED = 2 };
+
+int64_t fresh_token(int salt) {
+  const int64_t nanos = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                            std::chrono::system_clock::now().time_since_epoch())
+                            .count();
+  int64_t t = (nanos ^ (static_cast<int64_t>(::getpid()) << 36) ^
+               (static_cast<int64_t>(salt) << 24)) &
+              0x7FFFFFFFFFFFFFFF;
+  return t ? t : 1;
+}
+
+void sever_fwd_locked(Server* s) {
+  if (s->fwd_fd >= 0) {
+    ::close(s->fwd_fd);
+    s->fwd_fd = -1;
+  }
+}
+
+// Dial the peer and complete a repl-flagged HELLO.  Returns the connected
+// fd (>= 0), or -(FwdResult) on failure.  Bounded: connect/IO time out so
+// a wedged peer can never strand a serving thread.
+int dial_peer(const Server* s, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -FWD_PEER_DOWN;
+  timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(s->peer_port));
+  if (inet_pton(AF_INET, s->peer_host.c_str(), &addr.sin_addr) != 1 ||
+      ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -FWD_PEER_DOWN;
+  }
+  // Repl HELLO: own shard identity + layout version + the repl flag, so a
+  // mis-wired peer address fails loudly and the peer can refuse by policy.
+  const int64_t b =
+      (static_cast<int64_t>(s->shard_id) << kHelloShardIdShift) |
+      (static_cast<int64_t>(s->shard_count) << kHelloShardCountShift) |
+      ((s->layout_version & kHelloLayoutMask) << kHelloLayoutShift) |
+      (int64_t{1} << kHelloReplShift);
+  uint8_t req[2 + 8 + 8 + 4];
+  req[0] = HELLO;
+  req[1] = 0;
+  const int64_t a = kWireVersion;
+  uint32_t plen = 0;
+  std::memcpy(req + 2, &a, 8);
+  std::memcpy(req + 10, &b, 8);
+  std::memcpy(req + 18, &plen, 4);
+  uint8_t resp[12];
+  if (!write_n(fd, req, sizeof(req)) || !read_n(fd, resp, sizeof(resp))) {
+    ::close(fd);
+    return -FWD_PEER_DOWN;
+  }
+  int64_t status;
+  std::memcpy(&status, resp, 8);
+  if (status == kWireVersion) return fd;
+  ::close(fd);
+  // A policy refusal (partition) or an identity/layout mismatch (mis-wired
+  // peer config) is a LOUD condition, not a dead peer.
+  return status == kReplRefused || status <= -5 ? -FWD_REFUSED
+                                                : -FWD_PEER_DOWN;
+}
+
+// Ensure the forward link is up.  fwd_mu held.  Inside the dial-backoff
+// window the LAST dial's failure reason is answered (a refusal stays a
+// refusal — see fwd_last_fail).
+int ensure_fwd(Server* s) {
+  if (s->fwd_fd >= 0) return FWD_OK;
+  const auto now = std::chrono::steady_clock::now();
+  if (now < s->fwd_next_try)
+    return s->fwd_last_fail ? s->fwd_last_fail : FWD_PEER_DOWN;
+  int r = dial_peer(s, 5000);
+  if (r >= 0) {
+    s->fwd_fd = r;
+    s->fwd_last_fail = 0;
+    return FWD_OK;
+  }
+  s->fwd_next_try = now + std::chrono::milliseconds(200);
+  s->fwd_last_fail = -r;
+  return -r;
+}
+
+// Read the peer's one-frame ack off the forward link.  fwd_mu held.
+int read_fwd_ack(Server* s) {
+  uint8_t hdr[12];
+  if (!read_n(s->fwd_fd, hdr, sizeof(hdr))) {
+    sever_fwd_locked(s);
+    return FWD_PEER_DOWN;
+  }
+  int64_t status;
+  uint32_t rlen;
+  std::memcpy(&status, hdr, 8);
+  std::memcpy(&rlen, hdr + 8, 4);
+  if (rlen && !drain_n(s->fwd_fd, static_cast<size_t>(rlen) * 4)) {
+    sever_fwd_locked(s);
+    return FWD_PEER_DOWN;
+  }
+  if (status == kReplRefused || status == kReplDiverged) return FWD_REFUSED;
+  // -2 = the peer lacks the OBJECT a mutation targets: its state set has
+  // genuinely diverged from ours (it restarted without managing its
+  // REPL_SYNC — e.g. we were unreachable during its start window).
+  // Counting that as "delivered" would run the pair silently
+  // unreplicated — worse, with an empty dedup table waiting to
+  // double-apply replays after the next failover.  Latch loudly; the
+  // heal is the peer re-syncing (ps_server_resync_port), which clears
+  // the latch.
+  if (status == -2) return FWD_REFUSED;
+  return FWD_OK;  // mirror results (duplicate/stale) are fine — delivered
+}
+
+// Forward one op (optionally with an f32 payload) to the peer and await
+// its ack.  The forward link always speaks f32.
+int forward_op(Server* s, uint8_t op, const std::string& name, int64_t a,
+               int64_t b, const float* data, uint32_t plen) {
+  if (s->partitioned.load()) {
+    s->diverged.store(true);
+    return FWD_REFUSED;
+  }
+  std::lock_guard<std::mutex> lock(s->fwd_mu);
+  int r = ensure_fwd(s);
+  if (r != FWD_OK) {
+    if (r == FWD_REFUSED) s->diverged.store(true);
+    return r;
+  }
+  std::vector<uint8_t> hdr(2 + name.size() + 20);
+  hdr[0] = op;
+  hdr[1] = static_cast<uint8_t>(name.size());
+  std::memcpy(hdr.data() + 2, name.data(), name.size());
+  std::memcpy(hdr.data() + 2 + name.size(), &a, 8);
+  std::memcpy(hdr.data() + 10 + name.size(), &b, 8);
+  std::memcpy(hdr.data() + 18 + name.size(), &plen, 4);
+  if (!write_n(s->fwd_fd, hdr.data(), hdr.size()) ||
+      (plen && !write_n(s->fwd_fd, data, static_cast<size_t>(plen) * 4))) {
+    sever_fwd_locked(s);
+    return FWD_PEER_DOWN;
+  }
+  r = read_fwd_ack(s);
+  if (r == FWD_REFUSED) s->diverged.store(true);
+  return r;
+}
+
+// --- REPL_SYNC state blob ---------------------------------------------------
+// Byte layout (little-endian): i64 state_token | u32 n_objects | per
+// object: u8 kind, u16 name_len, name, then per kind:
+//   'p': i64 n, i64 step, f32 data[n]
+//   'a': i64 n, i64 global_step, i64 dropped, i64 deduped,
+//        u32 nded, (i64 worker, i64 seq)*nded
+//   'g': i64 n, i64 capacity, i64 min_step, i64 dropped, i64 deduped,
+//        u32 nded, (i64 worker, i64 seq)*nded
+//   't': (nothing — tokens are in-flight state; the chief's stall-repush
+//        heals their loss, same as the pre-r12 posture)
+
+template <typename T>
+void put(std::vector<uint8_t>& b, T v) {
+  const size_t at = b.size();
+  b.resize(at + sizeof(T));
+  std::memcpy(b.data() + at, &v, sizeof(T));
+}
+
+void put_dedup(std::vector<uint8_t>& blob, void* h,
+               int64_t (*size_fn)(void*),
+               int64_t (*export_fn)(void*, int64_t*, int64_t*, int64_t)) {
+  std::vector<int64_t> workers, seqs;
+  for (;;) {
+    const int64_t cap = size_fn(h) + 16;
+    workers.resize(static_cast<size_t>(cap));
+    seqs.resize(static_cast<size_t>(cap));
+    const int64_t n = export_fn(h, workers.data(), seqs.data(), cap);
+    if (n >= 0) {
+      put<uint32_t>(blob, static_cast<uint32_t>(n));
+      for (int64_t i = 0; i < n; ++i) {
+        put<int64_t>(blob, workers[i]);
+        put<int64_t>(blob, seqs[i]);
+      }
+      return;
+    }  // grew between size and export: retry with the fresh size
+  }
+}
+
+std::vector<uint8_t> build_state_blob(Server* s) {
+  std::vector<uint8_t> blob;
+  put<int64_t>(blob, s->state_token);
+  std::vector<std::pair<std::string, Object>> objs;
+  {
+    std::lock_guard<std::mutex> lock(s->mu);
+    for (const auto& kv : s->objects) objs.emplace_back(kv.first, kv.second);
+  }
+  put<uint32_t>(blob, static_cast<uint32_t>(objs.size()));
+  for (auto& [name, o] : objs) {
+    put<uint8_t>(blob, o.kind);
+    put<uint16_t>(blob, static_cast<uint16_t>(name.size()));
+    blob.insert(blob.end(), name.begin(), name.end());
+    if (o.kind == 'p') {
+      const int64_t n = pstore_num_elems(o.handle);
+      put<int64_t>(blob, n);
+      std::vector<float> data(static_cast<size_t>(n));
+      put<int64_t>(blob, pstore_get(o.handle, data.data()));
+      const size_t at = blob.size();
+      blob.resize(at + data.size() * 4);
+      std::memcpy(blob.data() + at, data.data(), data.size() * 4);
+    } else if (o.kind == 'a') {
+      put<int64_t>(blob, acc_num_elems(o.handle));
+      put<int64_t>(blob, acc_global_step(o.handle));
+      put<int64_t>(blob, acc_dropped(o.handle));
+      put<int64_t>(blob, acc_deduped(o.handle));
+      put_dedup(blob, o.handle, acc_dedup_size, acc_dedup_export);
+    } else if (o.kind == 'g') {
+      put<int64_t>(blob, gq_num_elems(o.handle));
+      put<int64_t>(blob, gq_capacity(o.handle));
+      put<int64_t>(blob, gq_min_step(o.handle));
+      put<int64_t>(blob, gq_dropped(o.handle));
+      put<int64_t>(blob, gq_deduped(o.handle));
+      put_dedup(blob, o.handle, gq_dedup_size, gq_dedup_export);
+    }
+  }
+  return blob;
+}
+
+// Parse-and-install the peer's state blob (start-time sync: runs before
+// this server accepts connections, so no locking races with handlers).
+// Returns false on a truncated/garbled blob (state left partially
+// installed; the caller falls back to a cold start token).
+bool install_state_blob(Server* s, const uint8_t* p, size_t len) {
+  size_t at = 0;
+  auto need = [&](size_t n) { return at + n <= len; };
+  auto get_i64 = [&](int64_t* v) {
+    if (!need(8)) return false;
+    std::memcpy(v, p + at, 8);
+    at += 8;
+    return true;
+  };
+  int64_t token;
+  if (!get_i64(&token)) return false;
+  uint32_t n_obj;
+  if (!need(4)) return false;
+  std::memcpy(&n_obj, p + at, 4);
+  at += 4;
+  for (uint32_t i = 0; i < n_obj; ++i) {
+    if (!need(3)) return false;
+    const uint8_t kind = p[at++];
+    uint16_t nlen;
+    std::memcpy(&nlen, p + at, 2);
+    at += 2;
+    if (!need(nlen)) return false;
+    std::string name(reinterpret_cast<const char*>(p + at), nlen);
+    at += nlen;
+    if (kind == 'p') {
+      int64_t n, step;
+      if (!get_i64(&n) || !get_i64(&step)) return false;
+      if (!need(static_cast<size_t>(n) * 4)) return false;
+      Object* o = get_or_create(s, name, 'p', n, 0);
+      if (o && step >= 0)
+        pstore_set(o->handle, step,
+                   reinterpret_cast<const float*>(p + at));
+      at += static_cast<size_t>(n) * 4;
+    } else if (kind == 'a' || kind == 'g') {
+      int64_t n, cap = 0, gate, dropped, deduped;
+      if (!get_i64(&n)) return false;
+      if (kind == 'g' && !get_i64(&cap)) return false;
+      if (!get_i64(&gate) || !get_i64(&dropped) || !get_i64(&deduped))
+        return false;
+      uint32_t nded;
+      if (!need(4)) return false;
+      std::memcpy(&nded, p + at, 4);
+      at += 4;
+      if (!need(static_cast<size_t>(nded) * 16)) return false;
+      std::vector<int64_t> workers(nded), seqs(nded);
+      for (uint32_t j = 0; j < nded; ++j) {
+        std::memcpy(&workers[j], p + at, 8);
+        std::memcpy(&seqs[j], p + at + 8, 8);
+        at += 16;
+      }
+      Object* o = get_or_create(s, name, kind, n, kind == 'g' ? cap : 0);
+      if (o && kind == 'a')
+        acc_restore(o->handle, gate, dropped, deduped,
+                    static_cast<int64_t>(nded), workers.data(), seqs.data());
+      else if (o)
+        gq_restore(o->handle, gate, dropped, deduped,
+                   static_cast<int64_t>(nded), workers.data(), seqs.data());
+    } else if (kind == 't') {
+      get_or_create(s, name, 't', 0, 0);
+    } else {
+      return false;
+    }
+  }
+  s->state_token = token;
+  return true;
+}
+
+// Start-time catch-up: pull the peer's full state before serving.  Retries
+// until `budget_ms` elapses (a restarting replica's peer is the survivor
+// and answers immediately; on a cold start the peer may be seconds away
+// or waiting on US — the caller gives replica 0 a short budget and later
+// replicas a long one, so a cold pair can never deadlock).  Returns true
+// when state (possibly empty) was adopted from the peer.
+bool sync_from_peer(Server* s, int64_t budget_ms) {
+  const auto t_end = std::chrono::steady_clock::now() +
+                     std::chrono::milliseconds(budget_ms);
+  for (;;) {
+    int fd = dial_peer(s, 5000);
+    if (fd >= 0) {
+      uint8_t req[2 + 8 + 8 + 4] = {};
+      req[0] = REPL_SYNC;
+      uint8_t hdr[12];
+      bool ok = write_n(fd, req, sizeof(req)) && read_n(fd, hdr, sizeof(hdr));
+      int64_t status = -1;
+      uint32_t plen = 0;
+      if (ok) {
+        std::memcpy(&status, hdr, 8);
+        std::memcpy(&plen, hdr + 8, 4);
+      }
+      if (ok && status >= 0) {
+        std::vector<uint8_t> blob(static_cast<size_t>(plen) * 4);
+        ok = blob.empty() || read_n(fd, blob.data(), blob.size());
+        ::close(fd);
+        if (ok && install_state_blob(s, blob.data(), blob.size()))
+          return true;
+        return false;  // garbled: cold-start below
+      }
+      ::close(fd);
+      if (status == kReplRefused) return false;  // partitioned: cold start
+    } else if (fd == -FWD_REFUSED) {
+      return false;
+    }
+    if (std::chrono::steady_clock::now() >= t_end) return false;
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+}
+
+// State-mutating ops a replicated server forwards to its peer (param-store
+// sets with payload; tagged apply/push as payload-less dedup mirrors; the
+// rest verbatim) — and refuses with kReplDiverged once the link is down by
+// POLICY (cancel is exempt: teardown must still work under divergence).
+bool is_replicated_op(uint8_t op) {
+  switch (op) {
+    case ACC_GET: case TQ_GET: case GQ_GET: case PSTORE_GET_OBJ:
+    case ACC_APPLY: case ACC_APPLY_TAGGED: case ACC_SET_STEP:
+    case ACC_RESET_WORKER: case GQ_PUSH: case GQ_PUSH_TAGGED:
+    case GQ_SET_MIN: case GQ_RESET_WORKER: case PSTORE_SET:
+      return true;
+    default:
+      return false;
+  }
+}
+
 void serve_conn_impl(Server* s, int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
@@ -342,6 +772,10 @@ void serve_conn_impl(Server* s, int fd) {
   // Per-connection payload encoding (HELLO): 0 = f32 (v1-compatible),
   // 1 = bf16.  scratch16 stages the half-width payloads both directions.
   int wire_dtype = 0;
+  // Repl-flagged connection (r12): the peer replica's forward/sync link.
+  // Its mirrors are never re-forwarded (no loops) and payload-less tagged
+  // ops take the dedup-mirror path.
+  bool is_repl = false;
   std::vector<uint16_t> scratch16;
   for (;;) {
     uint8_t op = 0, name_len = 0;
@@ -361,6 +795,31 @@ void serve_conn_impl(Server* s, int fd) {
     // ``payload_obj`` is reused by the dispatch below (one lookup, one
     // mutex acquisition per request on the gradient-push hot path).
     s->requests.fetch_add(1, std::memory_order_relaxed);
+    // Partition (r12): an ALREADY-ESTABLISHED repl connection must go
+    // dark too — every op on it is refused by policy, so the forwarding
+    // side observes kReplRefused on its next mutate and latches
+    // divergence, exactly like a fresh repl dial would.
+    if (is_repl && s->partitioned.load()) {
+      if (plen && !drain_n(fd, static_cast<size_t>(plen) * esize)) break;
+      if (!write_frame(fd, kReplRefused, 0, nullptr, 0)) break;
+      continue;
+    }
+    // Dedup-mirror fast path (r12): the peer forwards tagged apply/push
+    // WITHOUT the payload — same dedup/staleness bookkeeping, no data.
+    if (is_repl && plen == 0 &&
+        (op == ACC_APPLY_TAGGED || op == GQ_PUSH_TAGGED)) {
+      Object* o = find(s, name, op == ACC_APPLY_TAGGED ? 'a' : 'g');
+      int64_t status = -2;
+      if (o) {
+        status = op == ACC_APPLY_TAGGED
+                     ? acc_mirror_tagged(o->handle, a, b >> kTagWorkerShift,
+                                         b & kTagSeqMask)
+                     : gq_mirror_tagged(o->handle, a, b >> kTagWorkerShift,
+                                        b & kTagSeqMask);
+      }
+      if (!write_frame(fd, status, 0, nullptr, 0)) break;
+      continue;
+    }
     size_t expected = 0;
     Object* payload_obj = nullptr;
     if ((op == ACC_APPLY || op == ACC_APPLY_TAGGED) &&
@@ -374,6 +833,94 @@ void serve_conn_impl(Server* s, int fd) {
     if (plen != expected) {
       if (plen && !drain_n(fd, static_cast<size_t>(plen) * esize)) break;
       if (!write_frame(fd, -2, 0, nullptr, 0)) break;
+      continue;
+    }
+    // Replication (r12): the forward decision for this request.  Divergence
+    // is checked up front so a refused write never mutates local state
+    // (the payload still has to be consumed to keep the framing intact).
+    const bool replicate =
+        !is_repl && s->peer_port > 0 && is_replicated_op(op);
+    if (replicate && (s->partitioned.load() || s->diverged.load())) {
+      s->diverged.store(true);
+      if (plen && !drain_n(fd, static_cast<size_t>(plen) * esize)) break;
+      if (!write_frame(fd, kReplDiverged, 0, nullptr, 0)) break;
+      continue;
+    }
+    // PSTORE_SET forwards its payload STREAMED: each chunk read from the
+    // client is written to the peer before the next is read, so the two
+    // transfers overlap and a replicated publish costs ~one transfer of
+    // extra latency, not two (the replicated-push perf gate's bound).
+    // Only the f32 wire streams (chunks are forward-encoding-identical);
+    // bf16 payloads are decoded first and forwarded whole below.
+    int fwd_result = -1;  // -1 = no forward issued for this request
+    bool fwd_streamed = false;
+    bool ensure_refused = false;
+    if (replicate && op == PSTORE_SET && wire_dtype == 0 && plen) {
+      std::lock_guard<std::mutex> fl(s->fwd_mu);
+      const int er = ensure_fwd(s);
+      if (er == FWD_REFUSED) {
+        // The dial itself was policy-refused: latch divergence and refuse
+        // the write below — falling through to a local-only apply here
+        // was the one silent split-brain window (the backoff made every
+        // later attempt read "peer down").
+        s->diverged.store(true);
+        ensure_refused = true;
+      }
+      if (er == FWD_OK) {
+        // fwd_mu is held across the CLIENT payload read below (that is
+        // what lets the two transfers overlap), so the read must be
+        // bounded: a client wedged mid-payload must not convoy every
+        // other connection's forwards behind an unbounded recv.  The
+        // timeout is cleared again before the next request's read.
+        timeval rto{30, 0};
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &rto, sizeof(rto));
+        std::vector<uint8_t> hdr(2 + name.size() + 20);
+        hdr[0] = op;
+        hdr[1] = name_len;
+        std::memcpy(hdr.data() + 2, name.data(), name.size());
+        std::memcpy(hdr.data() + 2 + name.size(), &a, 8);
+        std::memcpy(hdr.data() + 10 + name.size(), &b, 8);
+        std::memcpy(hdr.data() + 18 + name.size(), &plen, 4);
+        bool fwd_up = write_n(s->fwd_fd, hdr.data(), hdr.size());
+        if (payload.size() < plen) payload.resize(plen);
+        size_t got = 0;
+        bool client_ok = true;
+        while (got < plen) {
+          const size_t chunk =
+              std::min<size_t>(plen - got, 256 * 1024);
+          if (!read_n(fd, payload.data() + got, chunk * 4)) {
+            client_ok = false;
+            break;
+          }
+          if (fwd_up && !write_n(s->fwd_fd, payload.data() + got, chunk * 4))
+            fwd_up = false;  // peer gone mid-stream: keep reading the client
+          got += chunk;
+        }
+        timeval rto0{0, 0};
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &rto0, sizeof(rto0));
+        if (!client_ok) break;
+        if (fwd_up) {
+          // Ack BEFORE the local apply: a policy-refused forward must not
+          // land the write one-sided (the refusal is the whole point).
+          // A merely-dead peer still applies locally (solo mode).
+          fwd_result = read_fwd_ack(s);
+          if (fwd_result == FWD_REFUSED) s->diverged.store(true);
+        } else {
+          sever_fwd_locked(s);
+          fwd_result = FWD_PEER_DOWN;
+        }
+        if (fwd_result != FWD_REFUSED)
+          pstore_set(payload_obj->handle, a, payload.data());
+        if (!write_frame(fd, fwd_result == FWD_REFUSED ? kReplDiverged : 0, 0,
+                         nullptr, 0))
+          break;
+        fwd_streamed = true;
+      }
+    }
+    if (fwd_streamed) continue;
+    if (ensure_refused) {
+      if (plen && !drain_n(fd, static_cast<size_t>(plen) * esize)) break;
+      if (!write_frame(fd, kReplDiverged, 0, nullptr, 0)) break;
       continue;
     }
     // Grow-only (like `out`): the payload is fully overwritten up to plen
@@ -390,7 +937,48 @@ void serve_conn_impl(Server* s, int fd) {
           payload[i] = bf16_to_f32(scratch16[i]);
       }
     }
+    if (replicate && op != ACC_APPLY && op != GQ_PUSH) {
+      // Forward BEFORE the local dispatch: a refused forward must not
+      // mutate local state (divergence stays one-sided and loud).  Tagged
+      // apply/push mirror payload-less (contents are deliberately NOT
+      // mirrored — see acc_mirror_tagged); pstore sets (the non-streamed
+      // bf16 path) forward their payload as f32; everything else forwards
+      // verbatim.  UNTAGGED apply/push carry no dedup state to mirror and
+      // mirroring their contents would double-apply after a failover, so
+      // they are divergence-gated above but never forwarded.
+      const bool mirror = op == ACC_APPLY_TAGGED || op == GQ_PUSH_TAGGED;
+      const uint32_t fplen = (mirror || !plen) ? 0 : plen;
+      fwd_result = forward_op(s, op, name, a, b,
+                              fplen ? payload.data() : nullptr, fplen);
+      if (fwd_result == FWD_REFUSED) {
+        if (!write_frame(fd, kReplDiverged, 0, nullptr, 0)) break;
+        continue;
+      }
+    }
 
+    if (op == REPL_SYNC) {
+      // Serve the full-state blob to a (re)starting peer — repl-flagged
+      // connections only (the blob is raw bytes; a bf16 client-side read
+      // would garble it, and state export is a replica-only privilege).
+      if (!is_repl) {
+        if (!write_frame(fd, -2, 0, nullptr, 0)) break;
+        continue;
+      }
+      std::vector<uint8_t> blob = build_state_blob(s);
+      blob.resize((blob.size() + 3) & ~size_t{3});  // pad to 4-byte units
+      int64_t n_obj;
+      {
+        std::lock_guard<std::mutex> lock(s->mu);
+        n_obj = static_cast<int64_t>(s->objects.size());
+      }
+      // A peer that successfully re-syncs is caught up again: clear the
+      // divergence latch (the healed-partition recovery path).
+      s->diverged.store(false);
+      if (!write_frame(fd, n_obj, static_cast<uint32_t>(blob.size() / 4),
+                       blob.data(), blob.size()))
+        break;
+      continue;
+    }
     int64_t status = -2;  // -2 = bad request/object
     Object* o = nullptr;
     // Valid prefix of `out` for THIS response.  ensure_out grows the
@@ -412,26 +1000,47 @@ void serve_conn_impl(Server* s, int fd) {
         const int64_t dtype = b & kHelloDtypeMask;
         const int64_t want_id = (b >> kHelloShardIdShift) & kHelloShardMask;
         const int64_t want_n = (b >> kHelloShardCountShift) & kHelloShardMask;
+        const int64_t want_v = (b >> kHelloLayoutShift) & kHelloLayoutMask;
+        const bool repl = (b >> kHelloReplShift) & 1;
         if (a != kWireVersion || (dtype != 0 && dtype != 1)) {
           status = -4;  // unsupported version/dtype: encoding unchanged
-        } else if (want_n != 0 && (want_n != s->shard_count ||
-                                   want_id != s->shard_id)) {
-          // Mis-wired dial: the client expects a different shard of the
-          // parameter vector than this server owns.  Answer the server's
-          // identity packed like the request so the client can report
-          // exactly what it reached.
+        } else if ((want_n != 0 && (want_n != s->shard_count ||
+                                    want_id != s->shard_id)) ||
+                   (want_v != 0 && want_v != (s->layout_version &
+                                              kHelloLayoutMask))) {
+          // Mis-wired dial: the client expects a different shard — or a
+          // different layout EPOCH — of the parameter vector than this
+          // server owns.  Answer the server's identity packed like the
+          // request so the client can report exactly what it reached.
           status = -5 - ((static_cast<int64_t>(s->shard_id)
                           << kHelloShardIdShift) |
                          (static_cast<int64_t>(s->shard_count)
-                          << kHelloShardCountShift));
+                          << kHelloShardCountShift) |
+                         ((s->layout_version & kHelloLayoutMask)
+                          << kHelloLayoutShift));
+        } else if (repl && s->partitioned.load()) {
+          // Injected partition: the peer's forward/sync link is refused BY
+          // POLICY — distinguishable from a dead peer, so the other side
+          // declares divergence loudly instead of silently serving on.
+          status = kReplRefused;
         } else {
           wire_dtype = static_cast<int>(dtype);
+          is_repl = repl;
           status = kWireVersion;
         }
         break;
       }
       case INCARNATION:
         status = s->incarnation;
+        break;
+      case REPL_TOKEN:
+        status = s->state_token;
+        break;
+      case REPL_SYNC:
+        // Dispatched BEFORE this switch (its response is a raw state
+        // blob, not the typed epilogue below); the label pins the op in
+        // the dispatch table so the wire-conformance lint can prove no
+        // client-sendable op silently falls through to -2.
         break;
       case CANCEL_ALL:
         cancel_all(s);
@@ -623,6 +1232,10 @@ void accept_loop(Server* s) {
 void stop_one(Server* s) {
   s->stopping.store(true);
   cancel_all(s);
+  {
+    std::lock_guard<std::mutex> lock(s->fwd_mu);
+    sever_fwd_locked(s);
+  }
   ::shutdown(s->listen_fd, SHUT_RDWR);
   ::close(s->listen_fd);
   s->accept_thread.join();
@@ -652,10 +1265,27 @@ extern "C" {
 // reference's in-cluster gRPC); 0 binds all interfaces for a multi-host PS
 // cluster on a trusted network.  (shard_id, shard_count) is the server's
 // identity for HELLO validation; (0, 1) = the whole vector (pre-r9).
-int ps_server_start_shard(int port, int loopback_only, int shard_id,
-                          int shard_count) {
+//
+// Replicated form (r12): ``layout_version`` joins the HELLO identity, and
+// a non-empty (peer_host, peer_port) names this shard's PEER REPLICA —
+// state-mutating ops forward to it, and the start blocks up to
+// ``sync_wait_ms`` pulling the peer's full state via REPL_SYNC (the
+// restarted-replica catch-up; a cold pair gives replica 0 a short budget
+// and later replicas a long one so they can never deadlock on each
+// other).  A successful sync ADOPTS the peer's state token, so clients
+// see "state intact" across the restart and the chief never reseeds.
+int ps_server_start_replicated(int port, int loopback_only, int shard_id,
+                               int shard_count, int64_t layout_version,
+                               const char* peer_host, int peer_port,
+                               int64_t sync_wait_ms) {
   std::lock_guard<std::mutex> lock(g_server_mu);
   if (shard_count < 1 || shard_id < 0 || shard_id >= shard_count) return -1;
+  // The HELLO identity fields are 12/12/16 bits wide; a value past them
+  // would TRUNCATE into the packed word and silently read as "no
+  // expectation" at the other end — reject at start instead.
+  if (shard_count > kHelloShardMask || layout_version < 0 ||
+      layout_version > kHelloLayoutMask)
+    return -1;
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
   int one = 1;
@@ -683,6 +1313,11 @@ int ps_server_start_shard(int port, int loopback_only, int shard_id,
   s->port = static_cast<int>(ntohs(addr.sin_port));
   s->shard_id = shard_id;
   s->shard_count = shard_count;
+  s->layout_version = layout_version;
+  if (peer_host && peer_port > 0) {
+    s->peer_host = peer_host;
+    s->peer_port = peer_port;
+  }
   // Unique across restarts WITHIN a process (clock advances) and across
   // processes (pid mixed in); masked positive so the wire status stays
   // out of the error range.
@@ -694,9 +1329,24 @@ int ps_server_start_shard(int port, int loopback_only, int shard_id,
         (static_cast<int64_t>(shard_id) << 32)) &
        0x7FFFFFFFFFFFFFFF);
   if (s->incarnation == 0) s->incarnation = 1;
+  // Catch up from the peer BEFORE serving: the socket is bound (the port
+  // is reserved) but nothing is accepted until the state — and the state
+  // TOKEN — are settled, so no client can observe a half-synced replica.
+  // A cold start (no peer / peer down / peer partitioned) mints a fresh
+  // token: state genuinely starts empty here.
+  if (s->peer_port > 0 && sync_wait_ms > 0 && sync_from_peer(s, sync_wait_ms))
+    ;  // token adopted by install_state_blob
+  else
+    s->state_token = fresh_token(shard_id);
   s->accept_thread = std::thread(accept_loop, s);
   g_servers.push_back(s);
   return s->port;
+}
+
+int ps_server_start_shard(int port, int loopback_only, int shard_id,
+                          int shard_count) {
+  return ps_server_start_replicated(port, loopback_only, shard_id,
+                                    shard_count, 0, nullptr, 0, 0);
 }
 
 // Pre-r9 entry point: one whole-vector server.
@@ -759,6 +1409,77 @@ int ps_server_stop_port(int port) {
     }
   }
   return 0;
+}
+
+// Late peer wiring (r12): point the shard server at <port> to its peer
+// replica — the in-process replicated topology starts every server on an
+// ephemeral port first, then wires the pairs.  Returns 1 on success.  No
+// start-time sync happens here (both servers are cold by construction);
+// ``ps_server_resync_port`` pulls the peer's state on demand.
+int ps_server_set_peer(int port, const char* host, int peer_port) {
+  std::lock_guard<std::mutex> lock(g_server_mu);
+  Server* s = find_port(port);
+  if (!s || !host || peer_port <= 0) return 0;
+  std::lock_guard<std::mutex> fl(s->fwd_mu);
+  sever_fwd_locked(s);
+  s->peer_host = host;
+  s->peer_port = peer_port;
+  return 1;
+}
+
+// On-demand REPL_SYNC catch-up for an already-running server (the
+// in-process analog of the start-time sync).  Returns 1 when state (and
+// the token) were adopted from the peer.
+int ps_server_resync_port(int port, int64_t wait_ms) {
+  Server* s;
+  {
+    std::lock_guard<std::mutex> lock(g_server_mu);
+    s = find_port(port);
+  }
+  if (!s || s->peer_port <= 0) return 0;
+  return sync_from_peer(s, wait_ms) ? 1 : 0;
+}
+
+// Partition injection (utils/faults.py `partition` kind): `on` != 0 makes
+// the server refuse its peer's repl-flagged connections (kReplRefused)
+// and fail its own forwards by policy — both directions of the pair's
+// replication traffic drop while both servers stay alive.  The side
+// still reached by clients latches `diverged` on its next forward and
+// answers mutating ops kReplDiverged: the LOUD split-brain refusal.
+int ps_server_set_partitioned(int port, int on) {
+  std::lock_guard<std::mutex> lock(g_server_mu);
+  Server* s = find_port(port);
+  if (!s) return 0;
+  s->partitioned.store(on != 0);
+  std::lock_guard<std::mutex> fl(s->fwd_mu);
+  sever_fwd_locked(s);
+  return 1;
+}
+
+// A shard server's state-lineage token, by bound port (-1 = no server):
+// test/observability hook for the failover logic the clients run.
+int64_t ps_server_state_token_port(int port) {
+  std::lock_guard<std::mutex> lock(g_server_mu);
+  Server* s = find_port(port);
+  return s ? s->state_token.load() : -1;
+}
+
+// Whether a shard server has latched replication divergence (-1 = no
+// server) — the split-brain observability hook.
+int ps_server_diverged_port(int port) {
+  std::lock_guard<std::mutex> lock(g_server_mu);
+  Server* s = find_port(port);
+  return s ? (s->diverged.load() ? 1 : 0) : -1;
+}
+
+// Live client connections at a shard server (-1 = no server).  A task
+// host's own shutdown-queue client counts, so an ORPHANED replica (peer
+// gone, run over, nobody dialing) reads exactly 1 — the host's
+// orphan-exit heuristic (host_ps_task) keys off this.
+int ps_server_live_conns_port(int port) {
+  std::lock_guard<std::mutex> lock(g_server_mu);
+  Server* s = find_port(port);
+  return s ? s->live_conns.load() : -1;
 }
 
 }  // extern "C"

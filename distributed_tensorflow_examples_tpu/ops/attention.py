@@ -171,7 +171,11 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, block_q, block_k):
     from . import flash_attention as fa
 
     n = collectives.axis_size(axis_name)
-    my = collectives.axis_index(axis_name)
+    # Shard identity is only consumed by the causal visibility test; tracing
+    # it unconditionally leaves a DEAD axis_index in the jaxpr (the
+    # custom_vjp boundary blocks DCE), which lowers to an unannotated
+    # partition-id the CPU SPMD partitioner rejects outright.
+    my = collectives.axis_index(axis_name) if causal else None
     B, H, T, D = q.shape
     dtype = q.dtype
     qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
@@ -190,7 +194,7 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, block_q, block_k):
         kr, vr = jax.tree.map(
             lambda x: collectives.ring_permute(x, axis_name, shift=-1), (kr, vr)
         )
-        src = (my + i) % n
+        src = (my + i) % n if causal else None
 
         # Never the diagonal for i in 1..n-1 — statically non-causal kernel;
         # under causal masking the whole block is visible iff src < my.

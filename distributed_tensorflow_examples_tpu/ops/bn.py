@@ -41,6 +41,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..parallel import collectives
+from . import flash_attention
 from .common import largest_divisor as _largest_divisor
 
 #: Test hook: force the fused path off-TPU so CPU parity tests exercise the
@@ -196,8 +197,8 @@ def bn_stats(x):
             pltpu.VMEM((1, c), jnp.float32),
             pltpu.VMEM((1, c), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary")
+        compiler_params=flash_attention.compiler_params(
+            ("arbitrary", "arbitrary")
         ),
         interpret=_interpret(),
     )(x)
@@ -228,8 +229,8 @@ def bn_bwd_stats(do, x, mean, inv, scale, bias, *, relu: bool):
             pltpu.VMEM((1, c), jnp.float32),
             pltpu.VMEM((1, c), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary")
+        compiler_params=flash_attention.compiler_params(
+            ("arbitrary", "arbitrary")
         ),
         interpret=_interpret(),
     )(do, x, mean, inv, scale, bias)

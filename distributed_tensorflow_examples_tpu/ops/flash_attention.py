@@ -79,15 +79,18 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def compiler_params(semantics: tuple[str, ...]):
+    """Version shim: pallas renamed TPUCompilerParams -> CompilerParams.
+    Both vintages take the same dimension_semantics tuple, and the
+    TPUCompilerParams-era interpret mode runs these kernels correctly
+    (verified on jax 0.4.37), so resolve whichever this jax ships.  The
+    ONE spelling every TPU kernel in the package uses."""
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(dimension_semantics=semantics)
+
+
 def _params():
-    # Deliberately pinned to the NEW pallas class name: on older jax
-    # (TPUCompilerParams-era) this raises AttributeError BEFORE any
-    # pallas_call is built — that vintage's interpret-mode executor
-    # hard-aborts the process on these kernels, and a clean per-test
-    # failure must never become a suite-killing abort.
-    return pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "arbitrary")
-    )
+    return compiler_params(("parallel", "parallel", "arbitrary"))
 
 
 def _mask(s, qi, kj, bq, bk):
@@ -622,8 +625,8 @@ def fused_bwd_call(q, k, v, do, lse, delta, *, causal, block_q, block_k, out_dty
         # Unlike the split kernels, BOTH k and q grid dims carry loop state
         # (dq_acc accumulates across kj with kj==0 as its reinit; dk/dv
         # scratch across qi) — only the batch*heads dim may be partitioned.
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary", "arbitrary")
+        compiler_params=compiler_params(
+            ("parallel", "arbitrary", "arbitrary")
         ),
         interpret=_interpret(),
     )(qs, k, v, do, lse, delta)

@@ -501,14 +501,23 @@ class RemotePSChief(AsyncPSTrainer):
         port: int = 0, ps_addr: tuple[str, int] | None = None,
         ps_addrs: list[tuple[str, int]] | None = None,
         ports: list[int] | None = None,
-        listen_all: bool = False, **kw,
+        listen_all: bool = False, ps_replicas: int = 1,
+        layout_version: int = 0, **kw,
     ):
         """``listen_all``: bind the in-process service on all interfaces
         (workers on other hosts; unauthenticated — explicit opt-in only,
         same contract as ``host_ps_task``).  ``ps_addrs``: external shard
         servers, one per shard (``ps_addr`` = the 1-shard shorthand);
         ``ports``: host N shard servers in-process at these ports (0 =
-        ephemeral; ``port`` = the 1-shard shorthand)."""
+        ephemeral; ``port`` = the 1-shard shorthand).
+
+        Replication (r12): ``ps_replicas=2`` reads the address/port list
+        replica-major (shards*2 entries: primaries then backups).  The
+        in-process topology starts every replica server here and wires
+        each pair as peers; clients fail over inside their own recovery
+        loop, so a killed primary costs NO chief reseed.
+        ``layout_version`` pins every connection to the shard-topology
+        epoch."""
         from . import ps_service, ps_shard
 
         if ps_addrs is None and ps_addr is not None:
@@ -519,25 +528,57 @@ class RemotePSChief(AsyncPSTrainer):
             wire_dtype=cfg.ps_wire_dtype,
         )
         role = faults.current_role() or "chief0"
+        self.ps_replicas = int(ps_replicas)
+        #: Chief reseeds performed (the last-resort path) — the replicated
+        #: acceptance gate asserts this stays ZERO across a primary kill.
+        self.reseeds = 0
         if ps_addrs is not None:
             self._owns_server = False
-            self.ports = [p for _, p in ps_addrs]
+            n = len(ps_addrs) // self.ps_replicas
+            self.ports = [p for _, p in ps_addrs[:n]]
         else:
-            n = len(ports) if ports else 1
-            self.ports = [
+            all_ports = list(ports) if ports else [port]
+            n = len(all_ports) // self.ps_replicas
+            bound = [
                 ps_service.start_server(
-                    p, loopback_only=not listen_all, shard_id=i, shard_count=n
+                    p, loopback_only=not listen_all, shard_id=i % n,
+                    shard_count=n, layout_version=layout_version,
                 )
-                for i, p in enumerate(ports if ports else [port])
+                for i, p in enumerate(all_ports)
             ]
-            ps_addrs = [("127.0.0.1", p) for p in self.ports]
+            if self.ps_replicas > 1:
+                # Ephemeral ports force start-then-pair: wire each shard's
+                # two cold servers as peers (replica-major grouping — the
+                # ONE definition, ps_shard.replica_major), then have the
+                # backup adopt the primary's state TOKEN via one REPL_SYNC
+                # — both are empty, but the pair must share one state
+                # lineage or the first failover would misread the backup
+                # as state-lost.
+                for primary, backup in ps_shard.replica_major(
+                    bound, n, self.ps_replicas
+                ):
+                    ps_service.set_server_peer(
+                        primary, ("127.0.0.1", backup)
+                    )
+                    ps_service.set_server_peer(
+                        backup, ("127.0.0.1", primary)
+                    )
+                    ps_service.resync_server(backup, wait_s=10.0)
+            self.ports = bound[:n]
+            ps_addrs = [("127.0.0.1", p) for p in bound]
             self._owns_server = True
         self.port = self.ports[0]
-        self._group = ps_shard.ShardedPSClients(ps_addrs, role=role, **client_kw)
+        self._group = ps_shard.ShardedPSClients(
+            ps_addrs, role=role, replicas=self.ps_replicas,
+            layout_version=layout_version, **client_kw,
+        )
         self._client = self._group.coordinator
         super().__init__(cfg, loss_fn, optimizer, init_params, **kw)
         total = sum(self._leaf_sizes)
-        self._layout = ps_shard.ShardLayout(total, self._group.num_shards)
+        self._layout = ps_shard.ShardLayout(
+            total, self._group.num_shards,
+            num_replicas=self.ps_replicas, version=layout_version,
+        )
         # Replace the in-process services with their (sharded) socket
         # proxies, so the chief exercises the same transport the workers do.
         if cfg.mode == "sync_replicas":
@@ -565,7 +606,13 @@ class RemotePSChief(AsyncPSTrainer):
         untouched).  In-flight worker gradients from the old incarnation
         are lost — exactly the reference's stale-drop posture — and
         re-pushed tokens may admit an extra gradient per worker, which the
-        staleness gate then drops."""
+        staleness gate then drops.
+
+        With replication (r12) this is the LAST-RESORT path: it fires only
+        when a shard's state was lost on EVERY replica (the client-side
+        state-token check short-circuits the callback otherwise), so the
+        ``reseeds`` counter stays 0 across any single-replica incident."""
+        self.reseeds += 1
         faults.log_event(
             "chief_reseed", step=self.global_step, mode=self.cfg.mode,
             shard=shard,
@@ -636,18 +683,26 @@ class RemotePSChief(AsyncPSTrainer):
             # Dedicated-PS topology: release the external PS tasks LAST —
             # after the dropped-counter reads above — so host_ps_task only
             # tears each service down once nothing will dial it again.
-            # EVERY shard task waits on its own server's ps_shutdown queue.
-            # Best-effort: a PS may already have exited via its
-            # cancel-grace window, so do NOT spend the reconnect budget.
+            # EVERY replica task of EVERY shard waits on its own server's
+            # ps_shutdown queue, and a shard's group client may have
+            # failed over away from the task that still needs the signal —
+            # so each replica ADDRESS gets a direct, short-lived,
+            # fail-fast dial (a PS may already have exited via its
+            # cancel-grace window; never spend a reconnect budget here).
             self._group.fail_fast()
-            for i, c in enumerate(self._group.clients):
-                try:
-                    ps_service.RemoteTokenQueue(c, "ps_shutdown").push(0)
-                except Exception:
-                    log.info(
-                        "ps_shutdown signal not delivered to shard %d "
-                        "(ps already down)", i,
-                    )
+            for i, replica_list in enumerate(self._group.replica_addrs):
+                for r, (h, p) in enumerate(replica_list):
+                    try:
+                        c = ps_service.PSClient(h, p, timeout_s=5.0)
+                        try:
+                            ps_service.RemoteTokenQueue(c, "ps_shutdown").push(0)
+                        finally:
+                            c.close()
+                    except Exception:
+                        log.info(
+                            "ps_shutdown signal not delivered to shard %d "
+                            "replica %d (ps already down)", i, r,
+                        )
         log.info(
             "remote async-PS chief done: %d applied steps, %d stale drops",
             self.global_step,
@@ -658,7 +713,9 @@ class RemotePSChief(AsyncPSTrainer):
 
 def host_ps_task(
     port: int, *, loopback_only: bool = True, shard_id: int = 0,
-    shard_count: int = 1,
+    shard_count: int = 1, layout_version: int = 0,
+    peer: tuple[str, int] | None = None, peer_role: str = "",
+    sync_wait_s: float = 0.0,
 ) -> int:
     """Dedicated PS-task body (``--job_name=ps`` under cross-process PS
     emulation): host the C++ state service on ``port`` and block until the
@@ -673,8 +730,19 @@ def host_ps_task(
     connection, so a mis-wired worker fails its dial loudly.  The chief
     signals ``ps_shutdown`` to EVERY shard task at the end of training.
 
+    Replication (r12): ``peer`` names this task's peer replica of the same
+    shard — the start pulls the peer's full state (REPL_SYNC, bounded by
+    ``sync_wait_s``) before serving, so a supervised RESTART rejoins with
+    the survivor's state AND state token (clients then reconnect without
+    any chief reseed), and state-mutating ops forward to the peer while
+    serving.  ``peer_role`` (the peer task's fault role) arms ``partition``
+    fault specs: a matching spec makes this server refuse the pair's
+    replication traffic by policy while both stay alive — the split-brain
+    injection the divergence guard is tested against.
+
     Arms any ``die`` fault specs for this process (``DTX_FAULT_PLAN``) —
-    ``after_reqs`` triggers off the server's request counter, the
+    ``after_reqs`` triggers off the server's request counter (with a
+    replicated pair, forwarded mirror traffic counts too), the
     deterministic "kill the PS at request N" fault the recovery tests
     inject; a supervisor (``supervise()``) restarts the task and the
     clients reconnect into the fresh incarnation."""
@@ -684,14 +752,24 @@ def host_ps_task(
 
     bound = ps_service.start_server(
         port, loopback_only=loopback_only, shard_id=shard_id,
-        shard_count=shard_count,
+        shard_count=shard_count, layout_version=layout_version,
+        peer=peer, sync_wait_s=sync_wait_s,
     )
+
+    def _partition(spec) -> bool:
+        if peer_role and not spec.matches_peer(peer_role):
+            return False
+        return ps_service.set_server_partitioned(bound, True)
+
     faults.arm_process_faults(
-        request_count_fn=ps_service.server_request_count
+        request_count_fn=ps_service.server_request_count,
+        partition_fn=_partition if peer is not None else None,
     )
     log.info(
-        "PS task serving on port %d (shard %d/%d), incarnation %d (blocking "
-        "until chief shutdown)", bound, shard_id, shard_count,
+        "PS task serving on port %d (shard %d/%d, layout v%d%s), "
+        "incarnation %d (blocking until chief shutdown)", bound, shard_id,
+        shard_count, layout_version,
+        f", peer {peer[0]}:{peer[1]}" if peer else "",
         ps_service.server_incarnation(),
     )
     client = ps_service.PSClient("127.0.0.1", bound, timeout_s=10.0)
@@ -703,6 +781,7 @@ def host_ps_task(
     # serve on as an orphan squatting the port.
     supervised = os.environ.get("DTX_PS_SUPERVISED") == "1"
     ppid0 = os.getppid()
+    orphan_polls = 0
     while True:
         # Bounded pops keep this thread responsive (fault triggers, signal
         # delivery) without consuming the shutdown contract below; 2 s
@@ -713,6 +792,37 @@ def host_ps_task(
             if supervised and os.getppid() != ppid0:
                 log.warning("PS task: supervisor died; exiting")
                 break
+            # Orphaned-replica exit (r12): a replicated task that restarts
+            # AFTER training ended can miss the chief's ps_shutdown push
+            # entirely (its clients failed over to the peer and never came
+            # back — training no longer stalls on a dead primary, so the
+            # run may finish before this incarnation is even up).  Detect
+            # the orphan state: the PEER is gone AND nobody but our own
+            # shutdown client is connected, for a sustained window — a
+            # peer merely crashing mid-run keeps the clients' connections
+            # here, so a serving replica can never match this.  Known
+            # limitation: if BOTH replicas restart after the run ended,
+            # each probes the other alive and neither self-exits — that
+            # double-fault corner needs an operator stop (RUNBOOK 4e); a
+            # liveness-only probe cannot distinguish it from a slow
+            # cluster launch without risking a mid-startup suicide.
+            if peer is not None and ps_service.server_live_conns(bound) <= 1:
+                try:
+                    import socket as _socket
+
+                    probe = _socket.create_connection(peer, timeout=0.5)
+                    probe.close()
+                    orphan_polls = 0
+                except OSError:
+                    orphan_polls += 1
+                    if orphan_polls >= 10:
+                        log.warning(
+                            "PS task: peer gone and no clients for ~%ds; "
+                            "orphaned replica exiting", 2 * orphan_polls,
+                        )
+                        break
+            else:
+                orphan_polls = 0
             continue
         if token is not None:
             break
@@ -843,6 +953,8 @@ def remote_worker_loop(
     model_state: Any = None,
     rng: jax.Array | None = None,
     addrs: list[tuple[str, int]] | None = None,
+    ps_replicas: int = 1,
+    layout_version: int = 0,
     metrics_dir: str | None = None,
     metrics_every: int = 20,
 ) -> int:
@@ -881,12 +993,16 @@ def remote_worker_loop(
         wire_dtype=cfg.ps_wire_dtype,
     )
     group = ps_shard.ShardedPSClients(
-        addrs, role=role, worker_tag=wid, **client_kw
+        addrs, role=role, worker_tag=wid, replicas=ps_replicas,
+        layout_version=layout_version, **client_kw
     )
     client = group.coordinator
     template = init_fn(jax.random.key(0))
     total, unflatten = ps_shard.flat_param_spec(template)
-    layout = ps_shard.ShardLayout(total, group.num_shards)
+    layout = ps_shard.ShardLayout(
+        total, group.num_shards, num_replicas=ps_replicas,
+        version=layout_version,
+    )
 
     pstore = ps_shard.ShardedParamStore(group, "params", layout)
     tq = ps_service.RemoteTokenQueue(client, "tokens")
@@ -908,7 +1024,8 @@ def remote_worker_loop(
             # prefetch connections specifically; "worker*" globs still
             # match both.
             pf_group = ps_shard.ShardedPSClients(
-                addrs, role=f"{role}_pf", **client_kw
+                addrs, role=f"{role}_pf", replicas=ps_replicas,
+                layout_version=layout_version, **client_kw
             )
             pf_store = ps_shard.ShardedParamStore(pf_group, "params", layout)
             prefetcher = ParamPrefetcher(

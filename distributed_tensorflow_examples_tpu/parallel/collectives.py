@@ -51,7 +51,7 @@ def ring_permute(x, axis_name: str, *, shift: int = 1):
     """Send to the neighbor ``shift`` hops around the axis ring; the building
     block of ring attention / pipelined collectives (permuter.h role).  XLA
     lowers ``ppermute`` to neighbor ICI transfers."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm=perm)
 

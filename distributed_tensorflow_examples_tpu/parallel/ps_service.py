@@ -111,6 +111,8 @@ _ACC_RESET_WORKER = wire.PS_OPS["ACC_RESET_WORKER"]
 _GQ_RESET_WORKER = wire.PS_OPS["GQ_RESET_WORKER"]
 _HELLO = wire.PS_OPS["HELLO"]
 _PSTORE_GET_IF_NEWER = wire.PS_OPS["PSTORE_GET_IF_NEWER"]
+_REPL_SYNC = wire.PS_OPS["REPL_SYNC"]
+_REPL_TOKEN = wire.PS_OPS["REPL_TOKEN"]
 
 #: Wire protocol version this client speaks (ps_server.cc kWireVersion).
 WIRE_VERSION = wire.WIRE_VERSION
@@ -139,6 +141,14 @@ class PSError(RuntimeError):
     server rejected the request)."""
 
 
+class _StateLost(Exception):
+    """Internal recovery signal: the replica just reconnected to carries a
+    DIFFERENT state token (restarted empty, peer unreachable) — try the
+    other replicas before falling back to the rebuild/reseed path.
+    Deliberately not a PSError: the generic recovery retry must not
+    swallow it."""
+
+
 class PSDeadlineError(PSError):
     """Reconnect budget exhausted: the PS stayed unreachable past
     ``reconnect_deadline_s``."""
@@ -146,7 +156,8 @@ class PSDeadlineError(PSError):
 
 def start_server(
     port: int = 0, *, loopback_only: bool = True, shard_id: int = 0,
-    shard_count: int = 1,
+    shard_count: int = 1, layout_version: int = 0,
+    peer: tuple[str, int] | None = None, sync_wait_s: float = 0.0,
 ) -> int:
     """Start an in-process C++ PS server; returns the bound port.
 
@@ -158,13 +169,65 @@ def start_server(
     which contiguous slice of the flat parameter vector it owns.  HELLO
     validates a shard-aware client's expectation against it, so a
     mis-wired dial fails loudly.  One process may host SEVERAL shard
-    servers (the chief-hosted sharded topology and the shard bench)."""
-    p = native._load().ps_server_start_shard(
-        port, 1 if loopback_only else 0, shard_id, shard_count
+    servers (the chief-hosted sharded topology and the shard bench).
+
+    Replication (r12): ``layout_version`` joins the HELLO identity (the
+    shard-topology epoch — mixed-epoch clients fail the dial loudly), and
+    ``peer`` names this shard's peer replica: state-mutating ops forward
+    to it, and the start blocks up to ``sync_wait_s`` pulling the peer's
+    full state (REPL_SYNC) — adopting its STATE TOKEN — before serving."""
+    host, pport = peer if peer is not None else ("", 0)
+    p = native._load().ps_server_start_replicated(
+        port, 1 if loopback_only else 0, shard_id, shard_count,
+        int(layout_version), host.encode() if host else None, int(pport),
+        int(sync_wait_s * 1000),
     )
     if p < 0:
         raise RuntimeError("ps_server_start failed")
     return p
+
+
+def set_server_peer(port: int, peer: tuple[str, int]) -> bool:
+    """Wire a running shard server to its peer replica (the in-process
+    replicated topology binds ephemeral ports first, then pairs them)."""
+    return bool(
+        native._load().ps_server_set_peer(port, peer[0].encode(), peer[1])
+    )
+
+
+def resync_server(port: int, wait_s: float = 5.0) -> bool:
+    """On-demand REPL_SYNC: the server at ``port`` pulls its peer's full
+    state (adopting the peer's state token).  The in-process analog of the
+    restarted-task start-time catch-up."""
+    return bool(
+        native._load().ps_server_resync_port(port, int(wait_s * 1000))
+    )
+
+
+def set_server_partitioned(port: int, on: bool) -> bool:
+    """Inject a replication partition at the server at ``port``: its
+    peer's repl connections are refused by policy and its own forwards
+    fail — the ``partition`` fault kind's server-side primitive."""
+    return bool(
+        native._load().ps_server_set_partitioned(port, 1 if on else 0)
+    )
+
+
+def server_state_token(port: int) -> int:
+    """A shard server's state-lineage token (-1 = no server there)."""
+    return int(native._load().ps_server_state_token_port(port))
+
+
+def server_diverged(port: int) -> int:
+    """Whether the server at ``port`` latched replication divergence
+    (1/0; -1 = no server there)."""
+    return int(native._load().ps_server_diverged_port(port))
+
+
+def server_live_conns(port: int) -> int:
+    """Live client connections at the server at ``port`` (-1 = none
+    there) — the orphaned-replica signal ``host_ps_task`` watches."""
+    return int(native._load().ps_server_live_conns_port(port))
 
 
 def stop_server(port: int | None = None) -> None:
@@ -232,6 +295,28 @@ class PSClient:
                              wrong slice of the parameter vector.  None =
                              no expectation (pre-r9 framing, byte-identical
                              for f32).
+    ``expect_layout``        the shard-topology EPOCH this client expects
+                             (r12 layout version; 0 = no expectation).
+                             Non-zero forces the handshake and a server on
+                             a different epoch fails the dial loudly
+                             naming both versions — the guard that makes
+                             mixed-epoch clients impossible during a
+                             (future) live reshard.
+    ``addrs``                the full ordered replica address list for
+                             this shard (r12; entry 0 is the primary —
+                             ``host``/``port`` must equal it when both are
+                             given).  With a backup present, recovery
+                             ALTERNATES replicas and compares the shard's
+                             STATE TOKEN on every reconnect: a token match
+                             means the state survived (failover or synced
+                             restart — NO reseed, by design zero chief
+                             involvement); only when every replica's token
+                             proves the state lost does the full
+                             reincarnation path (object re-create +
+                             ``on_reincarnation`` callbacks, i.e. chief
+                             reseed) run as the last resort.  Ops issued
+                             while connected to a backup replica inject
+                             faults under the ``<role>_b`` client role.
     """
 
     #: Server-side wait per blocking-op round trip when the client has a
@@ -245,13 +330,23 @@ class PSClient:
         backoff_s: float = 0.25, worker_tag: int | None = None,
         role: str | None = None, wire_dtype: str = "f32",
         expect_shard: tuple[int, int] | None = None,
+        expect_layout: int = 0,
+        addrs: list[tuple[str, int]] | None = None,
     ):
         if wire_dtype not in WIRE_DTYPES:
             raise ValueError(
                 f"wire_dtype {wire_dtype!r} not in {sorted(WIRE_DTYPES)}"
             )
-        self._host, self._port = host, port
+        self._addrs = list(addrs) if addrs else [(host, port)]
+        if (host, port) != self._addrs[0]:
+            raise ValueError(
+                f"(host, port) ({host}:{port}) must be addrs[0] "
+                f"({self._addrs[0][0]}:{self._addrs[0][1]})"
+            )
+        self._cur = 0
+        self._host, self._port = self._addrs[0]
         self._expect_shard = expect_shard
+        self._expect_layout = int(expect_layout)
         self._connect_timeout = timeout_s
         self._op_timeout = op_timeout_s if op_timeout_s is not None else timeout_s
         self._reconnect_deadline = reconnect_deadline_s
@@ -265,31 +360,68 @@ class PSClient:
         self._ensures: list[tuple[int, str, int, int]] = []
         self._callbacks: list = []
         self._reconnect_callbacks: list = []
-        self._injector = faults.client_injector(self.role)
+        # Per-REPLICA injectors (the backup leg is its own fault role,
+        # ``<role>_b``, with its own logical-op counter) — created lazily
+        # so single-address clients keep the zero-cost no-faults path.
+        self._injectors: dict[int, faults.ClientFaultInjector | None] = {}
+        self._injector = self._leg_injector(0)
         self._sock: socket.socket | None = None
         self._hdr = bytearray(12)  # reusable response-header buffer
+        # Per-replica incarnations + the shard's state-lineage token (r12):
+        # a reconnect that finds the SAME token — on any replica — proves
+        # the shard's state survived and skips every rebuild/reseed step.
+        # None token = server predates REPL_TOKEN (incarnation semantics).
+        self._incarnations: dict[int, int] = {}
+        self._state_token: int | None = None
         try:
             self._connect()
             # The baseline incarnation: reconnects compare against this to
             # tell a transient drop from a restarted (state-lost) server.
             # Bounded by the configured deadlines so a stalled server fails
             # the ctor instead of hanging it.
-            self._incarnation, _ = self._attempt(
+            inc, _ = self._attempt(
                 _INCARNATION,
                 deadline_s=self._op_timeout
                 if self._op_timeout is not None
                 else self._connect_timeout,
             )
+            self._incarnations[self._cur] = inc
+            if len(self._addrs) > 1:
+                # Token semantics are a REPLICATED-topology feature; a
+                # single-address client keeps the exact pre-r12 op
+                # sequence (and incarnation-only recovery).
+                self._read_state_token()
         except OSError:
             if self._reconnect_deadline <= 0:
                 raise
             # Construction during a PS outage (e.g. mid supervised restart)
             # gets the same recovery budget as any op: retry with backoff;
-            # the sentinel makes the first contact look like a fresh
-            # incarnation, which replays the (empty) ensure list and sets
-            # the real id.
-            self._incarnation = object()
+            # the empty incarnation map makes the first contact a plain
+            # first-connect (replays the empty ensure list, records ids).
             self._recover(time.monotonic() + self._reconnect_deadline)
+
+    def _leg_injector(self, idx: int):
+        """The fault injector for replica leg ``idx``: the bare client role
+        on the primary, ``<role>_b`` on a backup — so plans can target the
+        failover leg without firing on the healthy one."""
+        if idx not in self._injectors:
+            leg_role = self.role if idx == 0 else f"{self.role}_b"
+            self._injectors[idx] = faults.client_injector(leg_role)
+        return self._injectors[idx]
+
+    def _switch_replica(self, idx: int) -> None:
+        self._sever()
+        self._cur = idx
+        self._host, self._port = self._addrs[idx]
+        self._injector = self._leg_injector(idx)
+
+    def _read_state_token(self) -> None:
+        """Learn the shard's state token from the connected server (None
+        when the server predates the op)."""
+        tok, _ = self._attempt(
+            _REPL_TOKEN, deadline_s=self._op_timeout or 10.0
+        )
+        self._state_token = None if tok < 0 else tok
 
     # -- transport ----------------------------------------------------------
 
@@ -299,7 +431,11 @@ class PSClient:
         )
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock
-        if self._wire_code != WIRE_DTYPES["f32"] or self._expect_shard is not None:
+        if (
+            self._wire_code != WIRE_DTYPES["f32"]
+            or self._expect_shard is not None
+            or self._expect_layout
+        ):
             # Encoding differs from the v1 framing (HELLO per connection —
             # the server's dtype is per-connection state, negotiated BEFORE
             # any payload op can be misparsed) — or the caller expects a
@@ -323,7 +459,10 @@ class PSClient:
         sid, scount = self._expect_shard if self._expect_shard else (0, 0)
         status, _ = self._attempt(
             _HELLO, a=WIRE_VERSION,
-            b=wire.pack_hello_b(self._wire_code, sid, scount, service="ps"),
+            b=wire.pack_hello_b(
+                self._wire_code, sid, scount, service="ps",
+                layout_version=self._expect_layout,
+            ),
             deadline_s=self._connect_timeout
             if self._connect_timeout is not None
             else 10.0,
@@ -336,14 +475,24 @@ class PSClient:
             # Checked BEFORE the shard decode: wrong-service statuses live
             # in a range a genuine shard-mismatch echo can never produce
             # (its packed identity always carries shard_count >= 1 in bits
-            # 32+, putting it far below this band).
+            # 20+, putting it far below this band).
             raise PSError(
                 f"wrong-service dial: {self._host}:{self._port} is "
                 f"{wire.SERVICE_NAMES[got]} ({got!r}), not the native PS "
                 "state service — check --ps_hosts against the running tasks"
             )
         if status <= wire.HELLO_SHARD_MISMATCH:
-            got_id, got_n = wire.unpack_shard_mismatch(status)
+            got_id, got_n, got_v = wire.unpack_shard_mismatch(status)
+            if self._expect_layout and got_v != (
+                self._expect_layout & wire.HELLO_LAYOUT_MASK
+            ):
+                raise PSError(
+                    f"layout-version mismatch: {self._host}:{self._port} "
+                    f"serves shard layout EPOCH {got_v} but this client "
+                    f"expected epoch {self._expect_layout} — a mixed-epoch "
+                    "client must never scatter onto a resharded store; "
+                    "restart the stale end on the current topology"
+                )
             raise PSError(
                 f"mis-wired shard dial: {self._host}:{self._port} owns shard "
                 f"{got_id}/{got_n} but this client expected shard "
@@ -421,6 +570,19 @@ class PSClient:
             hdr = memoryview(self._hdr)
             self._recv_exact(hdr)
             status, plen = struct.unpack("<qI", self._hdr)
+            if status == wire.REPL_DIVERGED:
+                # The replica refuses to accept a write it can no longer
+                # replicate (its peer is alive but the link is down by
+                # policy) — a PERMANENT loud failure, never retried: a
+                # silent split-brain would diverge the two replicas'
+                # state under every client that kept writing.
+                raise PSError(
+                    f"replication diverged: the PS at {self._host}:"
+                    f"{self._port} refuses state-mutating ops because its "
+                    "peer replica cannot mirror them (partitioned link, or "
+                    "the peer restarted without syncing) — heal the link / "
+                    "re-sync the lagging replica before resuming training"
+                )
             if not plen:
                 return status, np.empty((0,), np.float32)
             # Receive straight into the result array (f32) or its bf16
@@ -478,13 +640,22 @@ class PSClient:
 
     def _recover(self, t_end: float) -> None:
         """Reconnect with exponential backoff until ``t_end``; on success,
-        detect a server restart via the incarnation id and rebuild state."""
+        detect state loss (token/incarnation) and rebuild only as the LAST
+        resort.  With replicas configured (r12), attempts ALTERNATE the
+        replica addresses — a dead primary fails over to its backup within
+        one retry, with zero chief involvement when the backup's token
+        proves the state intact."""
         attempt = 0
+        lost: set[int] = set()
+        lost_retries = 0
+        immediate = False
         while True:
-            if attempt:  # first attempt is immediate — the common drop is
-                # transient with a healthy server; backoff paces retries.
+            if attempt and not immediate:
+                # first attempt is immediate — the common drop is transient
+                # with a healthy server; backoff paces retries.
                 delay = min(self._backoff * (2 ** min(attempt - 1, 6)), 2.0)
                 time.sleep(min(delay, max(0.0, t_end - time.monotonic())))
+            immediate = False
             if time.monotonic() >= t_end:
                 faults.log_event(
                     "reconnect_gave_up", role=self.role, host=self._host,
@@ -498,10 +669,26 @@ class PSClient:
             try:
                 self._connect()
             except OSError:
+                if len(self._addrs) > 1:
+                    self._switch_replica((self._cur + 1) % len(self._addrs))
                 continue
             try:
-                self._post_reconnect(attempt)
+                # After several rounds stuck on state-lost replicas (the
+                # OTHER replica stayed unreachable throughout), stop
+                # waiting for a survivor that isn't coming and rebuild on
+                # what we have — the both-replicas-dead last resort.
+                self._post_reconnect(
+                    attempt, lost, force_rebuild=lost_retries >= 3
+                )
                 return
+            except _StateLost:
+                lost_retries += 1
+                nxt = next(
+                    i for i in range(len(self._addrs)) if i not in lost
+                )
+                self._switch_replica(nxt)
+                immediate = True
+                continue
             except (OSError, PSError):
                 # PSError: a transport failure inside a reincarnation
                 # callback (callbacks run single-attempt and wrap their
@@ -510,21 +697,51 @@ class PSClient:
                 self._sever()
                 continue
 
-    def _post_reconnect(self, attempts: int) -> None:
-        inc, _ = self._attempt(
-            _INCARNATION, deadline_s=self._op_timeout or 10.0
-        )
-        changed = inc != self._incarnation
+    def _post_reconnect(
+        self, attempts: int, lost: set[int] | None = None,
+        force_rebuild: bool = False,
+    ) -> None:
+        deadline = self._op_timeout or 10.0
+        inc, _ = self._attempt(_INCARNATION, deadline_s=deadline)
+        token = None
+        if len(self._addrs) > 1:  # token semantics are replicated-only
+            tok, _ = self._attempt(_REPL_TOKEN, deadline_s=deadline)
+            token = None if tok < 0 else tok  # -2 = pre-r12 server
+        prev = self._incarnations.get(self._cur)
+        changed = prev is not None and inc != prev
+        self._incarnations[self._cur] = inc
         faults.log_event(
             "reconnected", role=self.role, attempts=attempts,
-            incarnation_changed=changed,
+            incarnation_changed=changed, replica=self._cur,
         )
         for fn in list(self._reconnect_callbacks):
             fn()
-        if not changed:
-            return
-        # Server restarted: every object is gone.  Re-create them in
-        # creation order, then let the owner re-seed volatile state.
+        if token is not None and self._state_token is not None:
+            if token == self._state_token:
+                # The shard's state LINEAGE survived — on this replica
+                # (transient drop, or a restart that REPL_SYNCed from the
+                # survivor) or by failing over to its peer.  Nothing to
+                # rebuild, nothing to reseed: the zero-stall path.
+                if changed or self._cur != 0:
+                    faults.log_event(
+                        "replica_state_intact", role=self.role,
+                        replica=self._cur, incarnation_changed=changed,
+                    )
+                return
+            if not force_rebuild and lost is not None:
+                lost.add(self._cur)
+                if len(lost) < len(self._addrs):
+                    raise _StateLost()
+        else:
+            # Legacy (token-less) server, or first contact: incarnation
+            # semantics, exactly the pre-r12 behavior.
+            if not changed:
+                if self._state_token is None:
+                    self._state_token = token
+                return
+        # State lost on every replica (or a legacy server restarted):
+        # re-create objects in creation order, then let the owner re-seed
+        # volatile state — the chief-reseed last resort.
         self._in_recovery = True
         try:
             for op, name, a, b in list(self._ensures):
@@ -539,7 +756,7 @@ class PSClient:
                 fn()
         finally:
             self._in_recovery = False
-        self._incarnation = inc
+        self._state_token = token
         faults.log_event(
             "state_rebuilt", role=self.role, objects=len(self._ensures),
             callbacks=len(self._callbacks),

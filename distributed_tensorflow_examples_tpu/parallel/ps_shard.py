@@ -71,7 +71,31 @@ __all__ = [
     "ShardedAccumulator",
     "ShardedGradientQueue",
     "flat_param_spec",
+    "replica_major",
 ]
+
+
+def replica_major(addrs, num_shards: int, num_replicas: int):
+    """Group a flat ``--ps_hosts``-ordered address list into per-shard
+    replica lists — THE one definition of the replica-major convention
+    (entry ``r*num_shards + s`` is replica r of shard s: the first
+    ``num_shards`` entries are the primaries, so a replicas=1 list is
+    exactly the pre-r12 one and adding a replica tier never renumbers the
+    primaries).  Returns ``out[s][r]``.  Every site that pairs replicas
+    (clients, the in-process chief topology, the ps-task peer mapping)
+    must go through here — a second spelling of the arithmetic is how a
+    future reshard silently pairs a client with the wrong shard's
+    backup."""
+    need = num_shards * num_replicas
+    if len(addrs) < need:
+        raise ValueError(
+            f"need {need} addresses ({num_shards} shards x {num_replicas} "
+            f"replicas), got {len(addrs)}"
+        )
+    return [
+        [addrs[r * num_shards + s] for r in range(num_replicas)]
+        for s in range(num_shards)
+    ]
 
 
 def flat_param_spec(template):
@@ -116,13 +140,27 @@ class ShardLayout:
     layout, which is what makes sharded checkpoints/publishes stable.
     """
 
-    def __init__(self, num_elems: int, num_shards: int):
+    def __init__(
+        self, num_elems: int, num_shards: int, *, num_replicas: int = 1,
+        version: int = 0,
+    ):
         if num_elems < 0:
             raise ValueError(f"num_elems must be >= 0, got {num_elems}")
         if num_shards < 1:
             raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        if num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
         self.num_elems = int(num_elems)
         self.num_shards = int(num_shards)
+        #: Replica dimension (r12): how many servers hold EACH shard.  The
+        #: partition itself is replica-independent (replicas are copies,
+        #: not slices) — checkpoint stability is untouched by replication.
+        self.num_replicas = int(num_replicas)
+        #: Layout version (r12): the shard-topology EPOCH, carried in the
+        #: HELLO identity word so mixed-epoch clients fail loudly.  Not
+        #: part of the partition math (same (num_elems, num_shards) =>
+        #: same slices in every epoch that shares them).
+        self.version = int(version)
         base, rem = divmod(self.num_elems, self.num_shards)
         self.sizes: tuple[int, ...] = tuple(
             base + (1 if i < rem else 0) for i in range(self.num_shards)
@@ -141,6 +179,16 @@ class ShardLayout:
             raise IndexError(elem)
         return int(np.searchsorted(self.offsets, elem, side="right") - 1)
 
+    def replica_addrs(
+        self, addrs: list[tuple[str, int]],
+    ) -> list[list[tuple[str, int]]]:
+        """This layout's view of :func:`replica_major` (the ONE grouping
+        definition): entry ``[s][r]`` serves shard ``s``, replica ``r``."""
+        try:
+            return replica_major(addrs, self.num_shards, self.num_replicas)
+        except ValueError as e:
+            raise ValueError(f"{self!r}: {e}") from None
+
     def __eq__(self, other) -> bool:
         return (
             isinstance(other, ShardLayout)
@@ -149,7 +197,11 @@ class ShardLayout:
         )
 
     def __repr__(self) -> str:
-        return f"ShardLayout(num_elems={self.num_elems}, num_shards={self.num_shards})"
+        return (
+            f"ShardLayout(num_elems={self.num_elems}, "
+            f"num_shards={self.num_shards}, "
+            f"num_replicas={self.num_replicas}, version={self.version})"
+        )
 
 
 class _ShardPool:
@@ -225,19 +277,40 @@ class ShardedPSClients:
     HELLO on f32) and every sharded object degrades to a zero-overhead
     pass-through around its single-shard Remote* counterpart.
 
+    Replication (r12): ``replicas`` > 1 reads ``addrs`` as replica-major —
+    the first N entries are the shard primaries, the next N their backups
+    — and each shard's ONE client carries the full replica list: a dead
+    or state-lost primary fails over to the backup inside the client's
+    own recovery loop (state-token checked, zero chief involvement).
+    ``layout_version`` != 0 pins every connection to the shard-topology
+    epoch (mixed-epoch dials fail loudly).
+
     Client fault roles: shard 0 keeps the caller's bare ``role`` (so
     existing single-shard fault plans keep matching), shard i > 0 gets
-    ``<role>_s<i>`` — a plan can target one shard's client specifically.
+    ``<role>_s<i>`` — a plan can target one shard's client specifically —
+    and ops issued while failed over to a backup replica inject under a
+    further ``_b`` suffix (``<role>_s<i>_b``).
     """
 
     def __init__(
         self, addrs: list[tuple[str, int]], *, role: str | None = None,
-        **client_kw,
+        replicas: int = 1, layout_version: int = 0, **client_kw,
     ):
         if not addrs:
             raise ValueError("need at least one shard address")
-        self.addrs = list(addrs)
-        n = len(self.addrs)
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if len(addrs) % replicas:
+            raise ValueError(
+                f"{len(addrs)} addresses do not tile {replicas} replicas"
+            )
+        self.replicas = int(replicas)
+        self.layout_version = int(layout_version)
+        n = len(addrs) // replicas
+        #: Per-shard PRIMARY addresses (the pre-r12 meaning of ``addrs``).
+        self.addrs = list(addrs[:n])
+        #: Per-shard full replica lists: ``replica_addrs[s][r]``.
+        self.replica_addrs = replica_major(addrs, n, replicas)
         self.clients: list[ps_service.PSClient] = []
         try:
             for i, (host, port) in enumerate(self.addrs):
@@ -248,6 +321,8 @@ class ShardedPSClients:
                     ps_service.PSClient(
                         host, port,
                         expect_shard=(i, n) if n > 1 else None,
+                        expect_layout=layout_version,
+                        addrs=self.replica_addrs[i] if replicas > 1 else None,
                         **kw,
                     )
                 )

@@ -47,7 +47,13 @@ import struct
 import numpy as np
 
 #: Wire protocol version (must match native/ps_server.cc kWireVersion).
-WIRE_VERSION = 2
+#: v3 (r12): the HELLO b-word's shard-identity fields moved (count bits
+#: 32..55 -> 20..31, layout version and the repl flag added above them) —
+#: the bump makes a v2/v3 HELLO pairing fail loudly (-4) instead of a
+#: relocated field silently reading as "no expectation" and disabling the
+#: mis-wire guard.  Framing is unchanged; HELLO-less connections (plain
+#: f32, no expectations) are version-agnostic, exactly as before.
+WIRE_VERSION = 3
 
 #: Payload encodings (HELLO dtype codes).  f32 framing is byte-identical
 #: to wire v1; bf16 halves payload bytes and REQUIRES a negotiated peer.
@@ -93,6 +99,16 @@ PS_OPS: dict[str, int] = {
     "GQ_RESET_WORKER": 25,
     "HELLO": 26,
     "PSTORE_GET_IF_NEWER": 27,
+    # PS shard replication (r12).  REPL_SYNC: a (re)starting replica pulls
+    # its peer's full state (objects, param snapshots, dedup tables,
+    # counters, state token) before it starts serving — server-to-server
+    # only, over a repl-flagged connection.  REPL_TOKEN: answers the
+    # server's STATE TOKEN as the status — the state-lineage id clients
+    # compare on reconnect to tell "state intact (failover/resync)" from
+    # "state lost (reseed needed)"; a pre-r12 server answers -2 and the
+    # client falls back to incarnation-only semantics.
+    "REPL_SYNC": 28,
+    "REPL_TOKEN": 29,
 }
 
 #: Data-service op codes (data/data_service.py).  Disjoint from the PS
@@ -143,18 +159,35 @@ SRV_STATUS: dict[str, int] = {
 #: negotiation routine serves all three wires).
 HELLO_OP = PS_OPS["HELLO"]
 
-# Sharded PS (r9): HELLO's b operand carries the SHARD IDENTITY the client
-# expects of the server it dialed — dtype code in bits 0..7, expected shard
-# id in bits 8..31, expected shard count in bits 32..55.  A zero count
-# means "no expectation" (every pre-r9 client — their b is just the dtype
-# code, < 256).  The server answers ``-5 - packed(own identity)`` on a
-# mismatch, so a mis-wired dial fails loudly at connect, naming what was
-# actually reached, instead of silently serving the wrong slice of the
-# parameter vector.
+# Sharded PS (r9, field layout revised r12): HELLO's b operand carries the
+# SHARD IDENTITY the client expects of the server it dialed — dtype code in
+# bits 0..7, expected shard id in bits 8..19, expected shard count in bits
+# 20..31, expected LAYOUT VERSION in bits 32..47 (the shard-topology epoch
+# — the plumbing live N->M resharding rides on: mixed-epoch clients fail
+# the dial loudly instead of scattering onto the wrong partition), and the
+# replication-peer flag at bit 48 (the server-to-server forward/sync
+# connection announces itself so mirrors are never re-forwarded and a
+# partitioned peer can refuse it by policy).  A zero count/version means
+# "no expectation" (every pre-r9 client — their b is just the dtype code,
+# < 256 — packs identically).  The server answers ``-5 - packed(own
+# identity)`` on a mismatch, so a mis-wired dial fails loudly at connect,
+# naming what was actually reached, instead of silently serving the wrong
+# slice (or the wrong epoch) of the parameter vector.
 HELLO_SHARD_ID_SHIFT = 8
-HELLO_SHARD_COUNT_SHIFT = 32
-HELLO_SHARD_MASK = 0xFFFFFF
+HELLO_SHARD_COUNT_SHIFT = 20
+HELLO_SHARD_MASK = 0xFFF
+HELLO_LAYOUT_SHIFT = 32
+HELLO_LAYOUT_MASK = 0xFFFF
+HELLO_REPL_SHIFT = 48
 HELLO_SHARD_MISMATCH = -5
+
+# PS replication statuses (r12, native/ps_server.cc parity).  REPL_REFUSED:
+# a partitioned server refusing its peer's repl-flagged connection (the
+# injected-partition primitive).  REPL_DIVERGED: a replica refusing a
+# state-MUTATING client op because it can no longer replicate it (its peer
+# refuses the link) — the loud split-brain error; reads still serve.
+REPL_REFUSED = -6
+REPL_DIVERGED = -7
 
 # Service identity (r10): every wire service has an id + a 4-byte tag.  A
 # client announces the service it EXPECTS in HELLO's b operand (bits
@@ -178,14 +211,31 @@ WRONG_SERVICE_BASE = -100
 
 def pack_hello_b(
     dtype_code: int, shard_id: int = 0, shard_count: int = 0,
-    service: str = "",
+    service: str = "", layout_version: int = 0, repl: bool = False,
 ) -> int:
     """HELLO's b operand: dtype + (optional) expected shard identity +
-    (optional) expected SERVICE identity."""
+    (optional) expected layout version + (optional) replication-peer flag
+    + (optional) expected SERVICE identity.  Out-of-range fields are
+    REJECTED, never masked: a truncated shard_count/layout_version would
+    pack as "no expectation" and silently disable the very guard the
+    word exists to enforce."""
+    if not 0 <= shard_id <= HELLO_SHARD_MASK or \
+            not 0 <= shard_count <= HELLO_SHARD_MASK:
+        raise ValueError(
+            f"shard identity ({shard_id}/{shard_count}) exceeds the "
+            f"{HELLO_SHARD_MASK + 1}-shard HELLO field"
+        )
+    if not 0 <= layout_version <= HELLO_LAYOUT_MASK:
+        raise ValueError(
+            f"layout_version {layout_version} exceeds the "
+            f"{HELLO_LAYOUT_MASK + 1}-epoch HELLO field"
+        )
     return (
         dtype_code
-        | ((shard_id & HELLO_SHARD_MASK) << HELLO_SHARD_ID_SHIFT)
-        | ((shard_count & HELLO_SHARD_MASK) << HELLO_SHARD_COUNT_SHIFT)
+        | (shard_id << HELLO_SHARD_ID_SHIFT)
+        | (shard_count << HELLO_SHARD_COUNT_SHIFT)
+        | (layout_version << HELLO_LAYOUT_SHIFT)
+        | ((1 if repl else 0) << HELLO_REPL_SHIFT)
         | ((SERVICE_IDS[service] if service else 0) << HELLO_SERVICE_SHIFT)
     )
 
@@ -258,13 +308,14 @@ def hello_failure(
     )
 
 
-def unpack_shard_mismatch(status: int) -> tuple[int, int]:
+def unpack_shard_mismatch(status: int) -> tuple[int, int, int]:
     """Decode a ``-5 - packed`` HELLO answer into the SERVER's
-    (shard_id, shard_count)."""
+    (shard_id, shard_count, layout_version)."""
     packed = -(status - HELLO_SHARD_MISMATCH)
     return (
         (packed >> HELLO_SHARD_ID_SHIFT) & HELLO_SHARD_MASK,
         (packed >> HELLO_SHARD_COUNT_SHIFT) & HELLO_SHARD_MASK,
+        (packed >> HELLO_LAYOUT_SHIFT) & HELLO_LAYOUT_MASK,
     )
 
 #: Request tail after the name bytes: a, b, payload_len.

@@ -379,11 +379,16 @@ def run_ps_cluster_task(
 
     from ..utils.flags import ps_shard_topology
 
-    entries, n_shards = ps_shard_topology(FLAGS)
-    # The sharded-store topology (r9): shard i's server is entries[i];
-    # every client scatters/gathers over all of them in parallel.  Shard 0
-    # doubles as the coordinator (tokens, shutdown signal).
-    shard_addrs = entries[:n_shards]
+    entries, n_shards, n_replicas = ps_shard_topology(FLAGS)
+    # The sharded-store topology (r9): shard i's PRIMARY server is
+    # entries[i]; every client scatters/gathers over all of them in
+    # parallel.  Shard 0 doubles as the coordinator (tokens, shutdown
+    # signal).  Replication (r12): replica r of shard i is
+    # entries[r*n_shards + i] — clients carry the full per-shard replica
+    # list and fail over inside their own recovery loop.
+    shard_addrs = entries[: n_shards * n_replicas]
+    primary_addrs = entries[:n_shards]
+    layout_version = int(getattr(FLAGS, "ps_layout_version", 0) or 0)
     host, port = shard_addrs[0]
 
     if job == "serve":
@@ -418,7 +423,7 @@ def run_ps_cluster_task(
             if rc != 0:
                 raise SystemExit(rc)
             return None
-        for sh, sp in shard_addrs:
+        for sh, sp in primary_addrs:
             if not _probe_ps(sh, sp, 120.0):
                 raise ConnectionError(
                     f"no PS service at {sh}:{sp} after 120 s (the serve "
@@ -427,7 +432,7 @@ def run_ps_cluster_task(
         bound = serve_pkg.host_serve_task(
             init_fn=init_fn,
             predict_fn=predict_fn,
-            ps_addrs=shard_addrs,
+            ps_addrs=primary_addrs,
             port=int(my_port),
             loopback_only=not listen_all,
             max_batch=int(getattr(FLAGS, "serve_max_batch", 32)),
@@ -475,22 +480,45 @@ def run_ps_cluster_task(
             if rc != 0:
                 raise SystemExit(rc)
             return None
-        if tid >= n_shards:
-            # Launch-script parity: extra PS tasks beyond the shard count
-            # are accepted but own no slice — host an unsharded-identity
-            # service nothing will dial.
+        if tid >= n_shards * n_replicas:
+            # Launch-script parity: extra PS tasks beyond the shard/replica
+            # grid are accepted but own no slice — host an
+            # unsharded-identity service nothing will dial.
             log.warning(
-                "PS task %d exceeds --ps_shards=%d: no shard assigned "
-                "(idle; shrink --ps_hosts or raise --ps_shards)",
-                tid, n_shards,
+                "PS task %d exceeds --ps_shards=%d x --ps_replicas=%d: no "
+                "shard assigned (idle; shrink --ps_hosts or raise "
+                "--ps_shards)", tid, n_shards, n_replicas,
             )
             bound = async_ps.host_ps_task(
                 int(my_port), loopback_only=not listen_all
             )
         else:
+            # Task i serves shard i % shards, replica i // shards — the
+            # inverse of ps_shard.replica_major's addrs[r*shards + s]
+            # grouping (the ONE replica-major definition).  Its PEER is
+            # the other replica of the same shard; a restart catches up
+            # from it (REPL_SYNC) before serving — the primary waits only
+            # briefly (its peer may be waiting on US at a cold start),
+            # the backup generously (its primary is booting too).
+            s_id, r_id = tid % n_shards, tid // n_shards
+            peer = None
+            peer_role = ""
+            sync_wait_s = 0.0
+            if n_replicas == 2:
+                from ..parallel.ps_shard import replica_major
+
+                pair = replica_major(
+                    list(range(n_shards * n_replicas)), n_shards, n_replicas
+                )[s_id]
+                peer_tid = pair[(r_id + 1) % 2]
+                peer = entries[peer_tid]
+                peer_role = f"ps{peer_tid}"
+                sync_wait_s = 2.0 if r_id == 0 else 45.0
             bound = async_ps.host_ps_task(
                 int(my_port), loopback_only=not listen_all,
-                shard_id=tid, shard_count=n_shards,
+                shard_id=s_id, shard_count=n_shards,
+                layout_version=layout_version, peer=peer,
+                peer_role=peer_role, sync_wait_s=sync_wait_s,
             )
         print(f"PS_DONE port={bound}")
         return None
@@ -510,8 +538,9 @@ def run_ps_cluster_task(
                         "chief)"
                     )
         log.info(
-            "PS cluster chief: mode=%s %d workers, %d shard(s) at %s (%s)",
-            mode, n_workers, n_shards,
+            "PS cluster chief: mode=%s %d workers, %d shard(s) x %d "
+            "replica(s) at %s (%s)",
+            mode, n_workers, n_shards, n_replicas,
             ",".join(f"{h}:{p}" for h, p in shard_addrs),
             "hosted in-process" if chief_hosts_service else "external PS tasks",
         )
@@ -522,10 +551,12 @@ def run_ps_cluster_task(
             acfg, loss_fn, optimizer, params,
             model_state=model_state,
             rng=jax.random.key(FLAGS.seed),
+            ps_replicas=n_replicas,
+            layout_version=layout_version,
             **(
-                # Chief-hosted service (one in-process server per shard):
-                # same explicit-exposure contract as the dedicated PS task
-                # (code-review r5), checked per listed host.
+                # Chief-hosted service (one in-process server per shard
+                # replica): same explicit-exposure contract as the
+                # dedicated PS task (code-review r5), checked per host.
                 {
                     "ports": [p for _, p in shard_addrs],
                     "listen_all": any(
@@ -579,6 +610,8 @@ def run_ps_cluster_task(
         model_state=model_state,
         rng=jax.random.key(FLAGS.seed),
         addrs=shard_addrs,
+        ps_replicas=n_replicas,
+        layout_version=layout_version,
         # Per-shard pull/push wall-time scalars (shard-imbalance signal).
         metrics_dir=(
             os.path.join(FLAGS.log_dir, f"worker{wid}") if FLAGS.log_dir else None

@@ -32,6 +32,19 @@ Kinds:
   run's total).  One-shot: a supervisor restarting the task strips the
   spec via :func:`plan_without` so the incarnation that heals is not
   re-killed.
+- ``partition`` — drop traffic between two named roles while BOTH stay
+  alive: the fault that tests failover and split-brain guards distinctly
+  from death.  Two shapes: (a) process-level, ``partition:role=ps0,
+  peer=ps2`` — the matching SERVICE process severs its replication link
+  toward the peer role by policy (``arm_process_faults(partition_fn=...)``
+  — for a replicated PS pair the next mutating op then fails loudly with
+  the divergence error instead of silently splitting brains); timing via
+  ``after_s``/``after_reqs`` like ``die``, or immediately when neither is
+  given.  (b) client-level, ``partition:role=worker0,op=5`` — from the
+  ``op``-th call onward, EVERY op on the matching client severs its
+  socket first (the persistent-drop analog of ``drop_conn``): the client
+  keeps healing by reconnect, so this models a flapping/black-holed link
+  rather than a dead peer.
 
 Every spec takes ``role=`` (fnmatch glob, default ``*``) matched against
 the process role — set by launchers via the ``DTX_FAULT_ROLE`` env var or
@@ -72,7 +85,7 @@ log = logging.getLogger("dtx.faults")
 #: supervisors/tests can tell an injected kill from an organic crash.
 FAULT_EXIT_CODE = 43
 
-_CLIENT_KINDS = ("drop_conn", "delay")
+_CLIENT_KINDS = ("drop_conn", "delay", "partition")
 _KINDS = _CLIENT_KINDS + ("die",)
 
 _role_lock = threading.Lock()
@@ -86,13 +99,17 @@ class FaultSpec:
     op: int = 0  # client faults: 1-based call index the fault fires at
     count: int = 1  # client faults: consecutive calls affected
     ms: float = 0.0  # delay: sleep duration
-    after_s: float = 0.0  # die: seconds after arming
-    after_reqs: int = 0  # die: server requests served (PS-side step analog)
+    after_s: float = 0.0  # die/partition: seconds after arming
+    after_reqs: int = 0  # die/partition: server requests served
     p: float = 1.0  # client faults: per-eligible-op probability
     seed: int = 0  # seeds the probabilistic RNG (with role+kind)
+    peer: str = "*"  # partition: glob for the OTHER side of the cut link
 
     def matches_role(self, role: str) -> bool:
         return fnmatch.fnmatchcase(role, self.role)
+
+    def matches_peer(self, role: str) -> bool:
+        return fnmatch.fnmatchcase(role, self.peer)
 
 
 def parse_plan(plan: str) -> list[FaultSpec]:
@@ -112,7 +129,7 @@ def parse_plan(plan: str) -> list[FaultSpec]:
             key, has_eq, val = item.partition("=")
             if not has_eq:
                 raise ValueError(f"bad fault field {item!r} in {raw!r}")
-            if key == "role":
+            if key in ("role", "peer"):
                 kw[key] = val
             elif key in ("op", "count", "after_reqs", "seed"):
                 kw[key] = int(val)
@@ -121,7 +138,11 @@ def parse_plan(plan: str) -> list[FaultSpec]:
             else:
                 raise ValueError(f"unknown fault field {key!r} in {raw!r}")
         spec = FaultSpec(kind=kind, **kw)
-        if spec.kind in _CLIENT_KINDS and spec.op <= 0:
+        # ``partition`` is exempt: its process shape (role+peer, timed like
+        # die or immediate) carries no op index; only its op>0 form is a
+        # client fault.
+        if spec.kind in _CLIENT_KINDS and spec.kind != "partition" \
+                and spec.op <= 0:
             raise ValueError(f"{kind} fault needs op=<n> (1-based): {raw!r}")
         if spec.kind == "die" and not (spec.after_s > 0 or spec.after_reqs > 0):
             raise ValueError(f"die fault needs after_s or after_reqs: {raw!r}")
@@ -198,16 +219,26 @@ class ClientFaultInjector:
     def __init__(self, role: str | None = None, plan: str | None = None):
         self.role = role if role is not None else current_role()
         raw = plan if plan is not None else active_plan()
+        # Only a partition spec's CLIENT shape (an explicit op index)
+        # belongs here — its process shape (role+peer) arms at the service
+        # host via arm_process_faults and must not also sever the host's
+        # own client legs.
         self._specs = [
             s
             for s in (parse_plan(raw) if raw else [])
             if s.kind in _CLIENT_KINDS and s.matches_role(self.role)
+            and (s.kind != "partition" or s.op > 0)
         ]
         self._op = 0
         self._rngs: dict[int, "_DetRng"] = {}
 
     def _fires(self, i: int, spec: FaultSpec) -> bool:
-        if not (spec.op <= self._op < spec.op + spec.count):
+        if spec.kind == "partition":
+            # Persistent from its op index onward (count ignored): a
+            # partition stays cut until the plan changes.
+            if self._op < spec.op:
+                return False
+        elif not (spec.op <= self._op < spec.op + spec.count):
             return False
         if spec.p >= 1.0:
             return True
@@ -216,7 +247,8 @@ class ClientFaultInjector:
 
     def before_op(self, op_code: int) -> bool:
         """Advance the op counter; sleep for matching delays.  Returns True
-        when a drop_conn fault fires (the caller must sever its socket)."""
+        when a drop_conn/partition fault fires (the caller must sever its
+        socket)."""
         if not self._specs:
             return False
         self._op += 1
@@ -235,6 +267,13 @@ class ClientFaultInjector:
                     "inject_drop_conn", role=self.role, op=self._op,
                     op_code=op_code,
                 )
+                drop = True
+            elif spec.kind == "partition":
+                if self._op == spec.op:  # log the cut once, not per op
+                    log_event(
+                        "inject_partition", role=self.role, op=self._op,
+                        op_code=op_code,
+                    )
                 drop = True
         return drop
 
@@ -277,18 +316,76 @@ def _die(spec: FaultSpec, role: str, **fields) -> None:
 
 
 def arm_process_faults(
-    role: str | None = None, *, request_count_fn=None
+    role: str | None = None, *, request_count_fn=None, partition_fn=None,
 ) -> list[threading.Thread]:
-    """Arm matching ``die`` specs for this process.  ``after_s`` specs start
-    a timer thread; ``after_reqs`` specs need ``request_count_fn`` (e.g.
-    ``ps_service.server_request_count`` in a PS task) and poll it.  Returns
-    the watcher threads (daemonic; tests may join on a dead process)."""
+    """Arm matching ``die`` (and process-shape ``partition``) specs for
+    this process.  ``after_s`` specs start a timer thread; ``after_reqs``
+    specs need ``request_count_fn`` (e.g.
+    ``ps_service.server_request_count`` in a PS task) and poll it.
+    ``partition_fn(spec) -> bool`` is the service host's cut-the-link hook
+    (a replicated PS task severs its repl link when the spec's ``peer``
+    glob matches its peer's role); partition specs without timing fields
+    arm immediately.  Returns the watcher threads (daemonic; tests may
+    join on a dead process)."""
     role = role if role is not None else current_role()
     raw = active_plan()
     if not raw:
         return []
+
+    def fire_partition(spec):
+        if partition_fn(spec):
+            log_event(
+                "inject_partition", role=role, peer=spec.peer,
+                after_s=spec.after_s, after_reqs=spec.after_reqs,
+            )
+
     threads: list[threading.Thread] = []
     for spec in parse_plan(raw):
+        if spec.kind == "partition" and spec.op <= 0 and \
+                spec.matches_role(role):
+            if partition_fn is None:
+                log_event(
+                    "fault_unarmed", role=role, kind="partition",
+                    reason="no_partition_hook_in_this_process",
+                )
+                continue
+            if spec.after_s > 0:
+
+                def ptimer(spec=spec):
+                    time.sleep(spec.after_s)
+                    fire_partition(spec)
+
+                t = threading.Thread(
+                    target=ptimer, daemon=True, name="dtx-fault-partition"
+                )
+                t.start()
+                threads.append(t)
+            elif spec.after_reqs > 0:
+                if request_count_fn is None:
+                    # Same contract as the die kind: a timed trigger with
+                    # no counter to read must be SKIPPED loudly, never
+                    # fired at request 0.
+                    log_event(
+                        "fault_unarmed", role=role, kind="partition",
+                        reason="after_reqs_without_request_counter",
+                    )
+                    continue
+
+                def ppoller(spec=spec):
+                    while True:
+                        if request_count_fn() >= spec.after_reqs:
+                            fire_partition(spec)
+                            return
+                        time.sleep(0.02)
+
+                t = threading.Thread(
+                    target=ppoller, daemon=True, name="dtx-fault-partition"
+                )
+                t.start()
+                threads.append(t)
+            else:
+                fire_partition(spec)
+            continue
         if spec.kind != "die" or not spec.matches_role(role):
             continue
         if spec.after_s > 0:

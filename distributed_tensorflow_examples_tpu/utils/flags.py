@@ -154,6 +154,30 @@ def define_legacy_cluster_flags():
     )
     _define(
         "integer",
+        "ps_replicas",
+        1,
+        "PS shard replication (r12): servers holding EACH shard.  2 gives "
+        "every shard a primary/backup pair — --ps_hosts then lists "
+        "shards*2 entries, the first half primaries, the second half "
+        "backups (task i serves shard i%%shards, replica i//shards).  "
+        "Primaries forward state-mutating ops to their backup; a client "
+        "whose primary dies (or restarts empty) fails over to the backup "
+        "with ZERO chief involvement (state-token checked), and a "
+        "restarted replica catches up from the survivor via REPL_SYNC "
+        "before serving.  1 = the unreplicated pre-r12 wire.",
+    )
+    _define(
+        "integer",
+        "ps_layout_version",
+        0,
+        "PS shard-layout EPOCH (r12): carried in the HELLO shard-identity "
+        "word by every server and client of the topology, so a client "
+        "from a different epoch (e.g. a stale task surviving a reshard) "
+        "fails its dial loudly naming both versions instead of silently "
+        "scattering onto the wrong partition.  0 = unversioned.",
+    )
+    _define(
+        "integer",
         "ps_restarts",
         3,
         "Cross-process PS launch: run the --job_name=ps task under "
@@ -297,23 +321,37 @@ def parse_hostports(spec: str, flag: str = "--ps_hosts") -> list[tuple[str, int]
     return addrs
 
 
-def ps_shard_topology(FLAGS) -> tuple[list[tuple[str, int]], int]:
+def ps_shard_topology(FLAGS) -> tuple[list[tuple[str, int]], int, int]:
     """The validated PS shard topology: the FULL ``--ps_hosts`` address
     list plus the resolved shard count (``--ps_shards``; -1 = one shard
-    per host).  Shard i's server is ``addrs[i]`` — the ONE place the
+    per host) and replica count (``--ps_replicas``, r12).  Shard i's
+    PRIMARY is ``addrs[i]`` and replica r of shard i is
+    ``addrs[r*shards + i]`` (replica-major) — the ONE place the
     host-order/shard-id correspondence is defined (r9 fix: the pre-r9
     path warned and silently used ``ps_hosts[0]`` only)."""
     addrs = parse_hostports(FLAGS.ps_hosts)
     raw = getattr(FLAGS, "ps_shards", -1)
     n = -1 if raw is None else int(raw)
-    if n < 0:
-        n = len(addrs)
-    if n == 0 or n > len(addrs):
+    r = int(getattr(FLAGS, "ps_replicas", 1) or 1)
+    if r not in (1, 2):
         raise ValueError(
-            f"--ps_shards={n} invalid for {len(addrs)} --ps_hosts entries "
-            f"(need 1..{len(addrs)}, or -1 for one shard per host)"
+            f"--ps_replicas={r} unsupported (1 = unreplicated, 2 = "
+            "primary/backup pairs; deeper chains are not implemented)"
         )
-    return addrs, n
+    if n < 0:
+        if len(addrs) % r:
+            raise ValueError(
+                f"--ps_replicas={r} does not tile {len(addrs)} --ps_hosts "
+                "entries (need shards*replicas hosts)"
+            )
+        n = len(addrs) // r
+    if n == 0 or n * r > len(addrs):
+        raise ValueError(
+            f"--ps_shards={n} x --ps_replicas={r} invalid for {len(addrs)} "
+            f"--ps_hosts entries (need shards*replicas <= {len(addrs)}, "
+            "or -1 shards for one shard per host)"
+        )
+    return addrs, n, r
 
 
 def resolve_legacy_cluster(FLAGS) -> dict:
@@ -340,14 +378,16 @@ def resolve_legacy_cluster(FLAGS) -> dict:
         if emulation:
             # Validate and surface the FULL list (r9 fix: this path used
             # to log entry [0] only, hiding a sharded topology's servers).
-            addrs, n_shards = ps_shard_topology(FLAGS)
+            addrs, n_shards, n_replicas = ps_shard_topology(FLAGS)
             info["ps_hosts"] = [f"{h}:{p}" for h, p in addrs]
             info["ps_shards"] = n_shards
+            info["ps_replicas"] = n_replicas
             log.info(
                 "--ps_hosts given with PS emulation: %d host(s), %d "
-                "shard(s) — the native state service serves shard i at "
-                "entry i: %s.",
-                len(addrs), n_shards, ",".join(info["ps_hosts"][:n_shards]),
+                "shard(s) x %d replica(s) — the native state service "
+                "serves shard i%%%d, replica i//%d at entry i: %s.",
+                len(addrs), n_shards, n_replicas, n_shards, n_shards,
+                ",".join(info["ps_hosts"][: n_shards * n_replicas]),
             )
         else:
             info["ps_hosts"] = FLAGS.ps_hosts.split(",")

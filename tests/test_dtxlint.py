@@ -44,7 +44,12 @@ _WIRE_PY = textwrap.dedent(
     HELLO_SHARD_ID_SHIFT = 8
     HELLO_SHARD_COUNT_SHIFT = 24
     HELLO_SHARD_MASK = 0xFFFF
+    HELLO_LAYOUT_SHIFT = 40
+    HELLO_LAYOUT_MASK = 0xFF
+    HELLO_REPL_SHIFT = 50
     HELLO_SHARD_MISMATCH = -5
+    REPL_REFUSED = -6
+    REPL_DIVERGED = -7
     WRONG_SERVICE_BASE = -40
     SERVICE_IDS = {"ps": 1, "dsvc": 2, "msrv": 3}
     PS_OPS = {"PING": 15, "PSTORE_GET": 18, "HELLO": 26}
@@ -61,6 +66,11 @@ _PS_SERVER_CC = textwrap.dedent(
     constexpr int kHelloShardIdShift = 8;
     constexpr int kHelloShardCountShift = 24;
     constexpr int kHelloShardMask = 0xFFFF;
+    constexpr int kHelloLayoutShift = 40;
+    constexpr int kHelloLayoutMask = 0xFF;
+    constexpr int kHelloReplShift = 50;
+    constexpr int kReplRefused = -6;
+    constexpr int kReplDiverged = -7;
     constexpr int kTagWorkerShift = 40;
     enum Op : int {
       PING = 15,

@@ -66,8 +66,8 @@ def _eval_loss(params) -> float:
 
 def _run_socket_training(
     *, steps=40, mode="async", plan="", ps_addr=None, ps_addrs=None,
-    n_workers=2, shards=1, reconnect_deadline_s=60.0, join_timeout=180.0,
-    wire_dtype="f32", stop_servers=None,
+    n_workers=2, shards=1, replicas=1, reconnect_deadline_s=60.0,
+    join_timeout=180.0, wire_dtype="f32", stop_servers=None, on_chief=None,
 ):
     """One async-PS training run over the socket transport, chief + worker
     threads in THIS process (the thread/2-process fault path): cheap enough
@@ -77,7 +77,10 @@ def _run_socket_training(
     versioned param-pull cache); ``wire_dtype`` additionally switches the
     negotiated payload encoding.  ``shards`` > 1 hosts that many in-process
     shard servers (r9 scatter/gather); ``ps_addrs`` connects to external
-    shard servers instead."""
+    shard servers instead.  ``replicas=2`` (r12) gives every shard a
+    primary/backup pair (in-process, or external when ``ps_addrs`` lists
+    shards*2 replica-major entries).  ``on_chief(chief)`` runs on a side
+    thread once training started — the mid-run kill hook."""
     os.environ["DTX_FAULT_PLAN"] = plan
     try:
         cfg = async_ps.AsyncPSConfig(
@@ -97,13 +100,18 @@ def _run_socket_training(
             rng=jax.random.key(0),
             ps_addr=ps_addr,
             ps_addrs=ps_addrs,
-            ports=[0] * shards if shards > 1 else None,
+            ports=[0] * (shards * replicas) if shards * replicas > 1 else None,
+            ps_replicas=replicas,
         )
-        addrs = (
-            ps_addrs
-            if ps_addrs is not None
-            else [("127.0.0.1", p) for p in chief.ports]
-        )
+        if ps_addrs is not None:
+            addrs = ps_addrs
+        else:
+            # Replica-major flat list, exactly the --ps_hosts convention.
+            addrs = [
+                rl[r]
+                for r in range(replicas)
+                for rl in chief._group.replica_addrs
+            ]
         workers = [
             threading.Thread(
                 target=async_ps.remote_worker_loop,
@@ -115,6 +123,7 @@ def _run_socket_training(
                     batches=_blob_batches(w + 1),
                     rng=jax.random.key(0),
                     addrs=addrs,
+                    ps_replicas=replicas,
                 ),
                 daemon=True,
             )
@@ -133,6 +142,10 @@ def _run_socket_training(
 
         ct = threading.Thread(target=chief_body, daemon=True)
         ct.start()
+        if on_chief is not None:
+            threading.Thread(
+                target=on_chief, args=(chief,), daemon=True
+            ).start()
         for w in workers:
             w.start()
         if not done.wait(join_timeout):
@@ -311,6 +324,7 @@ from distributed_tensorflow_examples_tpu.train import ps_experiment
 FLAGS = SimpleNamespace(
     job_name="ps", task_index={task_index}, ps_hosts={ps_hosts!r},
     worker_hosts="a:1,b:1", ps_tasks=1, ps_listen_all=False, ps_restarts=2,
+    ps_replicas={ps_replicas}, ps_layout_version=0,
     batch_size=8, train_steps=60, log_dir="", checkpoint_every_steps=50,
     replicas_to_aggregate=0, max_staleness=0, deterministic=False, seed=0,
     grad_accum=1,
@@ -347,7 +361,8 @@ def test_ps_kill_mid_run_heals_via_supervised_restart(tmp_path, caplog):
     script = tmp_path / "ps_task.py"
     script.write_text(
         _PS_TASK_SCRIPT.format(
-            root=ROOT, task_index=0, ps_hosts=f"127.0.0.1:{port}"
+            root=ROOT, task_index=0, ps_hosts=f"127.0.0.1:{port}",
+            ps_replicas=1,
         )
     )
     env = dict(os.environ)
@@ -463,7 +478,8 @@ def test_one_shard_of_two_killed_heals_via_supervised_restart(tmp_path, caplog):
             script = tmp_path / f"ps_task_{tid}.py"
             script.write_text(
                 _PS_TASK_SCRIPT.format(
-                    root=ROOT, task_index=tid, ps_hosts=ps_hosts
+                    root=ROOT, task_index=tid, ps_hosts=ps_hosts,
+                    ps_replicas=1,
                 )
             )
             env = dict(env_base)
@@ -536,6 +552,245 @@ def test_one_shard_of_two_killed_heals_via_supervised_restart(tmp_path, caplog):
     assert "event=inject_die" not in log0, log0[-2000:]
     assert "PS_DONE" in log0, log0[-2000:]
     assert procs[0].returncode == 0 and procs[1].returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# PS shard replication (r12): failover matrix
+# ---------------------------------------------------------------------------
+
+
+def test_backup_leg_faults_inject_under_b_role(caplog):
+    """r12 fault matrix: the failover leg is its OWN client role — a plan
+    targeting ``<role>_b`` fires only on ops issued while connected to the
+    backup replica, and those ops still heal by reconnect+replay."""
+    caplog.set_level("INFO", logger="dtx.faults")
+    pa = ps_service.start_server(0)
+    pb = ps_service.start_server(0, peer=("127.0.0.1", pa), sync_wait_s=10.0)
+    ps_service.set_server_peer(pa, ("127.0.0.1", pb))
+    os.environ["DTX_FAULT_PLAN"] = "drop_conn:role=w0_b,op=1"
+    try:
+        c = ps_service.PSClient(
+            "127.0.0.1", pa, op_timeout_s=5.0, reconnect_deadline_s=20.0,
+            role="w0", addrs=[("127.0.0.1", pa), ("127.0.0.1", pb)],
+        )
+        st = ps_service.RemoteParamStore(c, "params", 4, cache_pulls=False)
+        st.set(1, np.arange(4, dtype=np.float32))
+        ps_service.stop_server(pa)  # force the failover to the backup leg
+        assert st.get()[0] == 1  # heals over to the backup mid-call
+        # First COUNTED backup-leg op: the injected drop fires under w0_b
+        # and heals by reconnect+replay on the same leg.
+        step, flat = st.get()
+        assert step == 1
+        np.testing.assert_array_equal(flat, np.arange(4, dtype=np.float32))
+        c.close()
+    finally:
+        os.environ.pop("DTX_FAULT_PLAN", None)
+        ps_service.stop_server()
+    events = [
+        r.getMessage() for r in caplog.records if "dtx.faults" in r.getMessage()
+    ]
+    assert any(
+        "inject_drop_conn" in m and "role=w0_b" in m for m in events
+    ), events
+    # Recovery events carry the client's base role + the replica index
+    # (the leg suffix is the INJECTION identity, not the logging one).
+    assert any(
+        "event=reconnected" in m and "replica=1" in m for m in events
+    ), events
+    # The primary leg never fired (its role carries no _b suffix).
+    assert not any(
+        "inject_drop_conn" in m and "role=w0 " in m for m in events
+    ), events
+
+
+def test_partition_between_replicas_fails_loudly_not_split_brain(caplog):
+    """r12 fault matrix: a ``partition`` spec between the two replicas of
+    a shard (both stay ALIVE) makes the next state-mutating op fail with
+    the loud divergence error — never a silent split-brain — while reads
+    keep serving.  Arms exactly the way ``host_ps_task`` does."""
+    caplog.set_level("INFO", logger="dtx.faults")
+    pa = ps_service.start_server(0)
+    pb = ps_service.start_server(0, peer=("127.0.0.1", pa), sync_wait_s=10.0)
+    ps_service.set_server_peer(pa, ("127.0.0.1", pb))
+    os.environ["DTX_FAULT_PLAN"] = "partition:role=ps0,peer=ps1"
+    try:
+        # A spec whose peer glob does NOT match this pair must not arm.
+        faults.arm_process_faults(
+            role="ps0",
+            partition_fn=lambda spec: (
+                spec.matches_peer("ps9")
+                and ps_service.set_server_partitioned(pa, True)
+            ),
+        )
+        c = ps_service.PSClient("127.0.0.1", pa, op_timeout_s=5.0)
+        st = ps_service.RemoteParamStore(c, "params", 4, cache_pulls=False)
+        st.set(1, np.zeros(4, np.float32))  # link healthy: accepted
+        # The real arming: peer glob matches, the pair partitions.
+        faults.arm_process_faults(
+            role="ps0",
+            partition_fn=lambda spec: (
+                spec.matches_peer("ps1")
+                and ps_service.set_server_partitioned(pa, True)
+            ),
+        )
+        with pytest.raises(ps_service.PSError, match="replication diverged"):
+            st.set(2, np.ones(4, np.float32))
+        # Reads still serve, and the divergence is latched/observable.
+        assert st.get()[0] == 1
+        assert ps_service.server_diverged(pa) == 1
+        c.close()
+    finally:
+        os.environ.pop("DTX_FAULT_PLAN", None)
+        ps_service.stop_server()
+    events = [
+        r.getMessage() for r in caplog.records if "dtx.faults" in r.getMessage()
+    ]
+    assert any("event=inject_partition" in m for m in events), events
+
+
+def test_replicated_ps_kill_heals_via_backup_with_zero_reseeds(tmp_path, caplog):
+    """r12 acceptance (the replication tentpole scenario): a 2-shard
+    REPLICATED topology — 4 dedicated supervised PS tasks, shard i served
+    by primary ps<i> and backup ps<2+i> — runs the async MNIST-blob
+    training; shard 0's PRIMARY is KILLED mid-run by its fault plan
+    (``die:after_reqs``).  The clients fail over to the backup inside
+    their own recovery loops (state token proves the state survived), so
+    training heals with ZERO chief reseeds (the counter stays 0 and no
+    chief_reseed event fires — the pre-r12 behavior this PR replaces),
+    at-most-once push semantics hold across the failover (dedup counters
+    readable, applied-step count exact), and the restarted primary
+    catches up from the survivor via REPL_SYNC and serves to a clean
+    shutdown."""
+    caplog.set_level("INFO", logger="dtx.faults")
+    ports = _free_ports(4)
+    ps_hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+    env_base = dict(os.environ)
+    env_base["JAX_PLATFORMS"] = "cpu"
+    env_base.pop("PALLAS_AXON_POOL_IPS", None)
+    procs, logs = [], []
+    try:
+        for tid in range(4):
+            script = tmp_path / f"ps_task_{tid}.py"
+            script.write_text(
+                _PS_TASK_SCRIPT.format(
+                    root=ROOT, task_index=tid, ps_hosts=ps_hosts,
+                    ps_replicas=2,
+                )
+            )
+            env = dict(env_base)
+            # Only shard 0's PRIMARY dies, once it has served 60 requests
+            # — mid-run (tokens/coordination keep its counter moving),
+            # while startup polling stays well under the trigger.
+            env["DTX_FAULT_PLAN"] = "die:role=ps0,after_reqs=60"
+            logf = open(tmp_path / f"ps_task_{tid}.log", "w")
+            logs.append(logf)
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, str(script)],
+                    stdout=logf, stderr=subprocess.STDOUT, env=env, cwd=ROOT,
+                )
+            )
+        for port in ports:
+            deadline = time.time() + 120
+            up = False
+            while time.time() < deadline:
+                try:
+                    c = ps_service.PSClient("127.0.0.1", port, timeout_s=2.0)
+                    c.ping()
+                    c.close()
+                    up = True
+                    break
+                except OSError:
+                    time.sleep(0.2)
+            assert up, f"replica task at port {port} never came up"
+
+        chief = _run_socket_training(
+            steps=40,
+            ps_addrs=[("127.0.0.1", p) for p in ports],
+            replicas=2,
+            reconnect_deadline_s=90.0,
+            join_timeout=240.0,
+        )
+        # The acceptance gates: exact step target, ZERO chief reseeds
+        # (assert the counter), dedup counters readable end-of-run, and
+        # the fault-free loss.
+        assert chief.global_step == 40
+        assert chief.reseeds == 0, "a replicated primary kill must not reseed"
+        assert chief.total_deduped != -1 and chief.total_dropped != -1
+        assert _eval_loss(chief.params) < 2.0
+        events = [
+            r.getMessage() for r in caplog.records if "dtx.faults" in r.getMessage()
+        ]
+        assert not any("event=chief_reseed" in m for m in events), events
+        # Some client really failed over to a backup replica with its
+        # state proven intact (the zero-stall path actually ran).
+        assert any(
+            "event=replica_state_intact" in m and "replica=1" in m
+            for m in events
+        ), events
+
+        # The restarted primary either got the chief's shutdown push
+        # (restarted mid-run) or exits via the orphaned-replica detector
+        # (restarted after the run already finished) — both are clean.
+        for p in procs:
+            p.wait(timeout=120)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        for f in logs:
+            f.close()
+    log0 = (tmp_path / "ps_task_0.log").read_text()
+    # ps0: injected death fired, supervisor healed the plan, the restarted
+    # incarnation (synced from the backup) served to a clean shutdown.
+    assert "event=inject_die" in log0, log0[-2000:]
+    assert "event=supervisor_healed_plan" in log0, log0[-2000:]
+    assert "PS_DONE" in log0, log0[-2000:]
+    # Every other replica served straight through, no deaths.
+    for tid in (1, 2, 3):
+        lg = (tmp_path / f"ps_task_{tid}.log").read_text()
+        assert "event=inject_die" not in lg, lg[-2000:]
+        assert "PS_DONE" in lg, lg[-2000:]
+    assert all(p.returncode == 0 for p in procs), [p.returncode for p in procs]
+
+
+def test_both_replicas_killed_chief_reseed_still_heals(caplog):
+    """r12 fault matrix: losing BOTH replicas of a shard mid-run falls
+    back to the pre-r12 last resort — both restart empty (a fresh state
+    lineage), the chief detects total state loss and reseeds, and
+    training still reaches its target."""
+    caplog.set_level("INFO", logger="dtx.faults")
+    killed = threading.Event()
+
+    def kill_both(chief):
+        while chief.global_step < 3:
+            time.sleep(0.02)
+        ports = [p for _, p in chief._group.replica_addrs[0]]
+        ps_service.stop_server(ports[0])
+        ps_service.stop_server(ports[1])
+        time.sleep(0.5)
+        # The "supervisor" restarts both EMPTY on the same ports — no
+        # survivor to sync from, so a fresh token lineage on both.
+        ps_service.start_server(ports[0])
+        ps_service.start_server(
+            ports[1], peer=("127.0.0.1", ports[0]), sync_wait_s=10.0
+        )
+        ps_service.set_server_peer(ports[0], ("127.0.0.1", ports[1]))
+        killed.set()
+
+    chief = _run_socket_training(
+        steps=60, replicas=2, reconnect_deadline_s=60.0,
+        join_timeout=200.0, on_chief=kill_both,
+    )
+    assert killed.is_set(), "the kill hook never fired"
+    assert chief.global_step == 60
+    assert chief.reseeds >= 1, "total state loss must run the reseed path"
+    assert _eval_loss(chief.params) < 2.0
+    events = [
+        r.getMessage() for r in caplog.records if "dtx.faults" in r.getMessage()
+    ]
+    assert any("event=chief_reseed" in m for m in events), events
 
 
 def _dsvc_splits(n=8, rows=16):

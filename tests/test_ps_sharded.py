@@ -117,10 +117,10 @@ def test_hello_shard_mismatch_fails_loudly():
     legacy = ps_service.PSClient(*addrs[0], timeout_s=5.0)
     legacy.ping()
     legacy.close()
-    # Packing round trip.
-    b = wire.pack_hello_b(1, 3, 7)
+    # Packing round trip (r12 layout: id, count, layout version).
+    b = wire.pack_hello_b(1, 3, 7, layout_version=5)
     assert b & 0xFF == 1
-    assert wire.unpack_shard_mismatch(-5 - (b - 1)) == (3, 7)
+    assert wire.unpack_shard_mismatch(-5 - (b - 1)) == (3, 7, 5)
 
 
 def test_permuted_host_list_fails_loudly():

@@ -169,7 +169,7 @@ class _FakeServer(threading.Thread):
 
 @pytest.mark.parametrize(
     "peer_status, blurb",
-    [(-2, "pre-v2 server answers unknown-op"), (3, "wrong version echoed")],
+    [(-2, "pre-v2 server answers unknown-op"), (999, "wrong version echoed")],
 )
 def test_bf16_rejects_mismatched_peer(peer_status, blurb):
     """A non-f32 encoding REQUIRES the negotiated version: a peer that
@@ -326,3 +326,33 @@ def test_perf_gate_flags_structural_regressions():
     assert any("if_newer" in f for f in fails), fails
     missing = {"detail": {"large_mb": 64.0}}
     assert perf_gate.gate(missing, base, tolerance=0.25, if_newer_ratio=20.0)
+
+
+def test_perf_gate_bounds_replicated_push_overhead():
+    """r12 gate mechanics: a replicated-push overhead past the bound (the
+    dedup mirror started moving payloads?) and a replicated-set collapse
+    are both flagged; a healthy replication row passes; a result that
+    DROPPED the rows against a baseline that has them is flagged too."""
+    import perf_gate
+
+    def rec(push_ov, set_ov):
+        return {"detail": {"large_mb": 64.0, "replicas": {
+            "1": {"set_mbs": 1000.0, "push_pop_mbs": 700.0},
+            "2": {"set_mbs": 1000.0 / set_ov, "push_pop_mbs": 700.0 / push_ov,
+                  "replicated_push_overhead": push_ov,
+                  "replicated_set_overhead": set_ov},
+        }}}
+
+    base = rec(1.1, 1.9)
+    kw = dict(tolerance=0.25, if_newer_ratio=20.0)
+    assert perf_gate.gate(rec(1.1, 1.9), base, **kw) == []
+    fails = perf_gate.gate(rec(2.4, 1.9), base, **kw)
+    assert any("replicated_push_overhead" in f for f in fails), fails
+    fails = perf_gate.gate(rec(1.1, 4.0), base, **kw)
+    assert any("replicated_set_overhead" in f for f in fails), fails
+    assert perf_gate.gate({"detail": {"large_mb": 64.0}}, base, **kw)
+    # Small-payload results (--quick) skip the bound — loopback RTTs
+    # dominate tiny payloads and the acceptance size is 64 MB.
+    quick = rec(2.4, 4.0)
+    quick["detail"]["large_mb"] = 8.0
+    assert perf_gate.gate(quick, base, **kw) == []

@@ -69,6 +69,14 @@ def fmt_transport(rec: dict, ok: str) -> str:
             f"    - batched_speedup={d['batched_speedup']} "
             "(micro-batching bound: >= 3.0 at max_batch=32)"
         )
+    repl = d.get("replicas")
+    if isinstance(repl, dict) and isinstance(repl.get("2"), dict):
+        lines.append(
+            "    - replicated_push_overhead="
+            f"{repl['2'].get('replicated_push_overhead')} "
+            "(replication bound: <= 1.6; set_overhead="
+            f"{repl['2'].get('replicated_set_overhead')})"
+        )
     return "\n".join(lines)
 
 

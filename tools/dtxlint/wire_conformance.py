@@ -50,6 +50,14 @@ _CONST_PAIRS = {
     "HELLO_SHARD_ID_SHIFT": "kHelloShardIdShift",
     "HELLO_SHARD_COUNT_SHIFT": "kHelloShardCountShift",
     "HELLO_SHARD_MASK": "kHelloShardMask",
+    # Replication surface (r12): layout-version + repl-flag bit positions
+    # and the divergence/refusal statuses must agree or a partitioned
+    # pair's loud failure decodes as garbage on one side.
+    "HELLO_LAYOUT_SHIFT": "kHelloLayoutShift",
+    "HELLO_LAYOUT_MASK": "kHelloLayoutMask",
+    "HELLO_REPL_SHIFT": "kHelloReplShift",
+    "REPL_REFUSED": "kReplRefused",
+    "REPL_DIVERGED": "kReplDiverged",
 }
 
 #: Registry-name prefixes per service, for the literal-restated check and
@@ -58,7 +66,7 @@ _CONST_PAIRS = {
 #: innocent constants like ``_ACCEPT_BACKLOG`` or ``_PING_INTERVAL_S``
 #: read as restated protocol numbers and fail the lint.
 _PS_NAME = re.compile(
-    r"^_?(?:(?:ACC|TQ|GQ|PSTORE)_\w+|CANCEL_ALL|PING|INCARNATION|HELLO)$"
+    r"^_?(?:(?:ACC|TQ|GQ|PSTORE|REPL)_\w+|CANCEL_ALL|PING|INCARNATION|HELLO)$"
 )
 _DSVC_NAME = re.compile(r"^DSVC_\w+$")
 _SRV_NAME = re.compile(r"^SRV_\w+$")

@@ -74,7 +74,7 @@ def _detail(rec: dict) -> dict:
 def gate(
     result: dict, baseline: dict, *, tolerance: float, if_newer_ratio: float,
     remote_local_ratio: float = 0.5, sharded_speedup: float = 1.3,
-    serving_speedup: float = 3.0,
+    serving_speedup: float = 3.0, replicated_overhead: float = 1.6,
 ) -> list[str]:
     """Returns a list of human-readable regression lines (empty = pass)."""
     res, base = _detail(result), _detail(baseline)
@@ -130,6 +130,42 @@ def gate(
         and not isinstance(shard_rows, dict)
     ):
         failures.append("shards: rows missing from result")
+    # The r12 replication acceptance bound, from the result alone: the
+    # replicated gradient push (the per-step hot path) mirrors its dedup
+    # tag HEADER-ONLY to the backup, so its overhead over the unreplicated
+    # push must stay under ``replicated_overhead`` (default 1.6 — one
+    # extra small round trip, never a second payload transfer).  The
+    # payload-carrying publish path (set) legitimately pays a second
+    # transfer; it gets a loose no-catastrophe tripwire (<= 2x the push
+    # bound) since loopback hosts cannot overlap the two streams.
+    repl_rows = res.get("replicas")
+    if (
+        isinstance(repl_rows, dict)
+        and isinstance(repl_rows.get("2"), dict)
+        and res.get("large_mb", 0.0) >= 64.0
+    ):
+        ov = repl_rows["2"].get("replicated_push_overhead")
+        if ov is not None and ov > replicated_overhead:
+            failures.append(
+                f"replicas.2.replicated_push_overhead: {ov:.2f} > "
+                f"{replicated_overhead} — the dedup mirror forwarding "
+                "payloads (or an extra blocking round trip) on the "
+                "gradient hot path?"
+            )
+        sov = repl_rows["2"].get("replicated_set_overhead")
+        if sov is not None and sov > 2 * replicated_overhead:
+            failures.append(
+                f"replicas.2.replicated_set_overhead: {sov:.2f} > "
+                f"{2 * replicated_overhead} — replicated publish worse "
+                "than a second full serialized transfer (forward no "
+                "longer streamed?)"
+            )
+    if (
+        isinstance(base.get("replicas"), dict)
+        and isinstance(base["replicas"].get("2"), dict)
+        and not isinstance(repl_rows, dict)
+    ):
+        failures.append("replicas: rows missing from result")
     # The disaggregation acceptance bound, from the result alone: remote
     # streaming within 1/ratio of the local in-process loader.  Applies in
     # the 1 MB+ batch regime the acceptance criterion names — per-batch
@@ -191,6 +227,10 @@ def main():
     ap.add_argument("--remote-local-ratio", type=float, default=0.5)
     ap.add_argument("--sharded-speedup", type=float, default=1.3)
     ap.add_argument("--serving-speedup", type=float, default=3.0)
+    ap.add_argument("--replicated-overhead", type=float, default=1.6,
+                    help="max replicated-push latency multiplier over the "
+                    "unreplicated push (r12: the dedup mirror is "
+                    "header-only, so ~1 extra small round trip)")
     args = ap.parse_args()
     with open(args.result) as f:
         result = json.load(f)
@@ -216,6 +256,7 @@ def main():
         remote_local_ratio=args.remote_local_ratio,
         sharded_speedup=args.sharded_speedup,
         serving_speedup=args.serving_speedup,
+        replicated_overhead=args.replicated_overhead,
     )
     if failures:
         print("PERF_GATE FAIL")

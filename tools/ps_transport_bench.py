@@ -168,6 +168,68 @@ def bench_shards(
     return rows
 
 
+def bench_replication(
+    host: str, *, counts: list[int], elems: int, reps: int, trials: int = 3,
+) -> dict:
+    """Replication axis (r12 tentpole measurement): the SAME publish/push
+    traffic against an unreplicated server (replicas=1) vs a local
+    primary/backup pair with forwarding on (replicas=2).  Publishes carry
+    their payload to the backup (streamed concurrently with the client
+    read); tagged gradient pushes mirror header-only.
+    ``replicated_set_overhead`` / ``replicated_push_overhead`` are the
+    latency multipliers over the replicas=1 row — ``tools/perf_gate.py``
+    bounds the PUSH overhead (<= 1.6x at 64 MB: the dedup mirror is one
+    extra header-sized round trip, never a payload) and gives the
+    payload-carrying set a no-catastrophe tripwire at 2x that bound.
+    Best-of-``trials``, like the shard axis."""
+    rows: dict = {}
+    mb = elems * 4 / 1e6
+    for n in counts:
+        ports = [ps_service.start_server(0) for _ in range(n)]
+        if n > 1:
+            ps_service.set_server_peer(ports[0], (host, ports[1]))
+            ps_service.set_server_peer(ports[1], (host, ports[0]))
+            ps_service.resync_server(ports[1], wait_s=10.0)
+        try:
+            c = ps_service.PSClient(
+                host, ports[0], timeout_s=120.0, worker_tag=1,
+                addrs=[(host, p) for p in ports] if n > 1 else None,
+            )
+            st = ps_service.RemoteParamStore(
+                c, "p_rep", elems, cache_pulls=False
+            )
+            flat = (np.arange(elems, dtype=np.float32) % 251) - 125.0
+            st.set(0, flat)
+            st.get()
+            gq = ps_service.RemoteGradientQueue(c, "g_rep", elems, capacity=4)
+
+            def push_pop():
+                gq.push(0, flat)
+                gq.pop()
+
+            push_pop()
+            row: dict = {"replicas": n, "set_mbs": 0.0, "push_pop_mbs": 0.0}
+            for _ in range(max(1, trials)):
+                dt = _time(lambda: st.set(1, flat), reps)
+                row["set_mbs"] = max(row["set_mbs"], reps * mb / dt)
+                dt = _time(push_pop, reps)
+                row["push_pop_mbs"] = max(row["push_pop_mbs"], reps * 2 * mb / dt)
+            rows[str(n)] = row
+            c.close()
+        finally:
+            for p in ports:
+                ps_service.stop_server(p)
+    base = rows.get("1")
+    if base:
+        for row in rows.values():
+            # Latency multipliers (>= ~1.0): baseline MB/s over this row's.
+            row["replicated_set_overhead"] = base["set_mbs"] / row["set_mbs"]
+            row["replicated_push_overhead"] = (
+                base["push_pop_mbs"] / row["push_pop_mbs"]
+            )
+    return rows
+
+
 def bench_concurrent_get(
     host: str, port: int, *, clients: int, elems: int, reps: int
 ) -> dict:
@@ -240,6 +302,12 @@ def run(args) -> dict:
         "127.0.0.1", counts=getattr(args, "shards_axis", [1, 2]),
         elems=large_elems, reps=args.reps_large,
     )
+    # Replication axis (r12): unreplicated vs forwarded primary/backup
+    # pair, same traffic — fresh servers per row like the shard axis.
+    detail["replicas"] = bench_replication(
+        "127.0.0.1", counts=getattr(args, "replicas_axis", [1, 2]),
+        elems=large_elems, reps=args.reps_large,
+    )
     return detail
 
 
@@ -256,6 +324,9 @@ def main():
     ap.add_argument("--shards", default="1,2,4",
                     help="shard-scaling axis: local shard-server counts "
                     "(same total bytes per row)")
+    ap.add_argument("--replicas", default="1,2",
+                    help="replication axis (r12): 1 = unreplicated, 2 = "
+                    "forwarded primary/backup pair, same traffic")
     ap.add_argument("--quick", action="store_true",
                     help="CI-sized: 8 MB large payload, 2 clients, few reps")
     ap.add_argument("--json", default="", help="also write the record here")
@@ -267,6 +338,7 @@ def main():
         args.reps_small = min(args.reps_small, 50)
     args.dtypes = [d for d in args.dtypes.split(",") if d]
     args.shards_axis = [int(s) for s in args.shards.split(",") if s]
+    args.replicas_axis = [int(s) for s in args.replicas.split(",") if s]
 
     detail = run(args)
     headline = detail[args.dtypes[0]]["set_get_mbs_large"]
