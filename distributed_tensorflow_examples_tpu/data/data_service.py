@@ -71,7 +71,7 @@ from typing import Callable, Iterator, Sequence
 import numpy as np
 
 from ..parallel import wire
-from ..utils import faults
+from ..utils import faults, telemetry
 from . import filestream
 
 log = logging.getLogger("dtx.data_service")
@@ -212,6 +212,8 @@ class DataServiceServer:
         self._requests = 0
         self._batches_served = 0
         self._splits_completed = 0
+        self._assigned_total = 0  # assignments handed out (r13 dtxobs)
+        self._acks = 0  # split completions acknowledged (r13 dtxobs)
         self._reassigned = 0
         self._epochs_completed = 0
         self._last_epoch_min_visits = 0
@@ -346,6 +348,7 @@ class DataServiceServer:
         self._completed.add(split)
         self._visits[split] = max(self._visits[split], 1)
         self._splits_completed += 1
+        self._acks += 1
         self._maybe_roll_locked()
 
     def _maybe_roll_locked(self) -> None:
@@ -365,6 +368,7 @@ class DataServiceServer:
         self._assigned[split] = (worker, time.monotonic())
         self._worker_split[worker] = split
         self._visits[split] += 1
+        self._assigned_total += 1
 
     def _handle_get_split(
         self, worker: int, ack: int, client_epoch: int | None, strict: bool
@@ -426,7 +430,9 @@ class DataServiceServer:
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
+                "service": "dsvc",
+                "role": faults.current_role(),
                 "incarnation": self._incarnation,
                 "epoch": self._epoch,
                 "num_splits": len(self._splits),
@@ -436,11 +442,19 @@ class DataServiceServer:
                 "registered_workers": len(self._registered),
                 "batches_served": self._batches_served,
                 "splits_completed": self._splits_completed,
+                "assigned_total": self._assigned_total,
+                "acks": self._acks,
                 "reassigned": self._reassigned,
                 "epochs_completed": self._epochs_completed,
                 "last_epoch_min_visits": self._last_epoch_min_visits,
                 "requests": self._requests,
             }
+        # Process-wide registry + flight-recorder depth ride along (r13):
+        # one STATS scrape reads the server's dispatcher counters AND the
+        # host process's client-side instruments in one round trip.
+        out["registry"] = telemetry.snapshot()
+        out["flight_events"] = len(telemetry.RECORDER)
+        return out
 
     # -- connection handling -------------------------------------------------
 
@@ -478,11 +492,21 @@ class DataServiceServer:
                         view = memoryview(sink)[: min(left, len(sink))]
                         wire.recv_exact(conn, view)
                         left -= len(view)
-                with self._lock:
-                    # Under the lock like all dispatcher state: a lost
-                    # increment would make die:after_reqs fault triggers
-                    # load-dependent.
-                    self._requests += 1
+                # Handshake/observability ops — and the scraper's
+                # metadata-only REGISTER probe (negative worker id) — are
+                # excluded (r13): ``request_count`` is the die:after_reqs
+                # fault trigger, and a dtxtop poll loop (HELLO + REGISTER
+                # probe + STATS per refresh) must not perturb when a
+                # chaos run's injected kills fire.
+                counted = op not in (DSVC_HELLO, DSVC_STATS) and not (
+                    op == DSVC_REGISTER and a < 0
+                )
+                if counted:
+                    with self._lock:
+                        # Under the lock like all dispatcher state: a lost
+                        # increment would make die:after_reqs fault
+                        # triggers load-dependent.
+                        self._requests += 1
                 try:
                     self._handle(conn, op, name, a, b)
                 except (OSError, ConnectionError):
@@ -755,6 +779,7 @@ class DataServiceClient:
                     "reconnect_gave_up", role=self.role, host=self._host,
                     port=self._port, attempts=attempt,
                 )
+                telemetry.dump_flight_recorder("reconnect_gave_up")
                 raise DSVCDeadlineError(
                     f"data service at {self._host}:{self._port} unreachable "
                     f"for {self._reconnect_deadline:.0f}s ({attempt} attempts)"

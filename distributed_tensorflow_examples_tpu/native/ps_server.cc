@@ -46,6 +46,7 @@
 #include <atomic>
 #include <chrono>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -164,6 +165,13 @@ enum Op : uint8_t {
   // lost everywhere, reseed").
   REPL_SYNC = 28,
   REPL_TOKEN = 29,
+  // Observability (r13 dtxobs).  STATS: the server's whole counter table
+  // (identity, incarnation/state token, requests, live connections,
+  // replication forward/sync/mirror counters, summed dedup/dropped
+  // counters) answered as one raw JSON blob.  The payload is counted in
+  // 4-byte units and NEVER dtype-encoded (like the REPL_SYNC state blob),
+  // so a bf16 connection scrapes the same bytes as an f32 one.
+  STATS = 30,
 };
 
 // v3 (r12): HELLO b-word field relayout — see wire.py WIRE_VERSION.
@@ -290,6 +298,17 @@ struct Server {
   // Requests served (all connections).  Deterministic per protocol op
   // sequence — the fault layer's "kill PS at request N" trigger.
   std::atomic<int64_t> requests{0};
+  // Observability counters (r13 dtxobs), exported by the STATS op in one
+  // table next to the pre-existing requests/incarnation/dedup counters.
+  // Replication forwards by outcome (delivered / peer dead / refused-by-
+  // policy), REPL_SYNC state blobs served to a (re)starting peer — the
+  // externally visible "my peer failed over through me / caught back up
+  // from me" evidence — and payload-less dedup mirrors applied.
+  std::atomic<int64_t> fwd_ok{0};
+  std::atomic<int64_t> fwd_peer_down{0};
+  std::atomic<int64_t> fwd_refused{0};
+  std::atomic<int64_t> repl_syncs_served{0};
+  std::atomic<int64_t> mirror_applies{0};
   std::atomic<bool> stopping{false};
   std::thread accept_thread;
   // Live connection fds: stop() shuts them down so blocked readers exit
@@ -530,18 +549,31 @@ int read_fwd_ack(Server* s) {
   return FWD_OK;  // mirror results (duplicate/stale) are fine — delivered
 }
 
+// Observability (r13): count one forward attempt's outcome into the
+// exported replication counters (STATS).
+void count_fwd(Server* s, int r) {
+  if (r == FWD_OK)
+    s->fwd_ok.fetch_add(1, std::memory_order_relaxed);
+  else if (r == FWD_PEER_DOWN)
+    s->fwd_peer_down.fetch_add(1, std::memory_order_relaxed);
+  else if (r == FWD_REFUSED)
+    s->fwd_refused.fetch_add(1, std::memory_order_relaxed);
+}
+
 // Forward one op (optionally with an f32 payload) to the peer and await
 // its ack.  The forward link always speaks f32.
 int forward_op(Server* s, uint8_t op, const std::string& name, int64_t a,
                int64_t b, const float* data, uint32_t plen) {
   if (s->partitioned.load()) {
     s->diverged.store(true);
+    count_fwd(s, FWD_REFUSED);
     return FWD_REFUSED;
   }
   std::lock_guard<std::mutex> lock(s->fwd_mu);
   int r = ensure_fwd(s);
   if (r != FWD_OK) {
     if (r == FWD_REFUSED) s->diverged.store(true);
+    count_fwd(s, r);
     return r;
   }
   std::vector<uint8_t> hdr(2 + name.size() + 20);
@@ -554,10 +586,12 @@ int forward_op(Server* s, uint8_t op, const std::string& name, int64_t a,
   if (!write_n(s->fwd_fd, hdr.data(), hdr.size()) ||
       (plen && !write_n(s->fwd_fd, data, static_cast<size_t>(plen) * 4))) {
     sever_fwd_locked(s);
+    count_fwd(s, FWD_PEER_DOWN);
     return FWD_PEER_DOWN;
   }
   r = read_fwd_ack(s);
   if (r == FWD_REFUSED) s->diverged.store(true);
+  count_fwd(s, r);
   return r;
 }
 
@@ -749,6 +783,62 @@ bool sync_from_peer(Server* s, int64_t budget_ms) {
   }
 }
 
+// --- STATS counter table (r13 dtxobs) --------------------------------------
+// The server's whole exported state as one JSON object: identity,
+// incarnation/state token, request/connection counts, the replication
+// counters above, and the per-object dedup/dropped counters SUMMED (the
+// pre-r13 counters reachable only object-by-object, folded into one
+// table).  All fields are numeric except the service tag, so no JSON
+// string escaping is ever needed.
+std::string build_stats_json(Server* s) {
+  int64_t acc_ded = 0, acc_drop = 0, gq_ded = 0, gq_drop = 0;
+  size_t n_obj = 0;
+  {
+    std::lock_guard<std::mutex> lock(s->mu);
+    n_obj = s->objects.size();
+    for (const auto& kv : s->objects) {
+      if (kv.second.kind == 'a') {
+        acc_ded += acc_deduped(kv.second.handle);
+        acc_drop += acc_dropped(kv.second.handle);
+      } else if (kv.second.kind == 'g') {
+        gq_ded += gq_deduped(kv.second.handle);
+        gq_drop += gq_dropped(kv.second.handle);
+      }
+    }
+  }
+  char buf[1024];
+  int n = std::snprintf(
+      buf, sizeof(buf),
+      "{\"service\":\"ps\",\"shard_id\":%d,\"shard_count\":%d,"
+      "\"layout_version\":%lld,\"incarnation\":%lld,\"state_token\":%lld,"
+      "\"requests\":%lld,\"live_conns\":%d,\"objects\":%lld,"
+      "\"replicated\":%d,\"partitioned\":%d,\"diverged\":%d,"
+      "\"fwd_ok\":%lld,\"fwd_peer_down\":%lld,\"fwd_refused\":%lld,"
+      "\"repl_syncs_served\":%lld,\"mirror_applies\":%lld,"
+      "\"acc_deduped\":%lld,\"acc_dropped\":%lld,"
+      "\"gq_deduped\":%lld,\"gq_dropped\":%lld}",
+      s->shard_id, s->shard_count,
+      static_cast<long long>(s->layout_version),
+      static_cast<long long>(s->incarnation),
+      static_cast<long long>(s->state_token.load()),
+      static_cast<long long>(s->requests.load(std::memory_order_relaxed)),
+      s->live_conns.load(), static_cast<long long>(n_obj),
+      s->peer_port > 0 ? 1 : 0, s->partitioned.load() ? 1 : 0,
+      s->diverged.load() ? 1 : 0,
+      static_cast<long long>(s->fwd_ok.load(std::memory_order_relaxed)),
+      static_cast<long long>(
+          s->fwd_peer_down.load(std::memory_order_relaxed)),
+      static_cast<long long>(s->fwd_refused.load(std::memory_order_relaxed)),
+      static_cast<long long>(
+          s->repl_syncs_served.load(std::memory_order_relaxed)),
+      static_cast<long long>(
+          s->mirror_applies.load(std::memory_order_relaxed)),
+      static_cast<long long>(acc_ded), static_cast<long long>(acc_drop),
+      static_cast<long long>(gq_ded), static_cast<long long>(gq_drop));
+  if (n < 0 || n >= static_cast<int>(sizeof(buf))) return "{}";
+  return std::string(buf, static_cast<size_t>(n));
+}
+
 // State-mutating ops a replicated server forwards to its peer (param-store
 // sets with payload; tagged apply/push as payload-less dedup mirrors; the
 // rest verbatim) — and refuses with kReplDiverged once the link is down by
@@ -794,7 +884,24 @@ void serve_conn_impl(Server* s, int fd) {
     // mismatched payloads are drained (framing intact) and answered -2.
     // ``payload_obj`` is reused by the dispatch below (one lookup, one
     // mutex acquisition per request on the gradient-push hot path).
-    s->requests.fetch_add(1, std::memory_order_relaxed);
+    //
+    // Handshake/identity/observability ops are EXCLUDED from the request
+    // counter (r13): ``requests`` is the fault layer's deterministic
+    // "kill at request N" trigger AND an exported metric, and these four
+    // ops are functions of connection management and scrape cadence —
+    // every dtxtop refresh dials a fresh client (HELLO + INCARNATION +
+    // STATS), every reconnect probes identity — not of training
+    // progress.  Observation (and re-dialing) must not perturb the
+    // observed trigger; state/service traffic alone advances it.
+    switch (op) {
+      case HELLO:
+      case INCARNATION:
+      case REPL_TOKEN:
+      case STATS:
+        break;
+      default:
+        s->requests.fetch_add(1, std::memory_order_relaxed);
+    }
     // Partition (r12): an ALREADY-ESTABLISHED repl connection must go
     // dark too — every op on it is refused by policy, so the forwarding
     // side observes kReplRefused on its next mutate and latches
@@ -816,8 +923,21 @@ void serve_conn_impl(Server* s, int fd) {
                                          b & kTagSeqMask)
                      : gq_mirror_tagged(o->handle, a, b >> kTagWorkerShift,
                                         b & kTagSeqMask);
+        s->mirror_applies.fetch_add(1, std::memory_order_relaxed);
       }
       if (!write_frame(fd, status, 0, nullptr, 0)) break;
+      continue;
+    }
+    // Observability scrape (r13): answered early, like REPL_SYNC — the
+    // response is a raw JSON blob (4-byte units, padded with spaces)
+    // that must bypass the dtype-encoded epilogue on every connection.
+    if (op == STATS) {
+      if (plen && !drain_n(fd, static_cast<size_t>(plen) * esize)) break;
+      std::string js = build_stats_json(s);
+      js.resize((js.size() + 3) & ~size_t{3}, ' ');
+      if (!write_frame(fd, 0, static_cast<uint32_t>(js.size() / 4),
+                       js.data(), js.size()))
+        break;
       continue;
     }
     size_t expected = 0;
@@ -865,6 +985,7 @@ void serve_conn_impl(Server* s, int fd) {
         // later attempt read "peer down").
         s->diverged.store(true);
         ensure_refused = true;
+        count_fwd(s, FWD_REFUSED);
       }
       if (er == FWD_OK) {
         // fwd_mu is held across the CLIENT payload read below (that is
@@ -909,6 +1030,7 @@ void serve_conn_impl(Server* s, int fd) {
           sever_fwd_locked(s);
           fwd_result = FWD_PEER_DOWN;
         }
+        count_fwd(s, fwd_result);
         if (fwd_result != FWD_REFUSED)
           pstore_set(payload_obj->handle, a, payload.data());
         if (!write_frame(fd, fwd_result == FWD_REFUSED ? kReplDiverged : 0, 0,
@@ -966,6 +1088,7 @@ void serve_conn_impl(Server* s, int fd) {
       }
       std::vector<uint8_t> blob = build_state_blob(s);
       blob.resize((blob.size() + 3) & ~size_t{3});  // pad to 4-byte units
+      s->repl_syncs_served.fetch_add(1, std::memory_order_relaxed);
       int64_t n_obj;
       {
         std::lock_guard<std::mutex> lock(s->mu);
@@ -1041,6 +1164,10 @@ void serve_conn_impl(Server* s, int fd) {
         // blob, not the typed epilogue below); the label pins the op in
         // the dispatch table so the wire-conformance lint can prove no
         // client-sendable op silently falls through to -2.
+        break;
+      case STATS:
+        // Dispatched BEFORE this switch too (raw JSON blob, bypassing
+        // the dtype-encoded epilogue); label pinned for the same lint.
         break;
       case CANCEL_ALL:
         cancel_all(s);

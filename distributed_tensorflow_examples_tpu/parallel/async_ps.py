@@ -46,7 +46,7 @@ import jax.numpy as jnp
 import optax
 
 from .. import native
-from ..utils import faults
+from ..utils import faults, telemetry
 
 log = logging.getLogger("dtx.async_ps")
 
@@ -613,6 +613,7 @@ class RemotePSChief(AsyncPSTrainer):
         state-token check short-circuits the callback otherwise), so the
         ``reseeds`` counter stays 0 across any single-replica incident."""
         self.reseeds += 1
+        telemetry.REGISTRY.inc("ps_chief/reseeds")
         faults.log_event(
             "chief_reseed", step=self.global_step, mode=self.cfg.mode,
             shard=shard,

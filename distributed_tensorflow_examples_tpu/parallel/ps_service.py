@@ -70,6 +70,7 @@ gather's zero-staging path.
 
 from __future__ import annotations
 
+import json
 import socket
 import struct
 import threading
@@ -78,7 +79,7 @@ import time
 import numpy as np
 
 from .. import native
-from ..utils import faults
+from ..utils import faults, telemetry
 from . import wire
 
 # Op codes — aliases into the ONE registry (wire.PS_OPS, the single Python
@@ -113,6 +114,22 @@ _HELLO = wire.PS_OPS["HELLO"]
 _PSTORE_GET_IF_NEWER = wire.PS_OPS["PSTORE_GET_IF_NEWER"]
 _REPL_SYNC = wire.PS_OPS["REPL_SYNC"]
 _REPL_TOKEN = wire.PS_OPS["REPL_TOKEN"]
+_STATS = wire.PS_OPS["STATS"]
+
+# Client-side observability (r13 dtxobs): every PSClient in the process
+# accumulates into these process-wide instruments — cached handles, so the
+# per-op cost is one lock + an int add (the `ps_client/*` family the STATS
+# scrapes of Python services, and tests, read via telemetry.snapshot()).
+_OBS_OPS = telemetry.REGISTRY.counter("ps_client/ops")
+_OBS_ERRS = telemetry.REGISTRY.counter("ps_client/op_errors")
+_OBS_TX = telemetry.REGISTRY.counter("ps_client/bytes_tx")
+_OBS_RX = telemetry.REGISTRY.counter("ps_client/bytes_rx")
+_OBS_OP_MS = telemetry.REGISTRY.histogram("ps_client/op_ms")
+_OBS_RECONNECTS = telemetry.REGISTRY.counter("ps_client/reconnects")
+_OBS_CONN_LOST = telemetry.REGISTRY.counter("ps_client/conn_lost")
+_OBS_REBUILDS = telemetry.REGISTRY.counter("ps_client/state_rebuilds")
+_OBS_FAILOVERS = telemetry.REGISTRY.counter("ps_client/failovers")
+_OBS_PULL_HITS = telemetry.REGISTRY.counter("ps_client/pull_cache_hits")
 
 #: Wire protocol version this client speaks (ps_server.cc kWireVersion).
 WIRE_VERSION = wire.WIRE_VERSION
@@ -548,7 +565,29 @@ class PSClient:
     def _attempt(
         self, op: int, name: str = "", a: int = 0, b: int = 0,
         payload: np.ndarray | None = None, *, deadline_s: float | None = None,
-        out: np.ndarray | None = None,
+        out: np.ndarray | None = None, raw: bool = False,
+    ) -> tuple[int, np.ndarray]:
+        """One instrumented send/recv round trip (r13: per-op wall time and
+        success/error counts land in the process ``ps_client/*`` telemetry
+        family — one lock+add per op against cached instruments, cheap
+        next to the socket round trip itself).  See ``_attempt_io``."""
+        t0 = time.perf_counter()
+        try:
+            ret = self._attempt_io(
+                op, name, a, b, payload, deadline_s=deadline_s, out=out,
+                raw=raw,
+            )
+        except OSError:
+            _OBS_ERRS.inc()
+            raise
+        _OBS_OPS.inc()
+        _OBS_OP_MS.observe((time.perf_counter() - t0) * 1e3)
+        return ret
+
+    def _attempt_io(
+        self, op: int, name: str = "", a: int = 0, b: int = 0,
+        payload: np.ndarray | None = None, *, deadline_s: float | None = None,
+        out: np.ndarray | None = None, raw: bool = False,
     ) -> tuple[int, np.ndarray]:
         """One send/recv round trip; severs the socket on ANY failure (the
         framing is broken mid-stream, so the connection is unusable).
@@ -558,7 +597,9 @@ class PSClient:
         sharded gather's zero-staging path: each shard's slice of one
         output buffer); any other length falls back to a fresh array, so
         status-only answers (e.g. an unchanged-step pull) never clobber
-        or misreport the caller's buffer."""
+        or misreport the caller's buffer.  ``raw``: the response payload is
+        an UN-encoded byte blob counted in 4-byte units (STATS/REPL_SYNC
+        shape) — returned as ``bytes``, never dtype-decoded."""
         if self._sock is None:
             raise ConnectionError("not connected")
         header = wire.pack_request(
@@ -567,15 +608,34 @@ class PSClient:
         try:
             self._sock.settimeout(deadline_s)
             self._send_frame(header, payload)
+            _OBS_TX.inc(
+                len(header) + (0 if payload is None else payload.nbytes)
+            )
             hdr = memoryview(self._hdr)
             self._recv_exact(hdr)
             status, plen = struct.unpack("<qI", self._hdr)
+            _OBS_RX.inc(
+                12 + plen * (4 if raw else (2 if self._wire_code == 1 else 4))
+            )
+            if raw:
+                blob = bytearray(plen * 4)
+                if plen:
+                    self._recv_exact(memoryview(blob))
+                return status, bytes(blob)
             if status == wire.REPL_DIVERGED:
                 # The replica refuses to accept a write it can no longer
                 # replicate (its peer is alive but the link is down by
                 # policy) — a PERMANENT loud failure, never retried: a
                 # silent split-brain would diverge the two replicas'
-                # state under every client that kept writing.
+                # state under every client that kept writing.  Fatal for
+                # the run, so the flight recorder dumps NOW: the events
+                # leading here (partitions, drops, failovers) are the
+                # post-mortem (r13 dtxobs).
+                faults.log_event(
+                    "repl_diverged", role=self.role, host=self._host,
+                    port=self._port, op_code=op,
+                )
+                telemetry.dump_flight_recorder("repl_diverged")
                 raise PSError(
                     f"replication diverged: the PS at {self._host}:"
                     f"{self._port} refuses state-mutating ops because its "
@@ -661,6 +721,9 @@ class PSClient:
                     "reconnect_gave_up", role=self.role, host=self._host,
                     port=self._port, attempts=attempt,
                 )
+                # Budget exhausted = fatal for this client's caller: dump
+                # the flight recorder so the outage window is attributable.
+                telemetry.dump_flight_recorder("reconnect_gave_up")
                 raise PSDeadlineError(
                     f"PS at {self._host}:{self._port} unreachable for "
                     f"{self._reconnect_deadline:.0f}s ({attempt} attempts)"
@@ -710,6 +773,7 @@ class PSClient:
         prev = self._incarnations.get(self._cur)
         changed = prev is not None and inc != prev
         self._incarnations[self._cur] = inc
+        _OBS_RECONNECTS.inc()
         faults.log_event(
             "reconnected", role=self.role, attempts=attempts,
             incarnation_changed=changed, replica=self._cur,
@@ -723,6 +787,7 @@ class PSClient:
                 # survivor) or by failing over to its peer.  Nothing to
                 # rebuild, nothing to reseed: the zero-stall path.
                 if changed or self._cur != 0:
+                    _OBS_FAILOVERS.inc()
                     faults.log_event(
                         "replica_state_intact", role=self.role,
                         replica=self._cur, incarnation_changed=changed,
@@ -757,6 +822,7 @@ class PSClient:
         finally:
             self._in_recovery = False
         self._state_token = token
+        _OBS_REBUILDS.inc()
         faults.log_event(
             "state_rebuilt", role=self.role, objects=len(self._ensures),
             callbacks=len(self._callbacks),
@@ -768,7 +834,7 @@ class PSClient:
         self, op: int, name: str = "", a: int = 0, b: int = 0,
         payload: np.ndarray | None = None, *, replay_safe: bool = True,
         server_wait_s: float = 0.0, fault_point: bool = True,
-        out: np.ndarray | None = None,
+        out: np.ndarray | None = None, raw: bool = False,
     ) -> tuple[int, np.ndarray]:
         """One request/response; recovers + replays on transport failure
         when recovery is enabled and the op is ``replay_safe`` (idempotent
@@ -800,7 +866,7 @@ class PSClient:
                     try:
                         return self._attempt(
                             op, name, a, b, wire_payload, deadline_s=deadline,
-                            out=out,
+                            out=out, raw=raw,
                         )
                     except OSError as e:
                         if self._in_recovery or self._reconnect_deadline <= 0:
@@ -810,6 +876,7 @@ class PSClient:
                                 f"PS op {op} not replay-safe; connection lost "
                                 f"mid-op: {e!r}"
                             ) from e
+                        _OBS_CONN_LOST.inc()
                         faults.log_event(
                             "conn_lost", role=self.role, op_code=op,
                             error=type(e).__name__,
@@ -875,6 +942,21 @@ class PSClient:
     def incarnation(self) -> int:
         status, _ = self.call(_INCARNATION)
         return status
+
+    def stats(self) -> dict:
+        """The server's whole counter table (r13 STATS): identity,
+        incarnation/state token, request/connection counts, replication
+        forward/sync/mirror counters and summed dedup/dropped counters —
+        one JSON object per scrape, dtype-independent (the blob is raw
+        bytes in 4-byte units, space-padded).  A pre-r13 server answers
+        -2: surfaced as a loud PSError, never decoded as garbage."""
+        status, blob = self.call(_STATS, raw=True)
+        if status < 0 or not blob:
+            raise PSError(
+                f"PS at {self._host}:{self._port} does not answer STATS "
+                f"(status {status}; pre-r13 server?)"
+            )
+        return json.loads(bytes(blob).decode())
 
     def cancel_all(self) -> None:
         self.call(_CANCEL_ALL)
@@ -1119,6 +1201,7 @@ class RemoteParamStore:
             # matching an empty store's step) — only a LIVE cache
             # satisfies the unchanged-step fast path.
             if s == self._cache_step and self._cache is not None:
+                _OBS_PULL_HITS.inc()
                 return s, self._cache
             if s < 0:
                 # Never published: status-only, payload deliberately empty

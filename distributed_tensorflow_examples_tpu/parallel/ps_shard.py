@@ -62,7 +62,20 @@ import time
 
 import numpy as np
 
+from ..utils import telemetry
 from . import ps_service
+
+# Sharded-store observability (r13 dtxobs): whole-gather/scatter counters
+# and wall-time histograms for the `ps_shard/*` family (per-shard wall
+# times stay on ``last_pull_ms``/``last_push_ms`` for the TensorBoard
+# scalars; these instruments are the cross-process STATS/dtxtop view).
+_OBS_PULLS = telemetry.REGISTRY.counter("ps_shard/pulls")
+_OBS_PULL_HITS = telemetry.REGISTRY.counter("ps_shard/pull_cache_hits")
+_OBS_PULL_MS = telemetry.REGISTRY.histogram("ps_shard/pull_ms")
+_OBS_PUSHES = telemetry.REGISTRY.counter("ps_shard/pushes")
+_OBS_PUSH_MS = telemetry.REGISTRY.histogram("ps_shard/push_ms")
+_OBS_SCATTERS = telemetry.REGISTRY.counter("ps_shard/grad_scatters")
+_OBS_GATHERS = telemetry.REGISTRY.counter("ps_shard/grad_gathers")
 
 __all__ = [
     "ShardLayout",
@@ -472,6 +485,8 @@ class ShardedParamStore:
             t0 = time.perf_counter()
             self._single.set(step, flat)
             self.last_push_ms[0] = (time.perf_counter() - t0) * 1e3
+            _OBS_PUSHES.inc()
+            _OBS_PUSH_MS.observe(self.last_push_ms[0])
             return
         flat = np.ascontiguousarray(flat, np.float32).reshape(-1)
         if flat.size != self._layout.num_elems:
@@ -489,7 +504,10 @@ class ShardedParamStore:
             self.last_push_ms[i] = (time.perf_counter() - t0) * 1e3
             return ps_service._check(s, "pstore_set")
 
+        t_all = time.perf_counter()
         self._pool.run({i: (lambda i=i: one(i)) for i in self._active})
+        _OBS_PUSHES.inc()
+        _OBS_PUSH_MS.observe((time.perf_counter() - t_all) * 1e3)
 
     # -- pull (gather) ------------------------------------------------------
 
@@ -525,9 +543,16 @@ class ShardedParamStore:
             t0 = time.perf_counter()
             out = self._single.get()
             self.last_pull_ms[0] = (time.perf_counter() - t0) * 1e3
+            _OBS_PULLS.inc()
+            _OBS_PULL_MS.observe(self.last_pull_ms[0])
             return out
         if not self._cache_enabled:
-            return self._gather_full()
+            t0 = time.perf_counter()
+            out = self._gather_full()
+            _OBS_PULLS.inc()
+            _OBS_PULL_MS.observe((time.perf_counter() - t0) * 1e3)
+            return out
+        t_all = time.perf_counter()
         have = list(self._steps) if self._front is not None else [-1] * self._layout.num_shards
         buf = np.empty(self._layout.num_elems, np.float32)
 
@@ -576,6 +601,9 @@ class ShardedParamStore:
         if not changed:
             # All shards unchanged: N header-sized round trips, zero data
             # movement — the sharded analog of the r7 if-newer fast path.
+            _OBS_PULLS.inc()
+            _OBS_PULL_HITS.inc()
+            _OBS_PULL_MS.observe((time.perf_counter() - t_all) * 1e3)
             return min(statuses.values()), self._front
         if len(changed) < len(self._active) and self._front is not None:
             # Mixed: the unchanged shards' bytes live in the previous
@@ -586,6 +614,8 @@ class ShardedParamStore:
         for i, s in statuses.items():
             self._steps[i] = int(s)
         self._front = buf
+        _OBS_PULLS.inc()
+        _OBS_PULL_MS.observe((time.perf_counter() - t_all) * 1e3)
         return min(statuses.values()), buf
 
 
@@ -621,6 +651,7 @@ class ShardedAccumulator:
 
     def apply(self, local_step: int, grad: np.ndarray) -> bool:
         grad = np.ascontiguousarray(grad, np.float32).reshape(-1)
+        _OBS_SCATTERS.inc()
         if self._layout.num_shards == 1:
             t0 = time.perf_counter()
             r = self._accs[0].apply(local_step, grad)
@@ -664,6 +695,7 @@ class ShardedAccumulator:
         for i in self._active:
             out[self._layout.slice(i)] = self._partial[i]
         self._partial.clear()
+        _OBS_GATHERS.inc()
         return out
 
     def set_global_step(self, step: int) -> None:
@@ -724,6 +756,7 @@ class ShardedGradientQueue:
 
     def push(self, local_step: int, grad: np.ndarray) -> bool | None:
         grad = np.ascontiguousarray(grad, np.float32).reshape(-1)
+        _OBS_SCATTERS.inc()
         if self._layout.num_shards == 1:
             t0 = time.perf_counter()
             r = self._gqs[0].push(local_step, grad)
@@ -770,6 +803,7 @@ class ShardedGradientQueue:
         # mixing the per-shard steps can legitimately differ).
         step = self._partial[self._active[0]][0]
         self._partial.clear()
+        _OBS_GATHERS.inc()
         return step, out
 
     def set_min_step(self, step: int) -> None:

@@ -109,6 +109,15 @@ PS_OPS: dict[str, int] = {
     # client falls back to incarnation-only semantics.
     "REPL_SYNC": 28,
     "REPL_TOKEN": 29,
+    # Observability (r13 dtxobs).  STATS: answers the server's whole
+    # counter table — shard identity, incarnation/state token, request and
+    # connection counts, replication forward/sync/mirror counters, summed
+    # dedup/dropped counters — as one raw JSON blob (payload counted in
+    # 4-byte units like REPL_SYNC, NEVER dtype-encoded), so one scraper
+    # (tools/dtxtop.py) reads a live cluster with zero side channels.
+    # All three services carry a STATS op; code points stay disjoint so a
+    # mis-wired scrape is refused, never misread.
+    "STATS": 30,
 }
 
 #: Data-service op codes (data/data_service.py).  Disjoint from the PS

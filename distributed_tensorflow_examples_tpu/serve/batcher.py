@@ -27,6 +27,8 @@ import queue
 import threading
 import time
 
+from ..utils import telemetry
+
 
 class Overloaded(RuntimeError):
     """Admission control refused the request: the replica's queue is full.
@@ -100,6 +102,13 @@ class DynamicBatcher:
         self.flush_full = 0
         self.flush_timeout = 0
         self.last_batch_rows = 0
+        # Observability histograms (r13 dtxobs): in-system depth sampled at
+        # every admit, and rows per flushed batch — the coalescing-quality
+        # signals ``stats()`` flattens next to the counters (and the serve
+        # STATS scrape ships to dtxtop).  Instance-owned, not registry
+        # entries: two batchers in one process must not share a ring.
+        self.queue_depth_hist = telemetry.Histogram(f"{name}/queue_depth")
+        self.batch_rows_hist = telemetry.Histogram(f"{name}/batch_rows")
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=f"dtx-{name}-batcher"
         )
@@ -128,6 +137,7 @@ class DynamicBatcher:
                 )
             self._inflight += 1
             self.requests += 1
+            self.queue_depth_hist.observe(self._inflight)
             # Enqueue under the SAME lock that stop() takes to set
             # _stopped: a ticket that passed the check above is therefore
             # queued before the stop sentinel, so the drain loop always
@@ -139,7 +149,7 @@ class DynamicBatcher:
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "requests": self.requests,
                 "overloads": self.overloads,
                 "batches": self.batches,
@@ -151,6 +161,11 @@ class DynamicBatcher:
                 "max_batch": self.max_batch,
                 "queue_depth": self.queue_depth,
             }
+        for k, v in self.queue_depth_hist.snapshot().items():
+            out[f"queue_depth_{k}"] = v
+        for k, v in self.batch_rows_hist.snapshot().items():
+            out[f"batch_rows_{k}"] = v
+        return out
 
     def stop(self) -> None:
         """Stop the batch thread; pending submitters see RuntimeError."""
@@ -224,6 +239,7 @@ class DynamicBatcher:
                 for t, r in zip(batch, results):
                     t._resolve(value=r)
             nrows = sum(t.rows for t in batch)
+            self.batch_rows_hist.observe(nrows)
             with self._lock:
                 self._inflight -= len(batch)
                 self.batches += 1

@@ -30,7 +30,7 @@ import time
 import numpy as np
 
 from ..parallel import wire
-from ..utils import faults
+from ..utils import faults, telemetry
 from .model_server import NO_MODEL, OVERLOAD, SRV_PREDICT, SRV_SHUTDOWN, SRV_STATS
 
 
@@ -161,6 +161,7 @@ class ServeClient:
                     "reconnect_gave_up", role=self.role, host=self._host,
                     port=self._port, attempts=attempt,
                 )
+                telemetry.dump_flight_recorder("reconnect_gave_up")
                 raise ServeDeadlineError(
                     f"model replica at {self._host}:{self._port} unreachable "
                     f"for {self._reconnect_deadline:.0f}s ({attempt} attempts)"

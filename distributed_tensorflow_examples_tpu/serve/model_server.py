@@ -51,7 +51,7 @@ import time
 import numpy as np
 
 from ..parallel import ps_shard, wire
-from ..utils import faults
+from ..utils import faults, telemetry
 from ..utils.metrics import LatencyRecorder, MetricsWriter
 from . import batcher as batcher_lib
 
@@ -315,6 +315,7 @@ class ModelReplicaServer:
         with self._lock:
             s = {
                 "service": SERVICE,
+                "role": self.role,
                 "incarnation": self._incarnation,
                 "model_step": self.model_step,
                 "requests": self._requests,
@@ -326,6 +327,11 @@ class ModelReplicaServer:
             }
         s.update({f"batcher_{k}": v for k, v in b.items()})
         s.update(self.latency.percentile_scalars("serve"))
+        # The replica process's client-side instruments ride along (r13):
+        # its PS legs' reconnect/failover counters are the externally
+        # visible half of "this replica kept tracking through the fault".
+        s["registry"] = telemetry.snapshot()
+        s["flight_events"] = len(telemetry.RECORDER)
         return s
 
     # -- connection handling -------------------------------------------------
@@ -357,8 +363,13 @@ class ModelReplicaServer:
                 if req is None:
                     return
                 op, name, a, b, plen = req
-                with self._lock:
-                    self._requests += 1
+                # Handshake/observability ops are excluded (r13):
+                # ``request_count`` is the die:after_reqs fault trigger,
+                # and a dtxtop poll loop (HELLO + STATS per refresh) must
+                # not perturb when a chaos run's injected kills fire.
+                if op not in (SRV_HELLO, SRV_STATS):
+                    with self._lock:
+                        self._requests += 1
                 if op == SRV_PREDICT:
                     t0 = time.perf_counter()
                     # The payload must leave the socket even on the

@@ -337,13 +337,21 @@ def run_ps_cluster_task(
     import jax
 
     from ..parallel import async_ps
-    from ..utils import faults
+    from ..utils import faults, telemetry
 
     n_workers = worker_count(FLAGS)
     local_bs = max(1, FLAGS.batch_size // n_workers)
     job = FLAGS.job_name
     if not faults.current_role():
         faults.set_role(f"{job}{FLAGS.task_index}")
+    # Observability (r13 dtxobs): export the flight-recorder dump directory
+    # to this task AND everything it spawns (supervised re-execs inherit
+    # the environment), so every role of the cluster dumps its event ring
+    # to one place on fatal conditions.  Env wins when both are set — the
+    # launcher may already have threaded it through.
+    obs_dir = getattr(FLAGS, "obs_events_dir", "") or ""
+    if obs_dir and not os.environ.get(telemetry.EVENTS_DIR_ENV):
+        os.environ[telemetry.EVENTS_DIR_ENV] = obs_dir
 
     if job == "data_service":
         # Disaggregated input worker (r8): serves ready batches from this

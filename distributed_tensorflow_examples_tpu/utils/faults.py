@@ -79,6 +79,8 @@ import threading
 import time
 import zlib
 
+from . import telemetry
+
 log = logging.getLogger("dtx.faults")
 
 #: Exit code of a fault-injected process death ("die" spec).  Distinctive so
@@ -201,7 +203,16 @@ def log_event(event: str, **fields) -> None:
     ambient logging config would swallow the event — recovery evidence
     must reach per-task log files even in processes whose root logger sits
     at the WARNING default.  Propagation stays on, so pytest's caplog (and
-    any operator-configured root handler) still sees every event."""
+    any operator-configured root handler) still sees every event.
+
+    Every line is ALSO retained by the process flight recorder (r13
+    dtxobs): injected faults and recovery actions stay attributable
+    post-hoc from the recorder's JSONL dump even when no log collector
+    was watching the process."""
+    try:
+        telemetry.record_event(event, **fields)
+    except Exception:
+        pass  # observability must never fail the recovery path it observes
     if not log.handlers and not log.isEnabledFor(logging.INFO):
         h = logging.StreamHandler(sys.stderr)
         h.setFormatter(logging.Formatter("%(message)s"))
@@ -259,20 +270,20 @@ class ClientFaultInjector:
             if spec.kind == "delay":
                 log_event(
                     "inject_delay", role=self.role, op=self._op,
-                    op_code=op_code, ms=spec.ms,
+                    op_code=op_code, ms=spec.ms, spec=format_plan([spec]),
                 )
                 time.sleep(spec.ms / 1000.0)
             elif spec.kind == "drop_conn":
                 log_event(
                     "inject_drop_conn", role=self.role, op=self._op,
-                    op_code=op_code,
+                    op_code=op_code, spec=format_plan([spec]),
                 )
                 drop = True
             elif spec.kind == "partition":
                 if self._op == spec.op:  # log the cut once, not per op
                     log_event(
                         "inject_partition", role=self.role, op=self._op,
-                        op_code=op_code,
+                        op_code=op_code, spec=format_plan([spec]),
                     )
                 drop = True
         return drop
@@ -306,7 +317,14 @@ def client_injector(role: str | None = None) -> ClientFaultInjector | None:
 
 
 def _die(spec: FaultSpec, role: str, **fields) -> None:
-    log_event("inject_die", role=role, exit=FAULT_EXIT_CODE, **fields)
+    log_event(
+        "inject_die", role=role, exit=FAULT_EXIT_CODE,
+        spec=format_plan([spec]), **fields,
+    )
+    # The process is about to hard-exit: persist the flight recorder NOW
+    # (the injected death plus everything leading up to it), so a chaos
+    # run's post-mortem can attribute the kill to its spec.
+    telemetry.dump_flight_recorder(f"inject_die role={role}")
     for h in log.handlers:
         try:
             h.flush()
@@ -337,6 +355,7 @@ def arm_process_faults(
             log_event(
                 "inject_partition", role=role, peer=spec.peer,
                 after_s=spec.after_s, after_reqs=spec.after_reqs,
+                spec=format_plan([spec]),
             )
 
     threads: list[threading.Thread] = []

@@ -66,6 +66,18 @@ def define_training_flags(default_batch_size: int = 128, default_steps: int = 10
     _define("bool", "profile", False, "Capture a jax.profiler trace window.")
     _define(
         "string",
+        "obs_events_dir",
+        "",
+        "Observability (r13 dtxobs): directory where each cluster task "
+        "dumps its structured-event flight recorder (one "
+        "flight-<role>-<pid>.jsonl per process) on fatal conditions — "
+        "replication divergence, reconnect-budget exhaustion, injected "
+        "deaths.  Exported to child tasks via DTX_OBS_EVENTS_DIR.  Empty "
+        "= on-fatal dumps are skipped (live scraping via the STATS ops / "
+        "tools/dtxtop.py works regardless).",
+    )
+    _define(
+        "string",
         "platform",
         "",
         'Force the JAX platform (e.g. "cpu") — needed for CPU fake-cluster '

@@ -660,7 +660,19 @@ def test_replicated_ps_kill_heals_via_backup_with_zero_reseeds(tmp_path, caplog)
     at-most-once push semantics hold across the failover (dedup counters
     readable, applied-step count exact), and the restarted primary
     catches up from the survivor via REPL_SYNC and serves to a clean
-    shutdown."""
+    shutdown.
+
+    r13 growth: the whole story is ALSO read from OUTSIDE the processes,
+    live, via the wire-level STATS scrape (tools/dtxtop.py): before the
+    kill every task answers its counter table in one scrape — the
+    backups' start-time REPL_SYNC catch-ups visible as
+    ``repl_syncs_served`` on the primaries — and after the kill the
+    surviving replicas still answer, with shard 0's backup counting its
+    dead peer (``fwd_peer_down`` grows as the failed-over clients' writes
+    can no longer be forwarded) — the failover evidence, with zero
+    process internals touched."""
+    from tools import dtxtop
+
     caplog.set_level("INFO", logger="dtx.faults")
     ports = _free_ports(4)
     ps_hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
@@ -668,6 +680,32 @@ def test_replicated_ps_kill_heals_via_backup_with_zero_reseeds(tmp_path, caplog)
     env_base["JAX_PLATFORMS"] = "cpu"
     env_base.pop("PALLAS_AXON_POOL_IPS", None)
     procs, logs = [], []
+    scrape: dict = {}
+    run_over = threading.Event()
+
+    def scrape_throughout(chief):
+        # Samples continuously for the whole run (the 40-step blob run is
+        # seconds long; the kill fires a couple of steps in): keep the
+        # best FULL snapshot (all 4 roles up — pre-kill) and the best
+        # POST-KILL snapshot (ps0 down, every survivor answering).
+        try:
+            while not run_over.is_set():
+                snap = dtxtop.snapshot(
+                    [("127.0.0.1", p) for p in ports],
+                    ps_shards=2, ps_replicas=2, timeout_s=3.0,
+                )
+                by_role = {r["role"]: r for r in snap["roles"]}
+                if snap["summary"]["roles_ok"] == 4 and "full" not in scrape:
+                    scrape["full"] = snap
+                if (
+                    not by_role["ps0"]["ok"]
+                    and all(by_role[f"ps{i}"]["ok"] for i in (1, 2, 3))
+                ):
+                    scrape["post_kill"] = snap
+                time.sleep(0.2)
+        except BaseException as e:  # noqa: BLE001 — asserted below
+            scrape["exc"] = e
+
     try:
         for tid in range(4):
             script = tmp_path / f"ps_task_{tid}.py"
@@ -710,11 +748,40 @@ def test_replicated_ps_kill_heals_via_backup_with_zero_reseeds(tmp_path, caplog)
             replicas=2,
             reconnect_deadline_s=90.0,
             join_timeout=240.0,
+            on_chief=scrape_throughout,
         )
+        run_over.set()
         # The acceptance gates: exact step target, ZERO chief reseeds
         # (assert the counter), dedup counters readable end-of-run, and
         # the fault-free loss.
         assert chief.global_step == 40
+        # r13: the external STATS scrape saw the whole story without
+        # touching any process internals.
+        assert "exc" not in scrape, scrape.get("exc")
+        assert "full" in scrape, "no pre-kill full-cluster scrape landed"
+        full = {r["role"]: r["stats"] for r in scrape["full"]["roles"]}
+        assert all(full[f"ps{i}"]["replicated"] == 1 for i in range(4))
+        # The backups' start-time REPL_SYNC catch-ups, counted on the
+        # primaries that served them.  Asserted on ps1 ONLY: ps1 never
+        # dies, so its counter survives no matter when the 4-role
+        # snapshot landed — ps0's counter resets if the kill slipped in
+        # before the first full scrape (the snapshot would then be of the
+        # restarted incarnation, whose own catch-up sync counts on ps2).
+        assert full["ps1"]["repl_syncs_served"] >= 1, full
+        for i in range(4):
+            assert "gq_deduped" in full[f"ps{i}"], full
+        assert "post_kill" in scrape, "no post-kill survivor scrape landed"
+        pk = {
+            r["role"]: r["stats"]
+            for r in scrape["post_kill"]["roles"] if r["ok"]
+        }
+        # Failover, externally visible: the clients moved to shard 0's
+        # backup, whose forwards now count a dead peer, and the backups
+        # applied forwarded dedup mirrors while the primaries lived.
+        assert pk["ps2"]["fwd_peer_down"] >= 1, pk
+        assert (
+            pk["ps2"]["mirror_applies"] + pk["ps3"]["mirror_applies"]
+        ) > 0, pk
         assert chief.reseeds == 0, "a replicated primary kill must not reseed"
         assert chief.total_deduped != -1 and chief.total_dropped != -1
         assert _eval_loss(chief.params) < 2.0

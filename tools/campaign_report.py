@@ -102,6 +102,33 @@ def fmt_dtxlint(rec: dict, ok: str) -> str:
     return "\n".join(lines)
 
 
+def fmt_obs(rec: dict, ok: str) -> str:
+    """Observability acceptance step (r13): the dtxtop snapshot summary —
+    which roles answered, the aggregated cluster counters, and any
+    missing-counter findings — rendered next to the bench rows."""
+    j = rec.get("json") or {}
+    if not j:
+        return f"- `obs_snapshot` [{ok}]: NO JSON ({rec['seconds']}s)"
+    su = j.get("summary", {})
+    lines = [
+        f"- `obs_snapshot` [{ok}]: {'all roles scraped' if j.get('ok') else 'MISSING'}"
+        f" — {j.get('roles_ok')}/{j.get('roles_total')} roles "
+        f"({rec['seconds']}s wall)"
+    ]
+    if su:
+        ps, dsvc, srv = su.get("ps", {}), su.get("dsvc", {}), su.get("serve", {})
+        lines.append(
+            f"    - ps_reqs={ps.get('requests')} dedup={ps.get('deduped')} "
+            f"repl_syncs={ps.get('repl_syncs_served')} "
+            f"diverged={ps.get('diverged') or 'none'} | "
+            f"dsvc_batches={dsvc.get('batches_served')} | "
+            f"serve_steps={srv.get('model_steps')} p99={srv.get('p99_ms')}ms"
+        )
+    for p in j.get("problems", []):
+        lines.append(f"    - PROBLEM: {p}")
+    return "\n".join(lines)
+
+
 def main():
     path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(ROOT, "CAMPAIGN_r05.json")
     with open(path) as f:
@@ -115,6 +142,8 @@ def main():
             print(fmt_transport(rec, ok))
         elif name == "dtxlint":
             print(fmt_dtxlint(rec, ok))
+        elif name == "obs_snapshot":
+            print(fmt_obs(rec, ok))
         elif name.startswith("bench_"):
             print(fmt_bench(rec, ok))
         elif name == "flash_parity":

@@ -64,9 +64,13 @@ _CONST_PAIRS = {
 #: the client-op collection.  Namespace prefixes (ACC_/TQ_/GQ_/PSTORE_)
 #: require the underscore; standalone ops must match exactly — else
 #: innocent constants like ``_ACCEPT_BACKLOG`` or ``_PING_INTERVAL_S``
-#: read as restated protocol numbers and fail the lint.
+#: read as restated protocol numbers and fail the lint.  STATS (r13) is a
+#: standalone op name on ALL THREE wires (PS 30 / DSVC 69 / SRV 97 — the
+#: observability scrape), so it joins the exact-match list: a restated
+#: STATS literal or an undispatched STATS case must fail like any op.
 _PS_NAME = re.compile(
-    r"^_?(?:(?:ACC|TQ|GQ|PSTORE|REPL)_\w+|CANCEL_ALL|PING|INCARNATION|HELLO)$"
+    r"^_?(?:(?:ACC|TQ|GQ|PSTORE|REPL)_\w+|CANCEL_ALL|PING|INCARNATION|HELLO"
+    r"|STATS)$"
 )
 _DSVC_NAME = re.compile(r"^DSVC_\w+$")
 _SRV_NAME = re.compile(r"^SRV_\w+$")

@@ -1,0 +1,360 @@
+"""dtxtop — live cluster-wide observability scraper (r13 dtxobs tentpole).
+
+Dials EVERY task of a running train-and-serve cluster straight from the
+cluster flags — PS shard servers (every replica), data servers, serve
+replicas — over each service's wire-level ``STATS`` op and renders one
+aggregated table: requests, qps (delta between refreshes), p99 latency,
+reconnect/failover/reseed counters, dedup/mirror hits, divergence flags.
+No side channels: everything it shows travels over the same sockets the
+cluster already serves, so what dtxtop can see, any operator tooling can.
+
+Usage:
+  # live table, refreshed every 2 s, against a replicated cluster
+  python tools/dtxtop.py --ps_hosts=h:7000,h:7001,h:7002,h:7003 \
+      --ps_shards=2 --ps_replicas=2 \
+      --data_service_hosts=h:7100 --serve_hosts=h:7200,h:7201
+
+  # one-shot machine-readable snapshot (tests, CI, the loadsim SLO gate)
+  python tools/dtxtop.py --json --ps_hosts=... --serve_hosts=...
+
+Exit code (``--json`` mode): 0 when every dialed role answered its STATS
+scrape, 1 otherwise — so a CI step can gate on "the whole cluster is
+observable" with no extra parsing.  A mis-wired host list fails loudly:
+the role's row carries the wire's wrong-service diagnostic, never a
+misread counter table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from distributed_tensorflow_examples_tpu.parallel import ps_service  # noqa: E402
+from distributed_tensorflow_examples_tpu.utils import flags as dtx_flags  # noqa: E402
+
+#: Snapshot schema version (tests pin it).
+SNAPSHOT_SCHEMA_VERSION = 1
+
+
+def _scrape_ps(
+    host: str, port: int, timeout_s: float,
+    expect_shard: tuple[int, int] | None = None,
+) -> dict:
+    # The shard expectation forces the HELLO handshake, so a mis-wired
+    # entry in --ps_hosts fails THIS scrape with the wire's full
+    # diagnostic (wrong service / wrong shard, naming both ends) instead
+    # of an opaque bad-status error.
+    c = ps_service.PSClient(
+        host, port, timeout_s=timeout_s, expect_shard=expect_shard,
+    )
+    try:
+        return c.stats()
+    finally:
+        c.close()
+
+
+def _scrape_dsvc(host: str, port: int, timeout_s: float) -> dict:
+    from distributed_tensorflow_examples_tpu.data import data_service
+
+    # worker_id=-1 is a metadata-only probe: the scraper must never count
+    # as a training worker in the dispatcher's liveness tables.
+    c = data_service.DataServiceClient(
+        host, port, worker_id=-1, op_timeout_s=timeout_s,
+        reconnect_deadline_s=0.0, role="dtxtop",
+    )
+    try:
+        return c.stats()
+    finally:
+        c.close()
+
+
+def _scrape_serve(host: str, port: int, timeout_s: float) -> dict:
+    from distributed_tensorflow_examples_tpu import serve
+
+    c = serve.ServeClient(
+        host, port, op_timeout_s=timeout_s, reconnect_deadline_s=0.0,
+        role="dtxtop",
+    )
+    try:
+        return c.stats()
+    finally:
+        c.close()
+
+
+def resolve_shards(ps_addrs, ps_shards: int, ps_replicas: int) -> int:
+    """The shard count a cluster's flags imply: explicit ``--ps_shards``
+    wins; otherwise one shard per host DIVIDED by the replica tier (the
+    ``--ps_shards=-1`` convention of ``flags.ps_shard_topology``) — a
+    4-host ``--ps_replicas=2`` cluster is 2 shards, and deriving 4 here
+    would pin every scrape's HELLO to a wrong identity and render a
+    healthy cluster DOWN."""
+    if ps_shards > 0:
+        return ps_shards
+    return max(1, len(ps_addrs) // max(1, ps_replicas))
+
+
+def cluster_roles(
+    ps_addrs=(), *, ps_shards: int = 0, ps_replicas: int = 1,
+    dsvc_addrs=(), serve_addrs=(),
+) -> list[dict]:
+    """The task list a cluster's flags imply, one entry per dialable role.
+    PS task i serves shard ``i % shards`` replica ``i // shards`` (the
+    replica-major ``--ps_hosts`` convention — ``ps_shard.replica_major``
+    is the one definition; this is only the naming of the flat order)."""
+    roles = []
+    n_shards = resolve_shards(ps_addrs, ps_shards, ps_replicas)
+    for i, (h, p) in enumerate(ps_addrs):
+        roles.append({
+            "role": f"ps{i}", "kind": "ps", "addr": f"{h}:{p}",
+            "shard": i % n_shards, "replica": i // n_shards,
+        })
+    for i, (h, p) in enumerate(dsvc_addrs):
+        roles.append({
+            "role": f"data_service{i}", "kind": "dsvc", "addr": f"{h}:{p}",
+        })
+    for i, (h, p) in enumerate(serve_addrs):
+        roles.append({
+            "role": f"serve{i}", "kind": "serve", "addr": f"{h}:{p}",
+        })
+    return roles
+
+
+_SCRAPERS = {"ps": _scrape_ps, "dsvc": _scrape_dsvc, "serve": _scrape_serve}
+
+
+def snapshot(
+    ps_addrs=(), *, ps_shards: int = 0, ps_replicas: int = 1,
+    dsvc_addrs=(), serve_addrs=(), timeout_s: float = 5.0,
+) -> dict:
+    """One scrape of the whole cluster: every role's STATS table plus an
+    aggregated summary.  A role that cannot be scraped (down, or a
+    mis-wired address answering as the wrong service) is reported with
+    ``ok: False`` and the diagnostic — missing observability is itself a
+    loud finding, never a silent hole in the table."""
+    roles = cluster_roles(
+        ps_addrs, ps_shards=ps_shards, ps_replicas=ps_replicas,
+        dsvc_addrs=dsvc_addrs, serve_addrs=serve_addrs,
+    )
+    n_shards = resolve_shards(ps_addrs, ps_shards, ps_replicas)
+
+    def scrape_one(r: dict) -> None:
+        host, port_s = r["addr"].rsplit(":", 1)
+        try:
+            if r["kind"] == "ps":
+                r["stats"] = _scrape_ps(
+                    host, int(port_s), timeout_s,
+                    expect_shard=(r["shard"], n_shards),
+                )
+            else:
+                r["stats"] = _SCRAPERS[r["kind"]](host, int(port_s), timeout_s)
+            r["ok"] = True
+        except Exception as e:  # noqa: BLE001 — every failure is a row
+            r["ok"] = False
+            r["error"] = f"{type(e).__name__}: {e}"
+
+    # Roles are independent — scrape them concurrently, so one blackholed
+    # host costs ONE timeout per refresh, not timeout x down-roles (a
+    # sequential dial would degrade the live table to a frame per
+    # N_down * timeout_s during exactly the outages it exists to show).
+    with concurrent.futures.ThreadPoolExecutor(
+        max_workers=min(8, max(1, len(roles)))
+    ) as pool:
+        list(pool.map(scrape_one, roles))
+    ps_rows = [r for r in roles if r["kind"] == "ps" and r["ok"]]
+    serve_rows = [r for r in roles if r["kind"] == "serve" and r["ok"]]
+    dsvc_rows = [r for r in roles if r["kind"] == "dsvc" and r["ok"]]
+    summary = {
+        "roles_total": len(roles),
+        "roles_ok": sum(1 for r in roles if r["ok"]),
+        "ps": {
+            "requests": sum(r["stats"]["requests"] for r in ps_rows),
+            "deduped": sum(
+                r["stats"]["acc_deduped"] + r["stats"]["gq_deduped"]
+                for r in ps_rows
+            ),
+            "mirror_applies": sum(
+                r["stats"]["mirror_applies"] for r in ps_rows
+            ),
+            "repl_syncs_served": sum(
+                r["stats"]["repl_syncs_served"] for r in ps_rows
+            ),
+            "diverged": sorted(
+                r["role"] for r in ps_rows if r["stats"]["diverged"]
+            ),
+        },
+        "dsvc": {
+            "batches_served": sum(
+                r["stats"]["batches_served"] for r in dsvc_rows
+            ),
+            "reassigned": sum(r["stats"]["reassigned"] for r in dsvc_rows),
+        },
+        "serve": {
+            "model_steps": [r["stats"]["model_step"] for r in serve_rows],
+            "predict_rows": sum(
+                r["stats"]["predict_rows"] for r in serve_rows
+            ),
+            "qps": round(sum(
+                r["stats"].get("serve/qps", 0.0) for r in serve_rows
+            ), 2),
+            "p99_ms": round(max(
+                (r["stats"].get("serve/latency_p99_ms", 0.0)
+                 for r in serve_rows),
+                default=0.0,
+            ), 3),
+        },
+    }
+    return {
+        "schema_version": SNAPSHOT_SCHEMA_VERSION,
+        "time": time.time(),
+        "roles": roles,
+        "summary": summary,
+    }
+
+
+def _fmt_ps_row(r: dict) -> str:
+    s = r["stats"]
+    flags = "".join((
+        "R" if s.get("replicated") else "-",
+        "P" if s.get("partitioned") else "-",
+        "D" if s.get("diverged") else "-",
+    ))
+    return (
+        f"{s['requests']:>9} conns={s['live_conns']:<3} "
+        f"shard={s['shard_id']}/{s['shard_count']} {flags} "
+        f"dedup={s['acc_deduped'] + s['gq_deduped']:<5} "
+        f"mirror={s['mirror_applies']:<6} fwd={s['fwd_ok']}"
+        f"/{s['fwd_peer_down']}/{s['fwd_refused']} "
+        f"syncs={s['repl_syncs_served']}"
+    )
+
+
+def _fmt_dsvc_row(r: dict) -> str:
+    s = r["stats"]
+    return (
+        f"{s['requests']:>9} epoch={s['epoch']:<3} "
+        f"batches={s['batches_served']:<7} "
+        f"splits={s['splits_completed']}/{s['assigned_total']}"
+        f"/{s['reassigned']} (done/assigned/reassigned) "
+        f"workers={s['registered_workers']}"
+    )
+
+
+def _fmt_serve_row(r: dict) -> str:
+    s = r["stats"]
+    return (
+        f"{s['requests']:>9} step={s['model_step']:<6} "
+        f"rows={s['predict_rows']:<7} overload={s['overloads']:<4} "
+        f"p99={s.get('serve/latency_p99_ms', 0.0):7.2f}ms "
+        f"qps={s.get('serve/qps', 0.0):7.1f} "
+        f"batch_p50={s.get('batcher_batch_rows_p50', 0)}"
+    )
+
+
+_ROW_FMT = {"ps": _fmt_ps_row, "dsvc": _fmt_dsvc_row, "serve": _fmt_serve_row}
+
+
+def render(snap: dict, prev: dict | None = None) -> str:
+    """The human table.  With a previous snapshot, a per-role qps column
+    is derived from the request-counter delta over the refresh window."""
+    dt = (snap["time"] - prev["time"]) if prev else 0.0
+    prev_reqs = {
+        r["role"]: r["stats"]["requests"]
+        for r in (prev["roles"] if prev else [])
+        if r.get("ok")
+    }
+    lines = [
+        f"dtxtop — {time.strftime('%H:%M:%S', time.localtime(snap['time']))}"
+        f"  roles {snap['summary']['roles_ok']}/{snap['summary']['roles_total']} ok"
+    ]
+    lines.append(f"{'ROLE':<15} {'ADDR':<22} {'REQS':>9} detail")
+    for r in snap["roles"]:
+        head = f"{r['role']:<15} {r['addr']:<22}"
+        if not r["ok"]:
+            lines.append(f"{head} {'DOWN':>9} {r['error']}")
+            continue
+        qps = ""
+        if dt > 0 and r["role"] in prev_reqs:
+            qps = f" qps={max(0.0, (r['stats']['requests'] - prev_reqs[r['role']]) / dt):.1f}"
+        lines.append(f"{head} {_ROW_FMT[r['kind']](r)}{qps}")
+    su = snap["summary"]
+    lines.append(
+        f"totals: ps_reqs={su['ps']['requests']} dedup={su['ps']['deduped']} "
+        f"syncs={su['ps']['repl_syncs_served']} "
+        f"diverged={su['ps']['diverged'] or 'none'} | "
+        f"dsvc_batches={su['dsvc']['batches_served']} "
+        f"reassigned={su['dsvc']['reassigned']} | "
+        f"serve_steps={su['serve']['model_steps']} "
+        f"qps={su['serve']['qps']} p99={su['serve']['p99_ms']}ms"
+    )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ps_hosts", default="", help="replica-major PS host list")
+    ap.add_argument("--ps_shards", type=int, default=-1)
+    ap.add_argument("--ps_replicas", type=int, default=1)
+    ap.add_argument("--data_service_hosts", default="")
+    ap.add_argument("--serve_hosts", default="")
+    ap.add_argument("--timeout_s", type=float, default=5.0)
+    ap.add_argument(
+        "--json", action="store_true",
+        help="one-shot JSON snapshot on stdout (exit 1 on any missing role)",
+    )
+    ap.add_argument(
+        "--interval_s", type=float, default=2.0, help="live refresh cadence"
+    )
+    ap.add_argument(
+        "--count", type=int, default=0,
+        help="live refreshes before exiting (0 = until interrupted)",
+    )
+    args = ap.parse_args(argv)
+
+    def addrs(spec, flag):
+        return dtx_flags.parse_hostports(spec, flag) if spec else []
+
+    ps_addrs = addrs(args.ps_hosts, "--ps_hosts")
+    dsvc_addrs = addrs(args.data_service_hosts, "--data_service_hosts")
+    serve_addrs = addrs(args.serve_hosts, "--serve_hosts")
+    if not (ps_addrs or dsvc_addrs or serve_addrs):
+        ap.error("nothing to scrape: give --ps_hosts/--data_service_hosts/"
+                 "--serve_hosts")
+    kw = dict(
+        ps_shards=args.ps_shards, ps_replicas=args.ps_replicas,
+        dsvc_addrs=dsvc_addrs, serve_addrs=serve_addrs,
+        timeout_s=args.timeout_s,
+    )
+    if args.json:
+        snap = snapshot(ps_addrs, **kw)
+        print(json.dumps(snap))
+        return 0 if snap["summary"]["roles_ok"] == snap["summary"]["roles_total"] else 1
+    prev = None
+    n = 0
+    try:
+        while True:
+            snap = snapshot(ps_addrs, **kw)
+            out = render(snap, prev)
+            # Clear-and-home only on a tty; piped output stays appendable.
+            if sys.stdout.isatty():
+                print("\x1b[2J\x1b[H" + out, flush=True)
+            else:
+                print(out + "\n", flush=True)
+            prev = snap
+            n += 1
+            if args.count and n >= args.count:
+                return 0
+            time.sleep(args.interval_s)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -160,6 +160,13 @@ def steps_plan() -> list[dict]:
         dict(name="dtxlint",
              cmd=[PY, "tools/dtxlint_step.py"], timeout=600,
              cpu_ok=True),
+        # Observability plane (r13): boot a mini train-and-serve cluster
+        # under load, scrape it once with dtxtop, fail on any missing
+        # role/counter — the cluster must stay scrape-able, release over
+        # release.  JAX-on-CPU only, so also a cpu_ok pre-wait step.
+        dict(name="obs_snapshot",
+             cmd=[PY, "tools/obs_snapshot_step.py"], timeout=600,
+             cpu_ok=True),
     ]
     return plan
 
