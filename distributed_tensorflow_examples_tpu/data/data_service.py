@@ -209,6 +209,13 @@ class DataServiceServer:
         self._completed: set[int] = set()
         self._visits = {i: 0 for i in range(len(self._splits))}
         self._last_seen: dict[int, float] = {}
+        # Workers declared departed by the membership layer (r14): their
+        # assigned splits reassign IMMEDIATELY on the next GET_SPLIT
+        # instead of waiting out the liveness window.  Any later op from
+        # the worker clears the mark (it came back; at-least-once absorbs
+        # the duplicate delivery).
+        self._stale_members: set[int] = set()
+        self._stale_marked = 0
         self._requests = 0
         self._batches_served = 0
         self._splits_completed = 0
@@ -376,6 +383,7 @@ class DataServiceServer:
         now = time.monotonic()
         with self._lock:
             self._last_seen[worker] = now
+            self._stale_members.discard(worker)  # it's back: unmark
             if ack >= 0 and (client_epoch is None or client_epoch == self._epoch):
                 # Epoch-tagged acks: an ack for a split assigned in a
                 # PREVIOUS epoch (a worker that stalled past reassignment
@@ -395,9 +403,14 @@ class DataServiceServer:
                 self._assign_locked(worker, s)
                 return s, {"epoch": self._epoch, "num_batches": None, "split": s}
             # Nothing pending: reassign only a STALE assignee's split (a
-            # lost worker must not wedge the epoch); otherwise wait.
+            # lost worker must not wedge the epoch); otherwise wait.  A
+            # worker the membership layer declared departed (expired
+            # lease, r14) is stale IMMEDIATELY — the elastic leave path
+            # skips the liveness window entirely.
             for s, (w, t0) in self._assigned.items():
-                if now - max(self._last_seen.get(w, 0.0), t0) > self._reassign_after_s:
+                if w in self._stale_members or now - max(
+                    self._last_seen.get(w, 0.0), t0
+                ) > self._reassign_after_s:
                     if self._worker_split.get(w) == s:
                         # The stale worker no longer holds it: were it to
                         # come back, its GET_SPLIT must not re-answer s.
@@ -410,9 +423,21 @@ class DataServiceServer:
                     return s, {"epoch": self._epoch, "num_batches": None, "split": s}
             return WAIT, {"epoch": self._epoch}
 
+    def mark_worker_stale(self, worker: int) -> None:
+        """Membership hook (r14): a worker whose lease EXPIRED is departed
+        NOW — its assigned splits become reassignable on the next
+        GET_SPLIT, without waiting out ``reassign_after_s``.  Idempotent;
+        any later op from the worker clears the mark."""
+        with self._lock:
+            if worker not in self._stale_members:
+                self._stale_members.add(worker)
+                self._stale_marked += 1
+        faults.log_event("dsvc_member_stale", worker=worker)
+
     def _handle_claim(self, worker: int, split: int):
         with self._lock:
             self._last_seen[worker] = time.monotonic()
+            self._stale_members.discard(worker)
             if not (0 <= split < len(self._splits)):
                 return ERR, {}
             if split in self._completed:
@@ -445,6 +470,7 @@ class DataServiceServer:
                 "assigned_total": self._assigned_total,
                 "acks": self._acks,
                 "reassigned": self._reassigned,
+                "stale_marked": self._stale_marked,
                 "epochs_completed": self._epochs_completed,
                 "last_epoch_min_visits": self._last_epoch_min_visits,
                 "requests": self._requests,
@@ -552,6 +578,7 @@ class DataServiceServer:
                 with self._lock:
                     self._registered.add(a)
                     self._last_seen[a] = time.monotonic()
+                    self._stale_members.discard(a)
             info = {
                 "incarnation": self._incarnation,
                 "epoch": self._epoch,
@@ -589,6 +616,7 @@ class DataServiceServer:
             if name:
                 with self._lock:
                     self._last_seen[int(name)] = time.monotonic()
+                    self._stale_members.discard(int(name))
             batches = self._split_batches(a)
             if b >= len(batches) or b < 0:
                 self._reply(conn, END_OF_SPLIT, None)
@@ -600,6 +628,7 @@ class DataServiceServer:
         if op == DSVC_HEARTBEAT:
             with self._lock:
                 self._last_seen[a] = time.monotonic()
+                self._stale_members.discard(a)
                 epoch = self._epoch
             self._reply(conn, epoch, None)
             return
@@ -1126,21 +1155,55 @@ def serve_from_dir(
 def host_data_service_task(
     data_dir: str, port: int, *, batch_size: int, seed: int = 0,
     loopback_only: bool = True,
+    ps_addrs: list[tuple[str, int]] | None = None,
+    lease_poll_s: float = 2.0,
 ) -> int:
     """Dedicated data-service task body (``--job_name=data_service``): host
     the server until a client signals DSVC_SHUTDOWN (or the supervisor
     dies).  Arms ``die`` fault specs off the server's request counter —
     the deterministic "kill the data server at request N" fault the
     mid-epoch recovery tests inject; a supervisor restart plus the clients'
-    re-claim path heals it."""
+    re-claim path heals it.
+
+    Elasticity (r14): with ``ps_addrs`` (the coordinator shard's replica
+    list, from ``--ps_hosts``), the task WATCHES the membership lease
+    registry — a worker whose lease expires or is released has its
+    in-flight splits marked reassignable immediately, so the live
+    rebalance follows the membership signal instead of waiting out the
+    dispatcher's own liveness window."""
     server = serve_from_dir(
         data_dir, batch_size=batch_size, seed=seed, port=port,
         loopback_only=loopback_only,
     )
-    faults.arm_process_faults(request_count_fn=server.request_count)
+    faults.arm_process_faults(
+        request_count_fn=server.request_count, leave_fn=server.stop,
+    )
+    watcher = None
+    if ps_addrs:
+        from ..parallel import membership
+
+        def _member_left(m: dict) -> None:
+            # Worker member ids carry their numeric wid as a trailing
+            # index ("worker3"); members without one have no dispatcher
+            # state to reassign.
+            wid = membership.member_index(m["member"])
+            if wid is not None:
+                server.mark_worker_stale(wid)
+
+        try:
+            watcher = membership.LeaseWatcher(
+                list(ps_addrs), kind="worker", poll_s=lease_poll_s,
+                on_leave=_member_left,
+            )
+        except (OSError, RuntimeError):
+            log.warning(
+                "data service: lease registry at %s unreachable; falling "
+                "back to the liveness-window reassignment only", ps_addrs,
+            )
     log.info(
-        "data service task on port %d (%d splits; blocking until shutdown)",
+        "data service task on port %d (%d splits%s; blocking until shutdown)",
         server.port, len(server._splits),
+        ", watching worker leases" if watcher is not None else "",
     )
     supervised = os.environ.get("DTX_DSVC_SUPERVISED") == "1"
     ppid0 = os.getppid()
@@ -1149,5 +1212,7 @@ def host_data_service_task(
             log.warning("data service task: supervisor died; exiting")
             break
     bound = server.port
+    if watcher is not None:
+        watcher.close()
     server.stop()
     return bound

@@ -172,6 +172,18 @@ enum Op : uint8_t {
   // 4-byte units and NEVER dtype-encoded (like the REPL_SYNC state blob),
   // so a bf16 connection scrapes the same bytes as an f32 one.
   STATS = 30,
+  // Membership leases (r14 elasticity).  LEASE_ACQUIRE: name = the member
+  // string, a = ttl_ms; answers 1 when newly acquired (no live lease —
+  // fresh member, or the previous lease EXPIRED, telling a renewing
+  // client it lapsed) or 2 on a renewal.  LEASE_RELEASE: clean departure
+  // (1 released / 0 unknown; idempotent).  LEASE_LIST: the live set as a
+  // raw JSON blob (4-byte units like STATS — never dtype-encoded);
+  // expired entries are pruned at list/acquire time and counted.  Leases
+  // are liveness state and are deliberately NOT replicated: a failover's
+  // next heartbeat re-acquires on the survivor within one TTL.
+  LEASE_ACQUIRE = 31,
+  LEASE_RELEASE = 32,
+  LEASE_LIST = 33,
 };
 
 // v3 (r12): HELLO b-word field relayout — see wire.py WIRE_VERSION.
@@ -245,6 +257,15 @@ struct Object {
   void* handle;
 };
 
+// Membership lease (r14): one live member of the elastic cluster.  The
+// member string is opaque to the server (Python packs id/kind/address into
+// it) — sanitized at acquire so LEASE_LIST can emit it into JSON verbatim.
+struct Lease {
+  std::chrono::steady_clock::time_point deadline;
+  std::chrono::steady_clock::time_point acquired;
+  int64_t renewals = 0;
+};
+
 struct Server {
   std::mutex mu;
   std::map<std::string, Object> objects;
@@ -309,6 +330,14 @@ struct Server {
   std::atomic<int64_t> fwd_refused{0};
   std::atomic<int64_t> repl_syncs_served{0};
   std::atomic<int64_t> mirror_applies{0};
+  // Membership lease registry (r14): live members keyed by their packed
+  // member string.  Own mutex — heartbeats must never contend with the
+  // object table's hot path.  ``leases_expired`` counts every lease that
+  // lapsed (pruned at list/acquire time): the membership-churn evidence
+  // STATS exports.
+  std::mutex lease_mu;
+  std::map<std::string, Lease> leases;
+  std::atomic<int64_t> leases_expired{0};
   std::atomic<bool> stopping{false};
   std::thread accept_thread;
   // Live connection fds: stop() shuts them down so blocked readers exit
@@ -783,6 +812,68 @@ bool sync_from_peer(Server* s, int64_t budget_ms) {
   }
 }
 
+// --- Membership leases (r14 elasticity) ------------------------------------
+
+// Drop every lapsed lease; counts them into leases_expired.  lease_mu held.
+void prune_leases_locked(Server* s,
+                         std::chrono::steady_clock::time_point now) {
+  for (auto it = s->leases.begin(); it != s->leases.end();) {
+    if (it->second.deadline < now) {
+      it = s->leases.erase(it);
+      s->leases_expired.fetch_add(1, std::memory_order_relaxed);
+    } else {
+      ++it;
+    }
+  }
+}
+
+// A member string must be JSON-verbatim-safe: LEASE_LIST emits it into the
+// blob without escaping, so quotes/backslashes/control bytes are rejected
+// at acquire instead of corrupting every later scrape.
+bool lease_name_ok(const std::string& name) {
+  if (name.empty() || name.size() > 200) return false;
+  for (unsigned char c : name)
+    if (c < 0x20 || c == '"' || c == '\\' || c > 0x7E) return false;
+  return true;
+}
+
+// The live set as one JSON object (expired entries pruned first):
+// {"leases":[{"m":...,"ttl_ms":...,"age_ms":...,"renewals":...}],
+//  "expired_total":N}
+std::string build_lease_json(Server* s) {
+  const auto now = std::chrono::steady_clock::now();
+  std::string out = "{\"leases\":[";
+  int64_t expired;
+  {
+    std::lock_guard<std::mutex> lk(s->lease_mu);
+    prune_leases_locked(s, now);
+    bool first = true;
+    for (const auto& [name, l] : s->leases) {
+      const int64_t ttl_ms = std::chrono::duration_cast<
+          std::chrono::milliseconds>(l.deadline - now).count();
+      const int64_t age_ms = std::chrono::duration_cast<
+          std::chrono::milliseconds>(now - l.acquired).count();
+      char buf[320];
+      int n = std::snprintf(
+          buf, sizeof(buf),
+          "%s{\"m\":\"%s\",\"ttl_ms\":%lld,\"age_ms\":%lld,"
+          "\"renewals\":%lld}",
+          first ? "" : ",", name.c_str(), static_cast<long long>(ttl_ms),
+          static_cast<long long>(age_ms),
+          static_cast<long long>(l.renewals));
+      if (n > 0 && n < static_cast<int>(sizeof(buf)))
+        out.append(buf, static_cast<size_t>(n));
+      first = false;
+    }
+    expired = s->leases_expired.load(std::memory_order_relaxed);
+  }
+  char tail[64];
+  int n = std::snprintf(tail, sizeof(tail), "],\"expired_total\":%lld}",
+                        static_cast<long long>(expired));
+  out.append(tail, static_cast<size_t>(n));
+  return out;
+}
+
 // --- STATS counter table (r13 dtxobs) --------------------------------------
 // The server's whole exported state as one JSON object: identity,
 // incarnation/state token, request/connection counts, the replication
@@ -806,7 +897,13 @@ std::string build_stats_json(Server* s) {
       }
     }
   }
-  char buf[1024];
+  int64_t n_leases;
+  {
+    std::lock_guard<std::mutex> lk(s->lease_mu);
+    prune_leases_locked(s, std::chrono::steady_clock::now());
+    n_leases = static_cast<int64_t>(s->leases.size());
+  }
+  char buf[1152];
   int n = std::snprintf(
       buf, sizeof(buf),
       "{\"service\":\"ps\",\"shard_id\":%d,\"shard_count\":%d,"
@@ -815,6 +912,7 @@ std::string build_stats_json(Server* s) {
       "\"replicated\":%d,\"partitioned\":%d,\"diverged\":%d,"
       "\"fwd_ok\":%lld,\"fwd_peer_down\":%lld,\"fwd_refused\":%lld,"
       "\"repl_syncs_served\":%lld,\"mirror_applies\":%lld,"
+      "\"leases\":%lld,\"leases_expired\":%lld,"
       "\"acc_deduped\":%lld,\"acc_dropped\":%lld,"
       "\"gq_deduped\":%lld,\"gq_dropped\":%lld}",
       s->shard_id, s->shard_count,
@@ -833,6 +931,9 @@ std::string build_stats_json(Server* s) {
           s->repl_syncs_served.load(std::memory_order_relaxed)),
       static_cast<long long>(
           s->mirror_applies.load(std::memory_order_relaxed)),
+      static_cast<long long>(n_leases),
+      static_cast<long long>(
+          s->leases_expired.load(std::memory_order_relaxed)),
       static_cast<long long>(acc_ded), static_cast<long long>(acc_drop),
       static_cast<long long>(gq_ded), static_cast<long long>(gq_drop));
   if (n < 0 || n >= static_cast<int>(sizeof(buf))) return "{}";
@@ -893,11 +994,18 @@ void serve_conn_impl(Server* s, int fd) {
     // STATS), every reconnect probes identity — not of training
     // progress.  Observation (and re-dialing) must not perturb the
     // observed trigger; state/service traffic alone advances it.
+    // Lease ops (r14) are excluded for the same reason: heartbeats and
+    // membership scrapes fire on WALL-CLOCK cadence, not training
+    // progress, so counting them would make every ``after_reqs`` trigger
+    // drift with the heartbeat period.
     switch (op) {
       case HELLO:
       case INCARNATION:
       case REPL_TOKEN:
       case STATS:
+      case LEASE_ACQUIRE:
+      case LEASE_RELEASE:
+      case LEASE_LIST:
         break;
       default:
         s->requests.fetch_add(1, std::memory_order_relaxed);
@@ -934,6 +1042,18 @@ void serve_conn_impl(Server* s, int fd) {
     if (op == STATS) {
       if (plen && !drain_n(fd, static_cast<size_t>(plen) * esize)) break;
       std::string js = build_stats_json(s);
+      js.resize((js.size() + 3) & ~size_t{3}, ' ');
+      if (!write_frame(fd, 0, static_cast<uint32_t>(js.size() / 4),
+                       js.data(), js.size()))
+        break;
+      continue;
+    }
+    // Membership scrape (r14): early-dispatched like STATS — the live set
+    // is a raw JSON blob (4-byte units, space-padded) that must bypass
+    // the dtype-encoded epilogue on every connection.
+    if (op == LEASE_LIST) {
+      if (plen && !drain_n(fd, static_cast<size_t>(plen) * esize)) break;
+      std::string js = build_lease_json(s);
       js.resize((js.size() + 3) & ~size_t{3}, ' ');
       if (!write_frame(fd, 0, static_cast<uint32_t>(js.size() / 4),
                        js.data(), js.size()))
@@ -1169,6 +1289,37 @@ void serve_conn_impl(Server* s, int fd) {
         // Dispatched BEFORE this switch too (raw JSON blob, bypassing
         // the dtype-encoded epilogue); label pinned for the same lint.
         break;
+      case LEASE_LIST:
+        // Dispatched BEFORE this switch (raw JSON blob, like STATS);
+        // label pinned for the wire-conformance lint.
+        break;
+      case LEASE_ACQUIRE: {
+        // a = ttl_ms.  1 = newly acquired (fresh member, or re-acquire
+        // after the old lease expired — the lapse signal), 2 = renewal.
+        if (a <= 0 || !lease_name_ok(name)) break;  // -2
+        const auto now = std::chrono::steady_clock::now();
+        std::lock_guard<std::mutex> lk(s->lease_mu);
+        prune_leases_locked(s, now);
+        auto it = s->leases.find(name);
+        if (it == s->leases.end()) {
+          s->leases.emplace(name,
+                            Lease{now + std::chrono::milliseconds(a), now, 0});
+          status = 1;
+        } else {
+          it->second.deadline = now + std::chrono::milliseconds(a);
+          ++it->second.renewals;
+          status = 2;
+        }
+        break;
+      }
+      case LEASE_RELEASE: {
+        // Clean departure; idempotent (1 released / 0 unknown).  A
+        // released lease does NOT count as expired — the churn counter
+        // distinguishes crashes from departures.
+        std::lock_guard<std::mutex> lk(s->lease_mu);
+        status = s->leases.erase(name) ? 1 : 0;
+        break;
+      }
       case CANCEL_ALL:
         cancel_all(s);
         status = 0;

@@ -103,6 +103,17 @@ class AsyncPSConfig:
     #: never prefetches: a pre-token snapshot would be guaranteed-stale and
     #: the staleness gate would starve the worker.
     ps_prefetch: bool = True
+    #: Cross-process mode only — membership leases (r14 elasticity): every
+    #: async worker (and serve replica) heartbeats a lease on the
+    #: coordinator shard, so the chief/data-service/dtxtop learn the LIVE
+    #: worker set from the registry instead of static ``--worker_hosts``
+    #: and a worker can join or leave mid-run with no restart of anything
+    #: else.  Degrades loudly to the static posture against a pre-r14 PS.
+    membership_leases: bool = True
+    #: Lease TTL: a member whose heartbeats stop for this long is treated
+    #: as departed (its splits reassigned, its lease pruned).  Renewals
+    #: run at ttl/3.
+    lease_ttl_s: float = 10.0
 
 
 class AsyncPSTrainer:
@@ -629,6 +640,15 @@ class RemotePSChief(AsyncPSTrainer):
                 shard, self.global_step - self.cfg.max_staleness
             )
 
+    def live_workers(self) -> list[dict]:
+        """The live async-worker set per the coordinator's lease registry
+        (r14) — the elastic replacement for counting ``--worker_hosts``.
+        Empty against a registry nobody heartbeats into (static clusters,
+        or ``membership_leases`` off)."""
+        from . import membership
+
+        return membership.live_members(self._group.coordinator, "worker")
+
     def _flat_params(self) -> np.ndarray:
         return np.concatenate(
             [np.asarray(l).reshape(-1) for l in jax.tree.leaves(self.params)]
@@ -1007,114 +1027,141 @@ def remote_worker_loop(
 
     pstore = ps_shard.ShardedParamStore(group, "params", layout)
     tq = ps_service.RemoteTokenQueue(client, "tokens")
+    # Membership (r14): announce this worker in the coordinator's lease
+    # registry and keep the lease renewed for the life of the loop — a
+    # worker started MID-RUN becomes visible to the chief/data-service/
+    # dtxtop within one heartbeat, and one that dies stops renewing and
+    # is pruned within one TTL (the elastic join/leave contract).
+    heartbeat = None
+    if cfg.membership_leases:
+        from . import membership
+
+        heartbeat = membership.LeaseHeartbeat(
+            group.replica_addrs[0], role, kind="worker",
+            ttl_s=cfg.lease_ttl_s, role=role,
+            op_timeout_s=cfg.ps_op_timeout_s,
+            reconnect_deadline_s=cfg.ps_reconnect_deadline_s,
+        )
+        # A ``leave`` fault (graceful departure) releases the lease on
+        # its way out, so the registry records a departure, not a lapse.
+        faults.register_leave_hook(heartbeat.close)
     prefetcher = None
     gq = None
-    if cfg.mode == "sync_replicas":
-        acc = ps_shard.ShardedAccumulator(group, "acc", layout)
-        push_ms_src = acc
-    else:
-        gq = ps_shard.ShardedGradientQueue(
-            group, "gq", layout, capacity=max(4, 2 * cfg.num_workers)
-        )
-        push_ms_src = gq
-        if cfg.ps_prefetch:
-            # Async only: double-buffer the pull on dedicated connections
-            # (one per shard) so the next snapshot streams while this
-            # step's gradient computes.  Distinct fault role ("<role>_pf",
-            # shard i > 0 appending "_s<i>") so plans can target the
-            # prefetch connections specifically; "worker*" globs still
-            # match both.
-            pf_group = ps_shard.ShardedPSClients(
-                addrs, role=f"{role}_pf", replicas=ps_replicas,
-                layout_version=layout_version, **client_kw
-            )
-            pf_store = ps_shard.ShardedParamStore(pf_group, "params", layout)
-            prefetcher = ParamPrefetcher(
-                pf_group, pf_store,
-                wait_budget_s=max(cfg.ps_reconnect_deadline_s, 5.0),
-            )
-            pstore_timing = pf_store  # pulls run on the prefetch store
-    if prefetcher is None:
-        pstore_timing = pstore
-    writer = MetricsWriter(metrics_dir) if metrics_dir else None
-    model_state = model_state if model_state is not None else {}
-    rng = rng if rng is not None else jax.random.key(0)
-
-    def _grad(params, model_state, batch, rng):
-        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, model_state, batch, rng
-        )
-        return loss, grads
-
-    grad_fn = jax.jit(_grad)
-
-    def await_params():
-        return _await_published(pstore, max(cfg.ps_reconnect_deadline_s, 5.0))
-
+    writer = None
     contributed = 0
-    it = 0
-    while True:
-        # EVERY remote call is inside the guard: the chief exiting (socket
-        # closed mid-recv) must end the worker cleanly, not crash it.
-        try:
-            if cfg.mode == "sync_replicas":
-                token = tq.pop()
-                if token is None:
-                    break
-                local_step = token
-                got = await_params()
-            else:
-                got = prefetcher.get() if prefetcher else await_params()
-            if got is None:
-                log.warning("worker %d: no republished params; exiting", wid)
-                break
-            step, flat = got
-            if cfg.mode != "sync_replicas":
-                if step >= cfg.train_steps:
-                    break
-                local_step = max(step, 0)
-                if prefetcher:
-                    # Overlap the NEXT pull with this step's gradient
-                    # compute (the communication/compute overlap the
-                    # transport fast path exists for).
-                    prefetcher.kick()
-        except (RuntimeError, ConnectionError, OSError):
-            break
-        params = unflatten(flat)
-        try:
-            batch = next(batches)
-        except StopIteration:
-            break
-        r = jax.random.fold_in(jax.random.fold_in(rng, wid), it)
-        _, grads = grad_fn(params, model_state, batch, r)
-        flat_g = np.concatenate(
-            [np.asarray(g).reshape(-1) for g in jax.tree.leaves(grads)]
-        ).astype(np.float32)
-        try:
-            if cfg.mode == "sync_replicas":
-                acc.apply(local_step, flat_g)
-            else:
-                pushed = gq.push(local_step, flat_g)
-                if pushed is None:
-                    break  # cancelled: the chief is done or failed
-        except (RuntimeError, ConnectionError, OSError):
-            break  # chief finished and tore the service down
-        contributed += 1
-        it += 1
-        if writer is not None and contributed % max(1, metrics_every) == 0:
-            # Per-shard transport wall times (r9 satellite): shard
-            # imbalance — one slow/hot shard server — shows up as one
-            # ps/*_ms_shard<i> series running away from the others.
-            writer.scalars(
-                local_step,
-                {
-                    **metrics.shard_scalars("pull", pstore_timing.last_pull_ms),
-                    **metrics.shard_scalars("push", push_ms_src.last_push_ms),
-                },
+    # Everything below runs under one finally: an exception anywhere
+    # (a ctor op against a failing PS, a terminal PSDeadlineError in
+    # the loop) must still release the lease — a leaked heartbeat
+    # would advertise a dead worker as live forever.
+    try:
+        if cfg.mode == "sync_replicas":
+            acc = ps_shard.ShardedAccumulator(group, "acc", layout)
+            push_ms_src = acc
+        else:
+            gq = ps_shard.ShardedGradientQueue(
+                group, "gq", layout, capacity=max(4, 2 * cfg.num_workers)
             )
-    if writer is not None:
-        writer.close()
-    if prefetcher is not None:
-        prefetcher.close()
-    group.close()
+            push_ms_src = gq
+            if cfg.ps_prefetch:
+                # Async only: double-buffer the pull on dedicated connections
+                # (one per shard) so the next snapshot streams while this
+                # step's gradient computes.  Distinct fault role ("<role>_pf",
+                # shard i > 0 appending "_s<i>") so plans can target the
+                # prefetch connections specifically; "worker*" globs still
+                # match both.
+                pf_group = ps_shard.ShardedPSClients(
+                    addrs, role=f"{role}_pf", replicas=ps_replicas,
+                    layout_version=layout_version, **client_kw
+                )
+                pf_store = ps_shard.ShardedParamStore(pf_group, "params", layout)
+                prefetcher = ParamPrefetcher(
+                    pf_group, pf_store,
+                    wait_budget_s=max(cfg.ps_reconnect_deadline_s, 5.0),
+                )
+                pstore_timing = pf_store  # pulls run on the prefetch store
+        if prefetcher is None:
+            pstore_timing = pstore
+        writer = MetricsWriter(metrics_dir) if metrics_dir else None
+        model_state = model_state if model_state is not None else {}
+        rng = rng if rng is not None else jax.random.key(0)
+
+        def _grad(params, model_state, batch, rng):
+            (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, model_state, batch, rng
+            )
+            return loss, grads
+
+        grad_fn = jax.jit(_grad)
+
+        def await_params():
+            return _await_published(pstore, max(cfg.ps_reconnect_deadline_s, 5.0))
+
+        it = 0
+        while True:
+            # EVERY remote call is inside the guard: the chief exiting (socket
+            # closed mid-recv) must end the worker cleanly, not crash it.
+            try:
+                if cfg.mode == "sync_replicas":
+                    token = tq.pop()
+                    if token is None:
+                        break
+                    local_step = token
+                    got = await_params()
+                else:
+                    got = prefetcher.get() if prefetcher else await_params()
+                if got is None:
+                    log.warning("worker %d: no republished params; exiting", wid)
+                    break
+                step, flat = got
+                if cfg.mode != "sync_replicas":
+                    if step >= cfg.train_steps:
+                        break
+                    local_step = max(step, 0)
+                    if prefetcher:
+                        # Overlap the NEXT pull with this step's gradient
+                        # compute (the communication/compute overlap the
+                        # transport fast path exists for).
+                        prefetcher.kick()
+            except (RuntimeError, ConnectionError, OSError):
+                break
+            params = unflatten(flat)
+            try:
+                batch = next(batches)
+            except StopIteration:
+                break
+            r = jax.random.fold_in(jax.random.fold_in(rng, wid), it)
+            _, grads = grad_fn(params, model_state, batch, r)
+            flat_g = np.concatenate(
+                [np.asarray(g).reshape(-1) for g in jax.tree.leaves(grads)]
+            ).astype(np.float32)
+            try:
+                if cfg.mode == "sync_replicas":
+                    acc.apply(local_step, flat_g)
+                else:
+                    pushed = gq.push(local_step, flat_g)
+                    if pushed is None:
+                        break  # cancelled: the chief is done or failed
+            except (RuntimeError, ConnectionError, OSError):
+                break  # chief finished and tore the service down
+            contributed += 1
+            it += 1
+            if writer is not None and contributed % max(1, metrics_every) == 0:
+                # Per-shard transport wall times (r9 satellite): shard
+                # imbalance — one slow/hot shard server — shows up as one
+                # ps/*_ms_shard<i> series running away from the others.
+                writer.scalars(
+                    local_step,
+                    {
+                        **metrics.shard_scalars("pull", pstore_timing.last_pull_ms),
+                        **metrics.shard_scalars("push", push_ms_src.last_push_ms),
+                    },
+                )
+    finally:
+        if writer is not None:
+            writer.close()
+        if prefetcher is not None:
+            prefetcher.close()
+        if heartbeat is not None:
+            heartbeat.close()  # releases the lease: the clean leave signal
+        group.close()
     return contributed

@@ -1,0 +1,368 @@
+"""Membership leases: the elastic-cluster live set (r14).
+
+The reference's cluster is STATIC — ``--worker_hosts`` is fixed at launch
+and a process set change means a full restart (the TensorFlow paper's
+dynamic-cluster story, PAPERS.md arxiv 1605.08695, is the capability this
+module adds; the tf.data-service elasticity argument, arxiv 2210.14826,
+applies it to every role, not just input workers).  Here the COORDINATOR
+PS shard hosts a lease registry (``wire.PS_OPS`` ``LEASE_*`` ops, served
+by ``native/ps_server.cc``): every elastic member — async worker, serve
+replica — ACQUIREs a lease naming itself and renews it on a heartbeat, so
+the chief, the data service and ``tools/dtxtop.py`` learn the live set
+from the registry instead of static flags:
+
+- a worker started MID-RUN acquires a lease, pulls the current params and
+  contributes gradients with no restart of anything else (its dedup
+  stream is announced via the existing ``*_RESET_WORKER`` ops);
+- an EXPIRED lease (member died without releasing) is the membership-
+  level stale signal: :class:`LeaseWatcher` surfaces it so the data
+  service can reassign the member's in-flight splits immediately instead
+  of waiting out its own liveness window, while the member's in-flight
+  gradient pushes stay dedup-safe/staleness-dropped exactly as before
+  (at-most-once, nothing new to clean up);
+- a RELEASED lease is the clean-departure signal (``leave`` semantics) —
+  counted separately from expiry, so churn dashboards can tell crashes
+  from scale-down.
+
+Leases are liveness state, deliberately NOT replicated (not forwarded,
+not in the REPL_SYNC blob): after a PS failover the next heartbeat
+re-acquires on the survivor within one TTL — the same self-healing
+posture as step tokens.
+
+Fault-plan role: membership connections run under ``<role>_lm`` so
+``DTX_FAULT_PLAN`` specs can target the heartbeat/watcher legs without
+firing on a process's data-path clients (the ``_pf``/``_ds``/``_sv``
+convention; see tests/test_faults.py for the matrix run).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..utils import faults, telemetry
+from . import ps_service
+
+#: LEASE_ACQUIRE statuses (native/ps_server.cc contract).
+LEASE_NEW = 1  # newly acquired — fresh member, or re-acquire after expiry
+LEASE_RENEWED = 2  # renewal of a live lease
+
+#: Field separator inside the packed member string.  The server treats the
+#: whole string as opaque; only this module assigns it structure.
+_SEP = "|"
+
+_OBS_RENEWALS = telemetry.REGISTRY.counter("membership/renewals")
+_OBS_LAPSES = telemetry.REGISTRY.counter("membership/lapses")
+_OBS_HB_ERRORS = telemetry.REGISTRY.counter("membership/heartbeat_errors")
+
+
+def pack_member(member: str, kind: str = "", addr: str = "") -> str:
+    """The wire form of a member identity: ``member|kind|addr``.  ``kind``
+    is the role family (``worker``, ``serve``, ...); ``addr`` is the
+    member's dialable ``host:port`` when it serves one ('' for pure
+    clients like workers).  Fields must be printable ASCII without
+    ``|``/``"``/``\\`` — the server emits the string into LEASE_LIST JSON
+    verbatim, so a malformed identity must fail HERE, loudly."""
+    for field, what in ((member, "member"), (kind, "kind"), (addr, "addr")):
+        # isprintable() additionally rejects control bytes (\n, \t, NUL —
+        # e.g. a role leaked from a shell with a trailing newline): the
+        # server would refuse them with the same -2 a pre-r14 server
+        # answers, and the heartbeat would misdiagnose a version mismatch.
+        if (
+            any(c in field for c in (_SEP, '"', "\\"))
+            or not field.isascii()
+            or not field.isprintable()
+        ):
+            raise ValueError(
+                f"lease {what} {field!r} must be printable ASCII without "
+                f"{_SEP!r}, quotes or backslashes"
+            )
+    if not member:
+        raise ValueError("lease member id must be non-empty")
+    packed = f"{member}{_SEP}{kind}{_SEP}{addr}"
+    if len(packed) > 200:
+        # The server refuses oversized names with the same -2 a pre-r14
+        # server answers — fail HERE instead, with the real reason.
+        raise ValueError(
+            f"packed member identity is {len(packed)} bytes (> 200): "
+            f"{packed[:60]!r}…"
+        )
+    return packed
+
+
+def member_index(member: str) -> int | None:
+    """The numeric task index off a member id's TRAILING digit run
+    (``worker3`` -> 3, ``w2-worker13`` -> 13; None without one) — the ONE
+    member-id-to-worker-index inverse every consumer (the data service's
+    lease watcher, loadsim's join scheduler) uses."""
+    i = len(member)
+    while i > 0 and member[i - 1].isdigit():
+        i -= 1
+    return int(member[i:]) if i < len(member) else None
+
+
+def unpack_addr(addr: str) -> tuple[str, int] | None:
+    """Decode a member's dialable ``host:port`` into an address tuple
+    (None when the member carries no valid address) — the ONE inverse of
+    the ``addr`` field every discovery consumer uses."""
+    host, _, port_s = addr.rpartition(":")
+    if host and port_s.isdigit():
+        return host, int(port_s)
+    return None
+
+
+def coordinator_addrs(
+    ps_addrs, num_shards: int, num_replicas: int = 1,
+) -> list[tuple[str, int]]:
+    """The COORDINATOR shard's replica address list out of a replica-major
+    ``--ps_hosts`` list (replica r of shard 0 = entry ``r * num_shards``)
+    — the only servers that host the lease registry."""
+    ps_addrs = list(ps_addrs)
+    n = max(1, int(num_shards))
+    return [
+        ps_addrs[r * n]
+        for r in range(max(1, int(num_replicas)))
+        if r * n < len(ps_addrs)
+    ]
+
+
+def unpack_member(name: str) -> dict:
+    """Inverse of :func:`pack_member`; tolerates a bare (unstructured)
+    member string from foreign acquirers."""
+    parts = name.split(_SEP)
+    return {
+        "member": parts[0],
+        "kind": parts[1] if len(parts) > 1 else "",
+        "addr": parts[2] if len(parts) > 2 else "",
+    }
+
+
+def parse_leases(doc: dict, kind: str | None = None) -> list[dict]:
+    """The parsed live set from a ``PSClient.lease_list()`` document:
+    member identity fields plus the registry's ttl/age/renewal numbers,
+    optionally filtered to one role family."""
+    out = []
+    for entry in doc.get("leases", []):
+        m = unpack_member(entry.get("m", ""))
+        if kind is not None and m["kind"] != kind:
+            continue
+        m.update(
+            ttl_ms=int(entry.get("ttl_ms", 0)),
+            age_ms=int(entry.get("age_ms", 0)),
+            renewals=int(entry.get("renewals", 0)),
+        )
+        out.append(m)
+    return out
+
+
+def live_members(client: ps_service.PSClient, kind: str | None = None) -> list[dict]:
+    """One registry scrape over an existing client."""
+    return parse_leases(client.lease_list(), kind)
+
+
+def membership_role(role: str | None = None) -> str:
+    """The fault role membership connections run under: ``<role>_lm``."""
+    return (role or faults.current_role() or "member") + "_lm"
+
+
+class LeaseHeartbeat:
+    """Owns one membership connection to the coordinator shard and renews
+    this member's lease every ``ttl_s / 3`` (so two missed heartbeats
+    still keep the lease alive).
+
+    Contract:
+
+    - the FIRST acquire runs in the constructor (bounded by the client's
+      own deadlines), so a member is visible in the registry before it
+      starts contributing;
+    - a pre-r14 coordinator (LEASE ops answer -2) DISABLES the heartbeat
+      loudly (one log line; ``enabled`` False) instead of failing the
+      member — elasticity degrades to the static posture, nothing else
+      changes;
+    - a renewal answered ``LEASE_NEW`` means the lease LAPSED between
+      heartbeats (PS outage past the TTL, or a failover that lost the
+      volatile registry): counted in ``lapses`` and re-acquired — the
+      member may have been treated as departed meanwhile (splits
+      reassigned), which the dedup/staleness machinery makes harmless;
+    - transient transport faults heal inside the owned ``PSClient``;
+      terminal errors (budget exhausted) are counted and retried next
+      tick — membership must never take the member down;
+    - ``close()`` RELEASES the lease (best effort, fail-fast): the clean
+      ``leave`` signal, distinguishable from expiry in the registry's
+      churn counters.
+    """
+
+    def __init__(
+        self,
+        addrs: list[tuple[str, int]],
+        member: str,
+        *,
+        kind: str = "",
+        addr: str = "",
+        ttl_s: float = 10.0,
+        role: str | None = None,
+        op_timeout_s: float | None = 5.0,
+        reconnect_deadline_s: float = 30.0,
+    ):
+        self.name = pack_member(member, kind, addr)
+        self.member = member
+        self.ttl_s = max(0.3, float(ttl_s))
+        self.role = membership_role(role)
+        self.enabled = True
+        self.renewals = 0
+        self.lapses = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._client = ps_service.PSClient(
+            addrs[0][0], addrs[0][1], op_timeout_s=op_timeout_s,
+            reconnect_deadline_s=reconnect_deadline_s, role=self.role,
+            addrs=list(addrs) if len(addrs) > 1 else None,
+        )
+        try:
+            self._client.lease_acquire(self.name, self.ttl_s)
+        except ps_service.PSDeadlineError:
+            # Coordinator merely UNREACHABLE right now (e.g. mid-failover
+            # while this member restarts): keep the heartbeat running —
+            # the next tick retries and acquires once the PS is back.  A
+            # transient outage must never permanently hide the member.
+            self.errors += 1
+            _OBS_HB_ERRORS.inc()
+        except ps_service.PSError:
+            # Genuine rejection (-2): pre-r14 coordinator — static
+            # membership, loudly.
+            self.enabled = False
+            faults.log_event(
+                "lease_disabled", role=self.role, member=member,
+                reason="coordinator_rejects_lease_ops",
+            )
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"dtx-lease-{member}"
+        )
+        if self.enabled:
+            self._thread.start()
+
+    def _loop(self) -> None:
+        period = self.ttl_s / 3.0
+        while not self._stop.wait(period):
+            try:
+                status = self._client.lease_acquire(self.name, self.ttl_s)
+            except (ps_service.PSError, OSError):
+                self.errors += 1
+                _OBS_HB_ERRORS.inc()
+                continue
+            self.renewals += 1
+            _OBS_RENEWALS.inc()
+            if status == LEASE_NEW:
+                # The lease lapsed between heartbeats — the registry (or
+                # the whole coordinator) lost us and we just rejoined.
+                self.lapses += 1
+                _OBS_LAPSES.inc()
+                faults.log_event(
+                    "lease_lapsed_reacquired", role=self.role,
+                    member=self.member,
+                )
+
+    def close(self) -> None:
+        """Stop heartbeating and RELEASE the lease (clean departure)."""
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=self.ttl_s)
+        self._client.fail_fast()
+        if self.enabled:
+            try:
+                self._client.lease_release(self.name)
+            except (ps_service.PSError, OSError):
+                pass  # the TTL expires us; departure degrades to a lapse
+        self._client.close()
+
+
+class LeaseWatcher:
+    """Polls the lease registry and surfaces membership TRANSITIONS:
+    ``on_join(member_dict)`` for a member that appeared, ``on_leave
+    (member_dict)`` for one that disappeared (expired OR released).  The
+    data service uses the leave edge to reassign a departed worker's
+    in-flight splits immediately; dtxtop uses the live set to discover
+    dynamically-joined roles.  Scrape failures are tolerated (the
+    registry may be failing over): no transition is synthesized from a
+    failed poll — a missing answer is not evidence of a missing member."""
+
+    def __init__(
+        self,
+        addrs: list[tuple[str, int]],
+        *,
+        kind: str | None = None,
+        poll_s: float = 1.0,
+        on_join=None,
+        on_leave=None,
+        role: str | None = None,
+        op_timeout_s: float | None = 5.0,
+        reconnect_deadline_s: float = 10.0,
+    ):
+        self.kind = kind
+        self.poll_s = max(0.05, float(poll_s))
+        self.on_join = on_join
+        self.on_leave = on_leave
+        self.role = membership_role(role)
+        self.joins_seen = 0
+        self.leaves_seen = 0
+        self.poll_errors = 0
+        self._known: dict[str, dict] = {}
+        self._stop = threading.Event()
+        # A positive reconnect budget is load-bearing: a fail-fast client
+        # would never redial after the first coordinator drop (a PS
+        # restart is routine) and the watcher would silently stop
+        # tracking membership for the rest of the run.
+        self._client = ps_service.PSClient(
+            addrs[0][0], addrs[0][1], op_timeout_s=op_timeout_s,
+            reconnect_deadline_s=max(0.1, reconnect_deadline_s),
+            role=self.role,
+            addrs=list(addrs) if len(addrs) > 1 else None,
+        )
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="dtx-lease-watch"
+        )
+        self._thread.start()
+
+    def members(self) -> list[dict]:
+        """The last successfully scraped live set."""
+        return list(self._known.values())
+
+    def poll_once(self) -> None:
+        """One scrape + transition dispatch (the loop body; callable from
+        tests for deterministic sequencing)."""
+        try:
+            live = {
+                m["member"]: m
+                for m in live_members(self._client, self.kind)
+            }
+        except (ps_service.PSError, OSError):
+            self.poll_errors += 1
+            return
+        prev, self._known = self._known, live  # callbacks see the NEW set
+        joined = [m for n, m in live.items() if n not in prev]
+        left = [m for n, m in prev.items() if n not in live]
+        for m in joined:
+            self.joins_seen += 1
+            faults.log_event(
+                "member_joined", role=self.role, member=m["member"],
+                kind=m["kind"],
+            )
+            if self.on_join is not None:
+                self.on_join(m)
+        for m in left:
+            self.leaves_seen += 1
+            faults.log_event(
+                "member_left", role=self.role, member=m["member"],
+                kind=m["kind"],
+            )
+            if self.on_leave is not None:
+                self.on_leave(m)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            self.poll_once()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._client.close()

@@ -115,6 +115,9 @@ _PSTORE_GET_IF_NEWER = wire.PS_OPS["PSTORE_GET_IF_NEWER"]
 _REPL_SYNC = wire.PS_OPS["REPL_SYNC"]
 _REPL_TOKEN = wire.PS_OPS["REPL_TOKEN"]
 _STATS = wire.PS_OPS["STATS"]
+_LEASE_ACQUIRE = wire.PS_OPS["LEASE_ACQUIRE"]
+_LEASE_RELEASE = wire.PS_OPS["LEASE_RELEASE"]
+_LEASE_LIST = wire.PS_OPS["LEASE_LIST"]
 
 # Client-side observability (r13 dtxobs): every PSClient in the process
 # accumulates into these process-wide instruments — cached handles, so the
@@ -955,6 +958,45 @@ class PSClient:
             raise PSError(
                 f"PS at {self._host}:{self._port} does not answer STATS "
                 f"(status {status}; pre-r13 server?)"
+            )
+        return json.loads(bytes(blob).decode())
+
+    # -- membership leases (r14) --------------------------------------------
+
+    def lease_acquire(self, name: str, ttl_s: float) -> int:
+        """Acquire-or-renew the lease ``name`` (an opaque member string —
+        see ``parallel.membership.pack_member``) for ``ttl_s`` seconds.
+        Returns 1 when NEWLY acquired — a fresh member, or a re-acquire
+        after the previous lease EXPIRED (the lapse signal a heartbeat
+        watches for) — or 2 on a renewal of a live lease.  Replay-safe:
+        a replayed acquire just renews again.  A pre-r14 server answers
+        -2, surfaced as PSError so callers can degrade loudly."""
+        status, _ = self.call(_LEASE_ACQUIRE, name, int(ttl_s * 1000))
+        if status < 0:
+            raise PSError(
+                f"lease acquire {name!r} rejected ({status}); pre-r14 "
+                "server, or a malformed member string"
+            )
+        return status
+
+    def lease_release(self, name: str) -> bool:
+        """Clean departure: drop the lease NOW instead of waiting out the
+        TTL.  Idempotent; True when a live lease was released."""
+        status, _ = self.call(_LEASE_RELEASE, name)
+        if status < 0:
+            raise PSError(f"lease release {name!r} rejected ({status})")
+        return status == 1
+
+    def lease_list(self) -> dict:
+        """The coordinator's live-member registry: ``{"leases": [{"m":
+        <member string>, "ttl_ms": ..., "age_ms": ..., "renewals": ...}],
+        "expired_total": N}`` — expired entries already pruned (and
+        counted) server-side.  Raw JSON blob like :meth:`stats`."""
+        status, blob = self.call(_LEASE_LIST, raw=True)
+        if status < 0 or not blob:
+            raise PSError(
+                f"PS at {self._host}:{self._port} does not answer "
+                f"LEASE_LIST (status {status}; pre-r14 server?)"
             )
         return json.loads(bytes(blob).decode())
 

@@ -118,6 +118,25 @@ PS_OPS: dict[str, int] = {
     # All three services carry a STATS op; code points stay disjoint so a
     # mis-wired scrape is refused, never misread.
     "STATS": 30,
+    # Membership leases (r14 elasticity).  The coordinator shard hosts a
+    # LEASE REGISTRY: every elastic member (async worker, serve replica)
+    # ACQUIREs a lease naming itself and renews it on a heartbeat, so the
+    # chief, the data service and dtxtop learn the LIVE set from the
+    # registry instead of static --worker_hosts.  LEASE_ACQUIRE: name =
+    # the member string (``membership.pack_member``), a = ttl_ms; answers
+    # 1 (newly acquired — including a re-acquire after the old lease
+    # EXPIRED, so a renewing client learns it lapsed) or 2 (renewal of a
+    # live lease).  LEASE_RELEASE: the clean-departure signal (1 released
+    # / 0 unknown, idempotent).  LEASE_LIST: the live set as one raw JSON
+    # blob (4-byte units, dtype-independent, like STATS) — expired
+    # entries are pruned at list time and counted.  Leases are liveness
+    # state, deliberately NOT replicated (not forwarded, not in the
+    # REPL_SYNC blob): after a failover the next heartbeat re-acquires on
+    # the survivor within one TTL, the same self-healing posture as
+    # tokens.
+    "LEASE_ACQUIRE": 31,
+    "LEASE_RELEASE": 32,
+    "LEASE_LIST": 33,
 }
 
 #: Data-service op codes (data/data_service.py).  Disjoint from the PS

@@ -14,9 +14,19 @@ params to any consumer" substrate, applied to serving:
   control.
 - ``client`` — :class:`ServeClient` (deadlines / backoff reconnect /
   ``<role>_sv`` fault injection) and :class:`ServePool` (round-robin over
-  N replicas with unhealthy-replica ejection).
+  N replicas with unhealthy-replica ejection; ``set_addrs`` reconciles an
+  elastic membership list).
+- ``autoscale`` (r14) — :class:`ServeAutoscaler` grows/shrinks an
+  in-process replica set against measured queue depth / p99, and
+  :class:`LeaseServeDiscovery` follows the membership lease registry so
+  pools track an elastic replica set with no static flags.
 """
 
+from .autoscale import (  # noqa: F401
+    LeaseServeDiscovery,
+    ServeAutoscaler,
+    make_replica_factory,
+)
 from .batcher import DynamicBatcher, Overloaded  # noqa: F401
 from .client import (  # noqa: F401
     ServeClient,

@@ -283,6 +283,8 @@ class ServePool:
 
     def _eject(self, i: int, for_s: float) -> None:
         with self._lock:
+            if i >= len(self.addrs):
+                return  # set_addrs shrank the pool under this request
             self._eject_until[i] = time.monotonic() + for_s
             self.ejections += 1
             c, self._clients[i] = self._clients[i], None
@@ -353,6 +355,12 @@ class ServePool:
                 # enough to drain, short enough to rejoin promptly.
                 last_err = e
                 self._eject(i, min(self._eject_s, 0.25))
+            except IndexError:
+                # set_addrs() shrank the pool between _pick and use (an
+                # elastic scale-down racing this request): the index is
+                # simply stale — re-pick against the new rotation, never
+                # fail the logical predict.
+                continue
             except (ServeError, OSError, ConnectionError) as e:
                 last_err = e
                 self._eject(i, self._eject_s)
@@ -363,6 +371,40 @@ class ServePool:
         raise ServeDeadlineError(
             f"no replica answered within {self._deadline:.0f}s "
             f"(last error: {last_err!r})"
+        )
+
+    def set_addrs(self, addrs: list[tuple[str, int]]) -> None:
+        """Reconcile the replica set against an ELASTIC membership list
+        (r14): addresses that remain keep their client and ejection
+        state; removed replicas' clients close (an in-flight predict on
+        one fails its attempt and retries on a peer — predict is pure, so
+        a scale-down never fails a logical request); new replicas join
+        the rotation un-ejected.  No-op when nothing changed."""
+        addrs = list(addrs)
+        if not addrs:
+            raise ValueError("need at least one replica address")
+        stale: list[ServeClient] = []
+        with self._lock:
+            if addrs == self.addrs:
+                return
+            keep_clients = dict(zip(self.addrs, self._clients))
+            keep_eject = dict(zip(self.addrs, self._eject_until))
+            stale = [
+                c
+                for a, c in keep_clients.items()
+                if c is not None and a not in addrs
+            ]
+            self.addrs = addrs
+            self._clients = [keep_clients.get(a) for a in addrs]
+            self._eject_until = [keep_eject.get(a, 0.0) for a in addrs]
+            self._rr %= len(addrs)
+        for c in stale:
+            try:
+                c.close()
+            except Exception:
+                pass
+        faults.log_event(
+            "serve_pool_resized", role=self.role, replicas=len(addrs),
         )
 
     def stats(self, i: int) -> dict:

@@ -112,6 +112,8 @@ class ModelReplicaServer:
         refresh_ms: float = 50.0, op_timeout_s: float | None = 10.0,
         reconnect_deadline_s: float = 60.0, role: str | None = None,
         metrics_dir: str | None = None, metrics_every: int = 100,
+        membership: bool = True, lease_ttl_s: float = 10.0,
+        advertise_addr: str | None = None,
     ):
         import jax
 
@@ -168,6 +170,21 @@ class ModelReplicaServer:
                 time.sleep(0.2)
         self._listener.listen(64)
         self.port = self._listener.getsockname()[1]
+        # Membership (r14): announce this replica — WITH its dialable
+        # address — in the coordinator's lease registry, so an elastic
+        # serve pool (and dtxtop) discovers dynamically-started replicas
+        # from the registry instead of a static --serve_hosts list.
+        self._heartbeat = None
+        if membership:
+            from ..parallel import membership as membership_lib
+
+            self._heartbeat = membership_lib.LeaseHeartbeat(
+                self._group.replica_addrs[0], self.role, kind="serve",
+                addr=advertise_addr or f"127.0.0.1:{self.port}",
+                ttl_s=lease_ttl_s, role=self.role,
+                op_timeout_s=op_timeout_s,
+                reconnect_deadline_s=reconnect_deadline_s,
+            )
         self._refresher = threading.Thread(
             target=self._refresh_loop, daemon=True, name="msrv-refresh"
         )
@@ -207,6 +224,14 @@ class ModelReplicaServer:
         return True
 
     def stop(self) -> None:
+        # Release the membership lease FIRST: discovery must drop this
+        # replica from every pool rotation before the listener goes dark,
+        # so a scale-down/stop never routes predicts at a dead port for
+        # the thread-join window below (the zero-failed-requests drain
+        # ordering autoscale.scale_down documents).
+        if self._heartbeat is not None:
+            self._heartbeat.close()
+            self._heartbeat = None
         self._stop.set()
         # shutdown() BEFORE close(): close alone does not free the port
         # while the accept thread is blocked in accept() (same reasoning as
@@ -324,6 +349,9 @@ class ModelReplicaServer:
                 "refreshes": self._refreshes,
                 "refresh_errors": self._refresh_errors,
                 "ps_shards": self._group.num_shards,
+                "leased": bool(
+                    self._heartbeat is not None and self._heartbeat.enabled
+                ),
             }
         s.update({f"batcher_{k}": v for k, v in b.items()})
         s.update(self.latency.percentile_scalars("serve"))
@@ -480,6 +508,8 @@ def host_serve_task(
     max_batch: int = 32, max_wait_ms: float = 5.0, queue_depth: int = 128,
     refresh_ms: float = 50.0, op_timeout_s: float | None = 10.0,
     reconnect_deadline_s: float = 60.0, metrics_dir: str | None = None,
+    membership: bool = True, lease_ttl_s: float = 10.0,
+    advertise_addr: str | None = None,
 ) -> int:
     """Dedicated serve-task body (``--job_name=serve``): host one replica
     until a client signals SRV_SHUTDOWN (or the supervisor dies).  Arms
@@ -493,8 +523,13 @@ def host_serve_task(
         max_wait_ms=max_wait_ms, queue_depth=queue_depth,
         refresh_ms=refresh_ms, op_timeout_s=op_timeout_s,
         reconnect_deadline_s=reconnect_deadline_s, metrics_dir=metrics_dir,
+        membership=membership, lease_ttl_s=lease_ttl_s,
+        advertise_addr=advertise_addr,
     )
-    faults.arm_process_faults(request_count_fn=server.request_count)
+    faults.arm_process_faults(
+        request_count_fn=server.request_count,
+        leave_fn=lambda: server.stop(),
+    )
     if not server.wait_for_model(timeout_s=120.0):
         log.warning(
             "serve task: no published params after 120 s — serving NO_MODEL "

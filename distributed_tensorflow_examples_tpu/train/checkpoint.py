@@ -24,6 +24,40 @@ from .state import TrainState
 log = logging.getLogger("dtx.checkpoint")
 
 
+def _is_key(x: Any) -> bool:
+    """True for typed PRNG key arrays (``jax.random.key``), which Orbax
+    cannot serialize directly (their extended dtype has no numpy form)."""
+    try:
+        return jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key)
+    except (AttributeError, TypeError):
+        return False
+
+
+def keys_to_data(state: Any) -> Any:
+    """The storable form of a pytree: every typed PRNG key leaf replaced by
+    its raw counter data (``jax.random.key_data``).  Non-key leaves pass
+    through untouched."""
+    return jax.tree.map(
+        lambda x: jax.random.key_data(x) if _is_key(x) else x, state
+    )
+
+
+def data_to_keys(restored: Any, template: Any) -> Any:
+    """Inverse of :func:`keys_to_data`: leaves that are typed keys in
+    ``template`` are re-wrapped (``jax.random.wrap_key_data``) with the
+    template leaf's RNG impl, so the restored state round-trips to the
+    exact key type the trainer folds per step."""
+    return jax.tree.map(
+        lambda r, t: (
+            jax.random.wrap_key_data(r, impl=jax.random.key_impl(t))
+            if _is_key(t)
+            else r
+        ),
+        restored,
+        template,
+    )
+
+
 class CheckpointManager:
     """Thin policy wrapper over ``ocp.CheckpointManager``.
 
@@ -51,16 +85,22 @@ class CheckpointManager:
         step = int(step)
         if self._mgr.latest_step() == step:
             return False  # already saved this step (periodic + final overlap)
-        return self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
+        # Typed PRNG keys are stored as their raw key data (JAX's extended
+        # key dtype has no numpy/tensorstore form); restore re-wraps them.
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(keys_to_data(state)), force=force
+        )
 
     def restore_latest(self, template: TrainState) -> TrainState | None:
         step = self._mgr.latest_step()
         if step is None:
             return None
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+        abstract = jax.tree.map(
+            ocp.utils.to_shape_dtype_struct, keys_to_data(template)
+        )
         restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
         log.info("restored checkpoint at step %d", step)
-        return restored
+        return data_to_keys(restored, template)
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()

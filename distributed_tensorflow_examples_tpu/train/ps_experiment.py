@@ -185,6 +185,9 @@ def _ps_cfg(FLAGS, mode: str, n_workers: int):
         # --ps_wire_dtype fails the launch loudly.
         ps_wire_dtype=getattr(FLAGS, "ps_wire_dtype", "f32") or "f32",
         ps_prefetch=bool(getattr(FLAGS, "ps_prefetch", True)),
+        # r14 elasticity knobs (getattr for embedded callers, as above).
+        membership_leases=bool(getattr(FLAGS, "membership_leases", True)),
+        lease_ttl_s=float(getattr(FLAGS, "lease_ttl_s", 10.0) or 10.0),
     )
 
 
@@ -378,9 +381,23 @@ def run_ps_cluster_task(
             if rc != 0:
                 raise SystemExit(rc)
             return None
+        # Elasticity (r14): when the launch carries a PS topology, watch
+        # the coordinator shard's lease registry so a departed worker's
+        # splits reassign on the membership signal, not the liveness
+        # window.
+        lease_addrs = None
+        if getattr(FLAGS, "ps_hosts", "") and bool(
+            getattr(FLAGS, "membership_leases", True)
+        ):
+            from ..parallel.membership import coordinator_addrs
+            from ..utils.flags import ps_shard_topology
+
+            entries, n_shards, n_replicas = ps_shard_topology(FLAGS)
+            lease_addrs = coordinator_addrs(entries, n_shards, n_replicas)
         bound = dsvc_lib.host_data_service_task(
             FLAGS.data_dir, int(my_port), batch_size=local_bs,
             seed=FLAGS.seed, loopback_only=not listen_all,
+            ps_addrs=lease_addrs,
         )
         print(f"DSVC_DONE port={bound}")
         return None
@@ -447,6 +464,9 @@ def run_ps_cluster_task(
             max_wait_ms=float(getattr(FLAGS, "serve_max_wait_ms", 5.0)),
             queue_depth=int(getattr(FLAGS, "serve_queue_depth", 128)),
             refresh_ms=float(getattr(FLAGS, "serve_refresh_ms", 50.0)),
+            membership=bool(getattr(FLAGS, "membership_leases", True)),
+            lease_ttl_s=float(getattr(FLAGS, "lease_ttl_s", 10.0) or 10.0),
+            advertise_addr=f"{my_host}:{my_port}",
             metrics_dir=(
                 os.path.join(FLAGS.log_dir, f"serve{FLAGS.task_index}")
                 if getattr(FLAGS, "log_dir", None)

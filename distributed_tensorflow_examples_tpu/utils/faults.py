@@ -88,7 +88,15 @@ log = logging.getLogger("dtx.faults")
 FAULT_EXIT_CODE = 43
 
 _CLIENT_KINDS = ("drop_conn", "delay", "partition")
-_KINDS = _CLIENT_KINDS + ("die",)
+# Membership event kinds (r14 elasticity): ``leave`` — the matching
+# process departs GRACEFULLY (runs its registered leave hooks — release
+# the membership lease, stop the service — then exits 0, so a supervisor
+# treats it as done, not a crash to heal); ``join`` — an ORCHESTRATOR
+# event (only a process that can spawn new tasks can honor it): loadsim
+# reads matching specs via :func:`join_specs` and starts the named role at
+# ``after_s``; in-process arming skips it loudly.  Together with ``die``
+# they script a full kill/join/leave cycle per role.
+_KINDS = _CLIENT_KINDS + ("die", "leave", "join")
 
 _role_lock = threading.Lock()
 _role: str | None = None
@@ -146,8 +154,17 @@ def parse_plan(plan: str) -> list[FaultSpec]:
         if spec.kind in _CLIENT_KINDS and spec.kind != "partition" \
                 and spec.op <= 0:
             raise ValueError(f"{kind} fault needs op=<n> (1-based): {raw!r}")
-        if spec.kind == "die" and not (spec.after_s > 0 or spec.after_reqs > 0):
-            raise ValueError(f"die fault needs after_s or after_reqs: {raw!r}")
+        if spec.kind in ("die", "leave") and not (
+            spec.after_s > 0 or spec.after_reqs > 0
+        ):
+            raise ValueError(
+                f"{kind} fault needs after_s or after_reqs: {raw!r}"
+            )
+        if spec.kind == "join" and not spec.after_s > 0:
+            raise ValueError(
+                f"join event needs after_s (orchestrators schedule joins "
+                f"by wall time): {raw!r}"
+            )
         specs.append(spec)
     return specs
 
@@ -316,6 +333,52 @@ def client_injector(role: str | None = None) -> ClientFaultInjector | None:
     return inj if inj._specs else None
 
 
+def join_specs(plan: str, role: str | None = None) -> list[FaultSpec]:
+    """The plan's ``join`` events (optionally filtered by a role glob
+    match) — the ORCHESTRATOR's half of membership chaos: only a process
+    that can spawn cluster tasks (tools/loadsim.py) can honor a join, so
+    it reads them from here instead of :func:`arm_process_faults`."""
+    return [
+        s
+        for s in (parse_plan(plan) if plan else [])
+        if s.kind == "join" and (role is None or s.matches_role(role))
+    ]
+
+
+# Late-registered graceful-departure hooks (r14): a process arms its
+# ``leave`` specs before its services (and their membership leases) exist,
+# so the hooks are looked up at FIRE time.  Typical hooks: release the
+# lease, stop the server.  Run in reverse registration order, each
+# guarded — departure must not hang on a broken service.
+_leave_hooks: list = []
+
+
+def register_leave_hook(fn) -> None:
+    _leave_hooks.append(fn)
+
+
+def _leave(spec: FaultSpec, role: str, leave_fn=None, **fields) -> None:
+    log_event(
+        "inject_leave", role=role, spec=format_plan([spec]), **fields,
+    )
+    telemetry.dump_flight_recorder(f"inject_leave role={role}")
+    for fn in [leave_fn] + list(reversed(_leave_hooks)):
+        if fn is None:
+            continue
+        try:
+            fn()
+        except Exception:
+            pass
+    for h in log.handlers:
+        try:
+            h.flush()
+        except Exception:
+            pass
+    # Exit 0: a LEAVE is a clean departure — the supervisor (exit-0 =
+    # done) must not resurrect a member that scaled itself down.
+    os._exit(0)
+
+
 def _die(spec: FaultSpec, role: str, **fields) -> None:
     log_event(
         "inject_die", role=role, exit=FAULT_EXIT_CODE,
@@ -335,16 +398,20 @@ def _die(spec: FaultSpec, role: str, **fields) -> None:
 
 def arm_process_faults(
     role: str | None = None, *, request_count_fn=None, partition_fn=None,
+    leave_fn=None,
 ) -> list[threading.Thread]:
-    """Arm matching ``die`` (and process-shape ``partition``) specs for
-    this process.  ``after_s`` specs start a timer thread; ``after_reqs``
-    specs need ``request_count_fn`` (e.g.
+    """Arm matching ``die``/``leave`` (and process-shape ``partition``)
+    specs for this process.  ``after_s`` specs start a timer thread;
+    ``after_reqs`` specs need ``request_count_fn`` (e.g.
     ``ps_service.server_request_count`` in a PS task) and poll it.
     ``partition_fn(spec) -> bool`` is the service host's cut-the-link hook
     (a replicated PS task severs its repl link when the spec's ``peer``
     glob matches its peer's role); partition specs without timing fields
-    arm immediately.  Returns the watcher threads (daemonic; tests may
-    join on a dead process)."""
+    arm immediately.  ``leave_fn`` is the graceful-departure hook a
+    ``leave`` spec runs before exiting 0 (late hooks can also be added via
+    :func:`register_leave_hook`).  ``join`` specs are orchestrator events
+    (:func:`join_specs`) and are skipped here, loudly.  Returns the
+    watcher threads (daemonic; tests may join on a dead process)."""
     role = role if role is not None else current_role()
     raw = active_plan()
     if not raw:
@@ -405,13 +472,30 @@ def arm_process_faults(
             else:
                 fire_partition(spec)
             continue
-        if spec.kind != "die" or not spec.matches_role(role):
+        if spec.kind == "join" and spec.matches_role(role):
+            # Only an orchestrator (a process that can SPAWN cluster
+            # tasks) can honor a join — skip loudly, like an unarmable
+            # after_reqs trigger, so a plan wired to the wrong process is
+            # never silently inert.
+            log_event(
+                "fault_unarmed", role=role, kind="join",
+                reason="join_is_orchestrated",
+            )
             continue
+        if spec.kind not in ("die", "leave") or not spec.matches_role(role):
+            continue
+        fire = (
+            _die
+            if spec.kind == "die"
+            else lambda spec, role, **kw: _leave(
+                spec, role, leave_fn=leave_fn, **kw
+            )
+        )
         if spec.after_s > 0:
 
-            def timer(spec=spec):
+            def timer(spec=spec, fire=fire):
                 time.sleep(spec.after_s)
-                _die(spec, role, after_s=spec.after_s)
+                fire(spec, role, after_s=spec.after_s)
 
             t = threading.Thread(target=timer, daemon=True, name="dtx-fault-die")
             t.start()
@@ -422,16 +506,16 @@ def arm_process_faults(
                 # broad role glob (e.g. the '*' default) must not take down
                 # chief/worker tasks that merely match it — skip, loudly.
                 log_event(
-                    "fault_unarmed", role=role, kind="die",
+                    "fault_unarmed", role=role, kind=spec.kind,
                     reason="after_reqs_without_request_counter",
                 )
                 continue
 
-            def poller(spec=spec):
+            def poller(spec=spec, fire=fire):
                 while True:
                     n = request_count_fn()
                     if n >= spec.after_reqs:
-                        _die(spec, role, after_reqs=spec.after_reqs, reqs=n)
+                        fire(spec, role, after_reqs=spec.after_reqs, reqs=n)
                     time.sleep(0.02)
 
             t = threading.Thread(target=poller, daemon=True, name="dtx-fault-die")

@@ -274,6 +274,28 @@ def define_legacy_cluster_flags():
         "unchanged (PSTORE_GET_IF_NEWER), so tight cadences stay cheap.",
     )
     _define(
+        "bool",
+        "membership_leases",
+        True,
+        "Elastic membership (r14): async workers and serve replicas "
+        "heartbeat a lease on the coordinator PS shard, so the chief, the "
+        "data service and tools/dtxtop.py learn the LIVE member set from "
+        "the registry instead of static --worker_hosts — a worker can "
+        "join or leave mid-run with no restart of anything else, and an "
+        "expired lease reassigns the member's in-flight splits "
+        "immediately.  Degrades loudly to the static posture against a "
+        "pre-r14 PS.  Off = no lease traffic (the pre-r14 wire).",
+    )
+    _define(
+        "float",
+        "lease_ttl_s",
+        10.0,
+        "Membership lease TTL in seconds: a member whose heartbeats stop "
+        "for this long is treated as departed (lease pruned, splits "
+        "reassigned).  Heartbeats renew at ttl/3, so two missed beats "
+        "still keep the lease alive.",
+    )
+    _define(
         "integer",
         "replicas_to_aggregate",
         0,

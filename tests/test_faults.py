@@ -1485,3 +1485,104 @@ else:
     err = float(outs[0].split("err=")[1].split()[0])
     assert err < 0.5, outs[0][-2000:]
     r.cleanup()
+
+
+# ----------------------------------------------------------------------------
+# Membership events (r14): lease heartbeat transport + join/leave kinds
+# ----------------------------------------------------------------------------
+
+
+def test_membership_heartbeat_lm_drop_conn_heals(caplog, monkeypatch):
+    """The ``_lm`` (lease/membership) client leg under injected faults:
+    a ``drop_conn:role=member0_lm,op=2`` severs the heartbeat's socket
+    mid-renewal; the owned PSClient reconnects and the lease stays live —
+    membership survives the same transport chaos as every other wire."""
+    from distributed_tensorflow_examples_tpu.parallel import membership
+
+    monkeypatch.setenv(
+        "DTX_FAULT_PLAN", "drop_conn:role=member0_lm,op=2,count=2"
+    )
+    port = ps_service.start_server(0)
+    caplog.set_level("INFO", logger="dtx.faults")
+    hb = membership.LeaseHeartbeat(
+        [("127.0.0.1", port)], "member0", kind="worker", ttl_s=0.6,
+        role="member0", reconnect_deadline_s=10.0,
+    )
+    try:
+        deadline = time.monotonic() + 10.0
+        while hb.renewals < 4 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert hb.renewals >= 4, "heartbeat wedged after the injected drop"
+        c = ps_service.PSClient("127.0.0.1", port, timeout_s=5.0)
+        live = membership.live_members(c, "worker")
+        c.close()
+        assert [m["member"] for m in live] == ["member0"]
+    finally:
+        hb.close()
+        ps_service.stop_server()
+    assert any(
+        "event=inject_drop_conn" in r.message and "member0_lm" in r.message
+        for r in caplog.records
+    ), "the _lm drop never fired"
+    assert any("event=reconnected" in r.message for r in caplog.records)
+
+
+def test_leave_fault_departs_cleanly_with_exit_zero(tmp_path):
+    """The ``leave`` membership kind: the matching process runs its
+    registered leave hooks (lease release) and exits 0 — a clean
+    departure a supervisor must NOT restart, distinct from ``die``'s
+    exit-43 crash.  Plan: ``leave:role=member1,after_s=0.3``."""
+    marker = tmp_path / "left"
+    script = f"""
+import sys, time
+sys.path.insert(0, {ROOT!r})
+from distributed_tensorflow_examples_tpu.utils import faults
+faults.set_role("member1")
+faults.register_leave_hook(
+    lambda: open({str(marker)!r}, "w").write("hooks-ran")
+)
+faults.arm_process_faults()
+time.sleep(30)  # the leave fires long before this
+print("NOT-REACHED")
+"""
+    env = dict(os.environ)
+    env["DTX_FAULT_PLAN"] = "leave:role=member1,after_s=0.3"
+    r = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True,
+        text=True, timeout=120,
+    )
+    assert r.returncode == 0, (r.returncode, r.stderr[-500:])
+    assert "NOT-REACHED" not in r.stdout
+    assert marker.read_text() == "hooks-ran"
+    assert "event=inject_leave" in r.stderr
+
+
+def test_join_specs_are_orchestrator_events(caplog):
+    """The ``join`` membership kind parses (``join:role=worker2,
+    after_s=5``), surfaces through ``faults.join_specs`` for the
+    orchestrator (loadsim spawns the member), and in-process arming
+    SKIPS it loudly — a plan wired to the wrong process is never
+    silently inert."""
+    plan = "join:role=worker2,after_s=5;die:role=ps0,after_s=9"
+    specs = faults.join_specs(plan)
+    assert [s.role for s in specs] == ["worker2"]
+    assert faults.join_specs(plan, "worker2")
+    assert not faults.join_specs(plan, "chief0")
+    # join without after_s fails the launch loudly.
+    with pytest.raises(ValueError):
+        faults.parse_plan("join:role=worker2")
+    with pytest.raises(ValueError):
+        faults.parse_plan("leave:role=worker0")
+    caplog.set_level("INFO", logger="dtx.faults")
+    faults.set_role("worker2")
+    try:
+        os.environ["DTX_FAULT_PLAN"] = plan
+        threads = faults.arm_process_faults()
+        assert threads == []  # join skipped; ps0's die doesn't match
+    finally:
+        os.environ.pop("DTX_FAULT_PLAN", None)
+    assert any(
+        "event=fault_unarmed" in r.message
+        and "join_is_orchestrated" in r.message
+        for r in caplog.records
+    )

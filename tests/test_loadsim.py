@@ -1,0 +1,129 @@
+"""loadsim (r14 tentpole): verdict logic units + the chaos smoke e2e.
+
+The unit tests pin the SLO verdict computation (step-progress analysis,
+chaos plan composition, perf-gate integration) deterministically; the
+smoke e2e drives the REAL ``tools/loadsim.py`` — a multi-process
+train-and-serve cluster off the product CLI with a full kill/join/leave
+cycle under closed-loop predict load — and asserts the gates the
+acceptance rig stands on: zero failed serve requests, monotone advancing
+global step through the chaos, and the joined worker's lease visible to
+a mid-run ``dtxtop --json`` that exits 0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from tools import loadsim  # noqa: E402
+from tools import perf_gate  # noqa: E402
+
+
+def test_build_plan_scripts_one_full_cycle():
+    from distributed_tensorflow_examples_tpu.utils import faults
+
+    plan = loadsim.build_plan(10.0, 40.0, join_worker_id=2)
+    specs = faults.parse_plan(plan)  # must parse loudly-valid
+    kinds = sorted(s.kind for s in specs)
+    assert kinds.count("die") == 3  # ps + serve + worker kills
+    assert "leave" in kinds and "join" in kinds
+    dies = {s.role: s.after_s for s in specs if s.kind == "die"}
+    assert set(dies) == {"ps0", "serve0", "worker1"}
+    # The orchestrator consumes the join; the leave outlives the kill.
+    (join,) = faults.join_specs(plan)
+    assert join.role == "worker2"
+    (leave,) = [s for s in specs if s.kind == "leave"]
+    assert leave.after_s > dies["worker1"] > join.after_s
+    # Offsets bake in the boot window.
+    assert min(s.after_s for s in specs if s.after_s) >= 10.0
+
+
+def test_analyze_steps_verdicts():
+    markers = {"kill_worker": 10.0, "leave_worker": 20.0}
+    good = [(t, 100 + 10 * t) for t in range(0, 30, 2)]
+    v = loadsim.analyze_steps([(float(t), int(s)) for t, s in good], markers)
+    assert v["step_monotone"] and v["step_advanced"]
+    assert v["step_advanced_post_chaos"]
+    # A regression (step going BACKWARD — a lost publish) fails monotone.
+    bad = [(0.0, 100), (5.0, 200), (10.0, 150), (15.0, 300)]
+    v = loadsim.analyze_steps(bad, markers)
+    assert not v["step_monotone"] and v["step_advanced"]
+    # Stalling after the last chaos marker fails the post-chaos gate even
+    # though the overall window advanced.
+    stalled = [(0.0, 100), (10.0, 500), (21.0, 500), (29.0, 500)]
+    v = loadsim.analyze_steps(stalled, markers)
+    assert v["step_advanced"] and not v["step_advanced_post_chaos"]
+    # Missing scrapes (-1) are holes, not evidence.
+    v = loadsim.analyze_steps([(0.0, -1), (1.0, 5), (2.0, 9)], {})
+    assert v["step_first"] == 5 and v["step_monotone"]
+
+
+def test_perf_gate_loadsim_rules():
+    base = {
+        "metric": "loadsim_slo", "slo_pass": True, "p99_ms": 20.0,
+        "gates": {"zero_failed_predicts": True, "join_lease_seen": True},
+    }
+    ok = {
+        "metric": "loadsim_slo", "slo_pass": True, "p99_ms": 35.0,
+        "gates": {"zero_failed_predicts": True, "join_lease_seen": True},
+    }
+    assert perf_gate.gate(
+        ok, base, tolerance=0.25, if_newer_ratio=20.0
+    ) == []
+    # slo_pass False names the failing gates.
+    bad = dict(ok, slo_pass=False,
+               gates={"zero_failed_predicts": False,
+                      "join_lease_seen": True})
+    (f,) = perf_gate.gate(bad, base, tolerance=0.25, if_newer_ratio=20.0)
+    assert "zero_failed_predicts" in f
+    # A gate present in the baseline cannot silently vanish.
+    shrunk = dict(ok, gates={"zero_failed_predicts": True})
+    fails = perf_gate.gate(shrunk, base, tolerance=0.25, if_newer_ratio=20.0)
+    assert any("join_lease_seen" in f for f in fails)
+    # The loose cross-host p99 tripwire.
+    slow = dict(ok, p99_ms=20.0 * 50)
+    fails = perf_gate.gate(slow, base, tolerance=0.25, if_newer_ratio=20.0)
+    assert any("p99_ms" in f for f in fails)
+
+
+def test_checked_in_loadsim_baseline_is_a_passing_verdict():
+    with open(os.path.join(ROOT, "tools", "loadsim_baseline.json")) as f:
+        base = json.load(f)
+    assert base["metric"] == "loadsim_slo"
+    assert base["slo_pass"] is True and base["predict_failed"] == 0
+    assert perf_gate.BASELINES["loadsim_slo"] == "loadsim_baseline.json"
+    # The baseline gates itself (the identity compare must pass).
+    assert perf_gate.gate(
+        base, base, tolerance=0.25, if_newer_ratio=20.0
+    ) == []
+
+
+@pytest.mark.slow
+def test_loadsim_chaos_smoke_e2e(tmp_path):
+    """THE acceptance smoke: a short real-cluster run with the full
+    kill/join/leave cycle must pass its SLO gate end to end (this is the
+    same invocation the measure_campaign cpu_ok step runs, trimmed)."""
+    out = tmp_path / "verdict.json"
+    env = dict(os.environ)
+    env.pop("DTX_FAULT_PLAN", None)
+    env.pop("DTX_FAULT_ROLE", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "loadsim.py"),
+         "--qps=15", "--duration_s=30", "--p99_bound_ms=1500",
+         f"--out={out}", f"--logdir={tmp_path}"],
+        capture_output=True, text=True, timeout=420, cwd=ROOT, env=env,
+    )
+    tail = "\n".join(r.stdout.strip().splitlines()[-3:])
+    assert r.returncode == 0, f"loadsim rc={r.returncode}\n{tail}\n{r.stderr[-2000:]}"
+    v = json.loads(open(out).read())
+    assert v["slo_pass"], v["gates"]
+    assert v["predict_failed"] == 0 and v["predict_ok"] > 100
+    assert v["step_monotone"] and v["step_advanced_post_chaos"]
+    assert v["gates"]["dtxtop_midrun_exit0"] and v["gates"]["join_lease_seen"]

@@ -1,0 +1,493 @@
+"""Membership leases + elastic serve pool (r14 tentpole).
+
+Protocol-level coverage of the LEASE op family (acquire/renew/expire/
+release against the real native server), the heartbeat/watcher layer on
+top of it, the data service's lease-driven immediate split reassignment,
+the autoscaling serve pool (grow/shrink against measured load, zero
+failed requests through a scale-down), ServePool's elastic reconcile,
+and dtxtop's lease-registry discovery — the pieces tools/loadsim.py then
+composes into the standing kill/join/leave acceptance rig.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_examples_tpu import serve
+from distributed_tensorflow_examples_tpu.data import data_service as dsvc_lib
+from distributed_tensorflow_examples_tpu.parallel import (
+    membership,
+    ps_service,
+    ps_shard,
+)
+from distributed_tensorflow_examples_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_env(monkeypatch):
+    monkeypatch.delenv("DTX_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("DTX_FAULT_ROLE", raising=False)
+    monkeypatch.setattr(faults, "_role", None)
+
+
+@pytest.fixture
+def ps_port():
+    port = ps_service.start_server(0)
+    yield port
+    ps_service.stop_server()
+
+
+def _client(port):
+    return ps_service.PSClient("127.0.0.1", port, timeout_s=10.0)
+
+
+# ----------------------------------------------------------------------------
+# Lease protocol (wire + native server)
+# ----------------------------------------------------------------------------
+
+
+def test_lease_acquire_renew_release_statuses(ps_port):
+    c = _client(ps_port)
+    name = membership.pack_member("worker0", "worker")
+    assert c.lease_acquire(name, 5.0) == membership.LEASE_NEW
+    assert c.lease_acquire(name, 5.0) == membership.LEASE_RENEWED
+    doc = c.lease_list()
+    assert doc["expired_total"] == 0
+    (entry,) = doc["leases"]
+    assert entry["renewals"] == 1 and 0 < entry["ttl_ms"] <= 5000
+    assert c.lease_release(name) is True
+    assert c.lease_release(name) is False  # idempotent: already gone
+    assert c.lease_list()["leases"] == []
+    # A release is a DEPARTURE, not an expiry: churn counters distinguish.
+    assert c.lease_list()["expired_total"] == 0
+    c.close()
+
+
+def test_lease_expiry_prunes_counts_and_signals_lapse(ps_port):
+    c = _client(ps_port)
+    name = membership.pack_member("worker1", "worker")
+    assert c.lease_acquire(name, 0.3) == membership.LEASE_NEW
+    time.sleep(0.45)
+    doc = c.lease_list()
+    assert doc["leases"] == [] and doc["expired_total"] == 1
+    # Re-acquiring after expiry answers NEW — the lapse signal a renewing
+    # heartbeat counts (the member may have been treated as departed).
+    assert c.lease_acquire(name, 5.0) == membership.LEASE_NEW
+    assert c.stats()["leases"] == 1
+    assert c.stats()["leases_expired"] == 1
+    c.close()
+
+
+def test_lease_rejects_malformed_members(ps_port):
+    c = _client(ps_port)
+    with pytest.raises(ps_service.PSError):
+        c.lease_acquire('bad"quote', 5.0)
+    with pytest.raises(ps_service.PSError):
+        c.lease_acquire("x", 0)  # non-positive ttl
+    # The Python packer refuses separator/escape/control bytes even
+    # earlier (a role leaked with a trailing newline must fail HERE, not
+    # read as a pre-r14 server at the heartbeat).
+    for bad in ("a|b", 'a"b', "a\\b", "", "worker0\n", "a\tb"):
+        with pytest.raises(ValueError):
+            membership.pack_member(bad)
+    c.close()
+
+
+def test_member_index_trailing_digits():
+    assert membership.member_index("worker3") == 3
+    assert membership.member_index("w2-worker13") == 13
+    assert membership.member_index("chief") is None
+    assert membership.member_index("") is None
+    # Oversized identities fail at the packer with the REAL reason (the
+    # server's -2 would otherwise read as "pre-r14 coordinator").
+    with pytest.raises(ValueError):
+        membership.pack_member("w" * 250)
+
+
+def test_lease_ops_do_not_advance_request_counter(ps_port):
+    """Heartbeats fire on wall-clock cadence: counting them would make
+    every ``die:after_reqs`` trigger drift with the heartbeat period
+    (same contract as HELLO/STATS, pinned per r13)."""
+    c = _client(ps_port)
+    before = ps_service.server_request_count(ps_port)
+    name = membership.pack_member("w", "worker")
+    for _ in range(5):
+        c.lease_acquire(name, 5.0)
+    c.lease_list()
+    c.lease_release(name)
+    assert ps_service.server_request_count(ps_port) == before
+    c.close()
+
+
+def test_member_pack_unpack_round_trip():
+    name = membership.pack_member("serve3", "serve", "10.0.0.7:7201")
+    m = membership.unpack_member(name)
+    assert m == {"member": "serve3", "kind": "serve", "addr": "10.0.0.7:7201"}
+    # Foreign/bare member strings degrade, never raise.
+    assert membership.unpack_member("legacy")["kind"] == ""
+
+
+# ----------------------------------------------------------------------------
+# Heartbeat + watcher
+# ----------------------------------------------------------------------------
+
+
+def test_heartbeat_keeps_lease_alive_past_many_ttls(ps_port):
+    hb = membership.LeaseHeartbeat(
+        [("127.0.0.1", ps_port)], "worker0", kind="worker", ttl_s=0.4,
+    )
+    c = _client(ps_port)
+    try:
+        time.sleep(1.5)  # ~4 TTLs: without renewal the lease would lapse
+        live = membership.live_members(c, "worker")
+        assert [m["member"] for m in live] == ["worker0"]
+        assert c.lease_list()["expired_total"] == 0
+        assert hb.lapses == 0 and hb.renewals >= 2
+    finally:
+        hb.close()
+    # close() RELEASED the lease (clean departure, not expiry).
+    assert membership.live_members(c, "worker") == []
+    assert c.lease_list()["expired_total"] == 0
+    c.close()
+
+
+def test_watcher_surfaces_join_and_leave_transitions(ps_port):
+    joins, leaves = [], []
+    w = membership.LeaseWatcher(
+        [("127.0.0.1", ps_port)], kind="worker", poll_s=30.0,
+        on_join=lambda m: joins.append(m["member"]),
+        on_leave=lambda m: leaves.append(m["member"]),
+        reconnect_deadline_s=0.5,
+    )
+    c = _client(ps_port)
+    try:
+        name = membership.pack_member("worker5", "worker")
+        c.lease_acquire(name, 0.4)
+        w.poll_once()
+        assert joins == ["worker5"] and leaves == []
+        assert [m["member"] for m in w.members()] == ["worker5"]
+        time.sleep(0.6)  # expire
+        w.poll_once()
+        assert leaves == ["worker5"]
+        # A failed poll synthesizes NO transition (absence of evidence).
+        ps_service.stop_server(ps_port)
+        errs = w.poll_errors
+        w.poll_once()
+        assert w.poll_errors == errs + 1 and leaves == ["worker5"]
+    finally:
+        w.close()
+        c.close()
+
+
+# ----------------------------------------------------------------------------
+# Data service: lease-driven immediate reassignment
+# ----------------------------------------------------------------------------
+
+
+def _splits(n=4, rows=8):
+    rng = np.random.default_rng(0)
+    return [
+        {
+            "x": rng.normal(size=(rows, 4)).astype(np.float32),
+            "label": rng.integers(0, 3, size=rows).astype(np.int32),
+        }
+        for _ in range(n)
+    ]
+
+
+def test_stale_marked_worker_splits_reassign_immediately():
+    """The elastic leave path: a departed member's in-flight split hands
+    over on the NEXT GET_SPLIT — no waiting out ``reassign_after_s``
+    (set prohibitively long here, so only the mark can explain the
+    handover) — and a returning member clears its own mark."""
+    server = dsvc_lib.DataServiceServer(
+        _splits(), batch_size=4, reassign_after_s=3600.0,
+    )
+    try:
+        c1 = dsvc_lib.DataServiceClient(
+            "127.0.0.1", server.port, worker_id=1, reconnect_deadline_s=0.0,
+        )
+        c2 = dsvc_lib.DataServiceClient(
+            "127.0.0.1", server.port, worker_id=2, reconnect_deadline_s=0.0,
+        )
+        held = []
+        for c in (c1, c2):
+            status, _ = c.call(
+                dsvc_lib.DSVC_GET_SPLIT, name="epoch=0", a=c.worker_id, b=-1
+            )
+            assert status >= 0
+            held.append(status)
+        # Drain the pending pool so worker 2's next ask must reassign.
+        drain = dsvc_lib.DataServiceClient(
+            "127.0.0.1", server.port, worker_id=3, reconnect_deadline_s=0.0,
+        )
+        ack = -1
+        while True:
+            # Replay safety re-answers an unacked split forever — each
+            # drained assignment is acked on the next ask.
+            status, _ = drain.call(
+                dsvc_lib.DSVC_GET_SPLIT, name="epoch=0", a=3, b=ack
+            )
+            if status < 0:
+                break
+            ack = status
+        server.mark_worker_stale(1)
+        # Worker 2 acks its own held split first (else the replay path
+        # re-answers it before the reassign scan can run).
+        status, _ = c2.call(
+            dsvc_lib.DSVC_GET_SPLIT, name="epoch=0", a=2, b=held[1]
+        )
+        assert status == held[0], "stale member's split did not hand over"
+        assert server.stats()["reassigned"] == 1
+        assert server.stats()["stale_marked"] == 1
+        # The marked worker COMING BACK clears the mark.
+        server.mark_worker_stale(2)
+        c2.call(dsvc_lib.DSVC_GET_SPLIT, name="epoch=0", a=2, b=-1)
+        server.mark_worker_stale(1)
+        c1.call(dsvc_lib.DSVC_GET_SPLIT, name="epoch=0", a=1, b=-1)
+        assert server.stats()["reassigned"] == 1  # no further handover
+        for c in (c1, c2, drain):
+            c.close()
+    finally:
+        server.stop()
+
+
+# ----------------------------------------------------------------------------
+# Elastic serve pool: set_addrs, autoscaler, lease discovery
+# ----------------------------------------------------------------------------
+
+D = 8
+
+
+def _init_fn(rng):
+    import jax.numpy as jnp
+
+    return {"w": jnp.zeros((D, 3), jnp.float32)}
+
+
+def _predict_fn(params, batch):
+    return batch["x"] @ params["w"]
+
+
+def _publish(addrs, step=1):
+    group = ps_shard.ShardedPSClients(addrs, role="pub", op_timeout_s=10.0)
+    layout = ps_shard.ShardLayout(D * 3, len(addrs))
+    store = ps_shard.ShardedParamStore(group, "params", layout)
+    store.set(step, np.arange(D * 3, dtype=np.float32))
+    return group
+
+
+def test_pool_set_addrs_reconciles_and_survives_scale_down(ps_port):
+    addrs = [("127.0.0.1", ps_port)]
+    group = _publish(addrs)
+    make = serve.make_replica_factory(
+        _init_fn, _predict_fn, addrs, refresh_ms=20.0, membership=False,
+    )
+    a, b = make(0), make(1)
+    try:
+        assert a.wait_for_model(30) and b.wait_for_model(30)
+        pool = serve.ServePool(
+            [("127.0.0.1", a.port), ("127.0.0.1", b.port)], deadline_s=20.0,
+        )
+        x = {"x": np.ones((2, D), np.float32)}
+        step, _ = pool.predict(x)
+        assert step == 1
+        # Shrink to just b; the dropped replica's client closes, requests
+        # keep succeeding on the survivor (pure predict => safe retry).
+        pool.set_addrs([("127.0.0.1", b.port)])
+        a.stop()
+        for _ in range(4):
+            step, _ = pool.predict(x)
+            assert step == 1
+        # Identical list = no-op (no client churn).
+        clients_before = list(pool._clients)
+        pool.set_addrs([("127.0.0.1", b.port)])
+        assert pool._clients == clients_before
+        with pytest.raises(ValueError):
+            pool.set_addrs([])
+        pool.close()
+    finally:
+        for s in (a, b):
+            try:
+                s.stop()
+            except Exception:
+                pass
+        group.close()
+
+
+def test_autoscaler_scales_on_load_signals_and_drains(ps_port):
+    addrs = [("127.0.0.1", ps_port)]
+    group = _publish(addrs)
+    make = serve.make_replica_factory(
+        _init_fn, _predict_fn, addrs, refresh_ms=20.0, lease_ttl_s=1.0,
+    )
+    asc = serve.ServeAutoscaler(
+        make, min_replicas=1, max_replicas=2, queue_high=0.5,
+        queue_low=0.25, settle_polls=2,
+    )
+    c = _client(ps_port)
+    try:
+        assert asc.num_replicas == 1
+        # Replicas lease themselves at boot.
+        assert len(membership.live_members(c, "serve")) == 1
+        # Synthetic load: hold requests in the batcher so measured depth
+        # crosses the high-water mark for settle_polls consecutive polls.
+        srv = asc._servers[0]
+        assert srv.wait_for_model(30)
+        stop_load = threading.Event()
+
+        def hammer():
+            pool = serve.ServePool(
+                [("127.0.0.1", srv.port)], deadline_s=10.0,
+            )
+            x = {"x": np.ones((4, D), np.float32)}
+            while not stop_load.is_set():
+                try:
+                    pool.predict(x)
+                except Exception:
+                    pass
+            pool.close()
+
+        threads = [
+            threading.Thread(target=hammer, daemon=True) for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        decisions = []
+        deadline = time.monotonic() + 20.0
+        while "up" not in decisions and time.monotonic() < deadline:
+            decisions.append(asc.poll_once())
+            time.sleep(0.05)
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        assert "up" in decisions, decisions
+        assert asc.num_replicas == 2
+        assert len(membership.live_members(c, "serve")) == 2
+        # Idle now: the pool drains back to min, releasing the lease.
+        deadline = time.monotonic() + 20.0
+        while asc.num_replicas > 1 and time.monotonic() < deadline:
+            asc.poll_once()
+            time.sleep(0.05)
+        assert asc.num_replicas == 1
+        assert len(membership.live_members(c, "serve")) == 1
+        assert asc.scale_ups == 1 and asc.scale_downs == 1
+    finally:
+        asc.close()
+        c.close()
+        group.close()
+
+
+def test_lease_discovery_follows_elastic_replica_set(ps_port):
+    addrs = [("127.0.0.1", ps_port)]
+    group = _publish(addrs)
+    make = serve.make_replica_factory(
+        _init_fn, _predict_fn, addrs, refresh_ms=20.0, lease_ttl_s=5.0,
+    )
+    asc = serve.ServeAutoscaler(make, min_replicas=1, max_replicas=3)
+    pool = serve.ServePool(asc.addrs(), deadline_s=20.0)
+    disc = serve.LeaseServeDiscovery(addrs, pool, poll_s=30.0)
+    try:
+        disc.poll_once()
+        assert len(pool.addrs) == 1
+        new_addr = asc.scale_up(depth=9.0)
+        disc.poll_once()
+        assert set(pool.addrs) == set(asc.addrs())
+        assert new_addr in pool.addrs
+        asc.scale_down(depth=0.0)
+        disc.poll_once()
+        assert pool.addrs == asc.addrs()
+        x = {"x": np.ones((2, D), np.float32)}
+        step, _ = pool.predict(x)
+        assert step == 1
+    finally:
+        disc.close()
+        pool.close()
+        asc.close()
+        group.close()
+
+
+def test_coordinator_addrs_and_unpack_addr():
+    addrs = [("h0", 1), ("h1", 2), ("h0b", 3), ("h1b", 4)]
+    # Replica-major 2 shards x 2 replicas: coordinator = shard 0's pair.
+    assert membership.coordinator_addrs(addrs, 2, 2) == [
+        ("h0", 1), ("h0b", 3)
+    ]
+    assert membership.coordinator_addrs(addrs, 4, 1) == [("h0", 1)]
+    assert membership.unpack_addr("10.0.0.7:7201") == ("10.0.0.7", 7201)
+    assert membership.unpack_addr("") is None
+    assert membership.unpack_addr("noport") is None
+
+
+def test_scrape_leases_unions_coordinator_replicas_only():
+    """Regression (review finding): leases are NOT replicated, so after a
+    failover different members heartbeat into DIFFERENT coordinator
+    replicas — the scrape must union the pair, and must never read a
+    non-coordinator shard's (empty) registry as 'no members'."""
+    from tools import dtxtop
+
+    # 2 shards x 2 replicas, replica-major: [s0r0, s1r0, s0r1, s1r1].
+    ports = [
+        ps_service.start_server(0, shard_id=i % 2, shard_count=2)
+        for i in range(4)
+    ]
+    addrs = [("127.0.0.1", p) for p in ports]
+    try:
+        c_s0r0 = _client(ports[0])
+        c_s0r1 = _client(ports[2])
+        c_s1 = _client(ports[1])
+        # Split-brain membership: worker0 on one coordinator replica,
+        # worker1 on the other; a lease on a NON-coordinator shard is
+        # foreign state the scrape must ignore.
+        c_s0r0.lease_acquire(membership.pack_member("worker0", "worker"), 5.0)
+        c_s0r1.lease_acquire(membership.pack_member("worker1", "worker"), 5.0)
+        c_s1.lease_acquire(membership.pack_member("ghost", "worker"), 5.0)
+        got = dtxtop.scrape_leases(
+            addrs, 5.0, ps_shards=2, ps_replicas=2
+        )
+        assert sorted(m["member"] for m in got) == ["worker0", "worker1"]
+        for c in (c_s0r0, c_s0r1, c_s1):
+            c.close()
+    finally:
+        ps_service.stop_server()
+
+
+# ----------------------------------------------------------------------------
+# dtxtop discovery
+# ----------------------------------------------------------------------------
+
+
+def test_dtxtop_snapshot_discovers_leased_members(ps_port):
+    from tools import dtxtop
+
+    addrs = [("127.0.0.1", ps_port)]
+    group = _publish(addrs)
+    make = serve.make_replica_factory(
+        _init_fn, _predict_fn, addrs, refresh_ms=20.0, lease_ttl_s=5.0,
+    )
+    srv = make(0)
+    hb = membership.LeaseHeartbeat(
+        addrs, "worker7", kind="worker", ttl_s=5.0,
+    )
+    try:
+        assert srv.wait_for_model(30)
+        # NO static serve_hosts: the replica must be discovered from its
+        # lease, scraped as a live role, and the worker rendered as a
+        # leased member.
+        snap = dtxtop.snapshot(addrs, ps_shards=1)
+        mem = snap["summary"]["members"]
+        assert "worker7" in mem["workers"]
+        serve_rows = [r for r in snap["roles"] if r["kind"] == "serve"]
+        assert len(serve_rows) == 1 and serve_rows[0]["ok"]
+        assert serve_rows[0]["stats"]["model_step"] == 1
+        assert snap["summary"]["roles_ok"] == snap["summary"]["roles_total"]
+        rendered = dtxtop.render(snap)
+        assert "worker7" in rendered and "members:" in rendered
+    finally:
+        hb.close()
+        srv.stop()
+        group.close()

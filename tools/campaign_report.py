@@ -129,6 +129,34 @@ def fmt_obs(rec: dict, ok: str) -> str:
     return "\n".join(lines)
 
 
+def fmt_loadsim(rec: dict, ok: str) -> str:
+    """Elasticity acceptance step (r14): the loadsim SLO verdict — pass/
+    fail per gate, the latency/qps numbers and the step-progress window —
+    readable from the report without re-running the sim."""
+    j = rec.get("json") or {}
+    if not j:
+        return f"- `loadsim` [{ok}]: NO JSON ({rec['seconds']}s)"
+    gates = j.get("gates", {})
+    bad = sorted(g for g, v in gates.items() if not v)
+    lines = [
+        f"- `loadsim` [{ok}]: SLO {'PASS' if j.get('slo_pass') else 'FAIL'}"
+        f" — {j.get('predict_ok')} predicts, {j.get('predict_failed')} "
+        f"failed, p99={j.get('p99_ms')}ms (bound {j.get('p99_bound_ms')}), "
+        f"qps {j.get('qps_achieved')}/{j.get('qps_target')} "
+        f"({rec['seconds']}s wall)"
+    ]
+    lines.append(
+        f"    - step {j.get('step_first')} -> {j.get('step_last')} "
+        f"(monotone={j.get('step_monotone')}, "
+        f"post_chaos_advance={j.get('step_advanced_post_chaos')}); "
+        f"members={((j.get('members_last') or {}).get('workers') or [])} + "
+        f"{((j.get('members_last') or {}).get('serve') or [])}"
+    )
+    if bad:
+        lines.append(f"    - FAILING GATES: {', '.join(bad)}")
+    return "\n".join(lines)
+
+
 def main():
     path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(ROOT, "CAMPAIGN_r05.json")
     with open(path) as f:
@@ -144,6 +172,8 @@ def main():
             print(fmt_dtxlint(rec, ok))
         elif name == "obs_snapshot":
             print(fmt_obs(rec, ok))
+        elif name == "loadsim":
+            print(fmt_loadsim(rec, ok))
         elif name.startswith("bench_"):
             print(fmt_bench(rec, ok))
         elif name == "flash_parity":

@@ -69,8 +69,8 @@ _CONST_PAIRS = {
 #: observability scrape), so it joins the exact-match list: a restated
 #: STATS literal or an undispatched STATS case must fail like any op.
 _PS_NAME = re.compile(
-    r"^_?(?:(?:ACC|TQ|GQ|PSTORE|REPL)_\w+|CANCEL_ALL|PING|INCARNATION|HELLO"
-    r"|STATS)$"
+    r"^_?(?:(?:ACC|TQ|GQ|PSTORE|REPL|LEASE)_\w+|CANCEL_ALL|PING|INCARNATION"
+    r"|HELLO|STATS)$"
 )
 _DSVC_NAME = re.compile(r"^DSVC_\w+$")
 _SRV_NAME = re.compile(r"^SRV_\w+$")

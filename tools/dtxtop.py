@@ -8,6 +8,13 @@ reconnect/failover/reseed counters, dedup/mirror hits, divergence flags.
 No side channels: everything it shows travels over the same sockets the
 cluster already serves, so what dtxtop can see, any operator tooling can.
 
+Elastic membership (r14): the coordinator PS shard's LEASE registry is
+scraped too — dynamically-joined serve replicas are discovered from
+their leases and scraped as live roles (an elastic pool is never
+rendered as missing), and leased workers get their own registry rows
+(the lease IS their observable surface; workers dial out, they don't
+listen).
+
 Usage:
   # live table, refreshed every 2 s, against a replicated cluster
   python tools/dtxtop.py --ps_hosts=h:7000,h:7001,h:7002,h:7003 \
@@ -130,6 +137,39 @@ def cluster_roles(
 _SCRAPERS = {"ps": _scrape_ps, "dsvc": _scrape_dsvc, "serve": _scrape_serve}
 
 
+def scrape_leases(
+    ps_addrs, timeout_s: float, *, ps_shards: int = 0, ps_replicas: int = 1,
+) -> list[dict]:
+    """The coordinator shard's membership lease registry (r14): the LIVE
+    elastic member set (workers, serve replicas) straight off the wire.
+    ONLY the coordinator shard's replicas host leases — and after a
+    failover different members may be heartbeating into DIFFERENT
+    replicas of the pair (each client alternates independently, and the
+    registry is deliberately not replicated) — so every coordinator
+    replica is scraped and the answers UNION by member id.  An empty
+    cluster — or a pre-r14 PS — contributes nothing, never an error
+    (elastic discovery degrades to the static flag lists)."""
+    from distributed_tensorflow_examples_tpu.parallel import membership
+
+    n_shards = resolve_shards(ps_addrs, ps_shards, ps_replicas)
+    merged: dict[str, dict] = {}
+    for host, port in membership.coordinator_addrs(
+        ps_addrs, n_shards, ps_replicas
+    ):
+        try:
+            c = ps_service.PSClient(host, port, timeout_s=timeout_s)
+            try:
+                for m in membership.live_members(c):
+                    prev = merged.get(m["member"])
+                    if prev is None or m["renewals"] > prev["renewals"]:
+                        merged[m["member"]] = m
+            finally:
+                c.close()
+        except Exception:  # noqa: BLE001 — try the next replica
+            continue
+    return list(merged.values())
+
+
 def snapshot(
     ps_addrs=(), *, ps_shards: int = 0, ps_replicas: int = 1,
     dsvc_addrs=(), serve_addrs=(), timeout_s: float = 5.0,
@@ -138,7 +178,31 @@ def snapshot(
     aggregated summary.  A role that cannot be scraped (down, or a
     mis-wired address answering as the wrong service) is reported with
     ``ok: False`` and the diagnostic — missing observability is itself a
-    loud finding, never a silent hole in the table."""
+    loud finding, never a silent hole in the table.
+
+    Elastic membership (r14): the coordinator shard's lease registry is
+    scraped too, and every LEASED serve replica whose address is not in
+    the static ``serve_addrs`` is discovered and scraped as a live role —
+    a dynamically-joined pool is never rendered as missing.  Leased
+    workers (no dialable address) are reported in the ``members`` list."""
+    from distributed_tensorflow_examples_tpu.parallel import membership
+
+    members = (
+        scrape_leases(
+            ps_addrs, timeout_s, ps_shards=ps_shards,
+            ps_replicas=ps_replicas,
+        )
+        if ps_addrs
+        else []
+    )
+    static = {f"{h}:{p}" for h, p in serve_addrs}
+    serve_addrs = list(serve_addrs)
+    for m in members:
+        if m["kind"] != "serve" or m["addr"] in static:
+            continue
+        addr = membership.unpack_addr(m["addr"])
+        if addr is not None:
+            serve_addrs.append(addr)
     roles = cluster_roles(
         ps_addrs, ps_shards=ps_shards, ps_replicas=ps_replicas,
         dsvc_addrs=dsvc_addrs, serve_addrs=serve_addrs,
@@ -211,10 +275,20 @@ def snapshot(
             ), 3),
         },
     }
+    summary["members"] = {
+        "total": len(members),
+        "workers": sorted(
+            m["member"] for m in members if m["kind"] == "worker"
+        ),
+        "serve": sorted(
+            m["member"] for m in members if m["kind"] == "serve"
+        ),
+    }
     return {
         "schema_version": SNAPSHOT_SCHEMA_VERSION,
         "time": time.time(),
         "roles": roles,
+        "members": members,
         "summary": summary,
     }
 
@@ -284,7 +358,22 @@ def render(snap: dict, prev: dict | None = None) -> str:
         if dt > 0 and r["role"] in prev_reqs:
             qps = f" qps={max(0.0, (r['stats']['requests'] - prev_reqs[r['role']]) / dt):.1f}"
         lines.append(f"{head} {_ROW_FMT[r['kind']](r)}{qps}")
+    for m in snap.get("members", ()):
+        # Leased members without a dialable service (workers) still get a
+        # row: the lease IS their observable surface.
+        if m["kind"] == "serve" and m.get("addr"):
+            continue  # already rendered as a scraped serve role above
+        lines.append(
+            f"{m['member']:<15} {'(lease)':<22} {'':>9} "
+            f"kind={m['kind']} ttl={m['ttl_ms']}ms renewals={m['renewals']}"
+        )
     su = snap["summary"]
+    mem = su.get("members", {})
+    lines.append(
+        f"members: {mem.get('total', 0)} leased "
+        f"(workers={','.join(mem.get('workers', [])) or 'none'} "
+        f"serve={','.join(mem.get('serve', [])) or 'none'})"
+    )
     lines.append(
         f"totals: ps_reqs={su['ps']['requests']} dedup={su['ps']['deduped']} "
         f"syncs={su['ps']['repl_syncs_served']} "

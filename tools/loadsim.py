@@ -1,0 +1,519 @@
+"""loadsim — closed-loop chaos load simulator + SLO gate (r14 tentpole).
+
+Boots a REAL multi-process train-and-serve cluster off the product CLI
+(``examples/mnist_mlp.py`` — supervised PS task(s), chief, async workers,
+supervised serve replicas), drives BOTH planes simultaneously — training
+runs free while a closed-loop generator holds the serve pool at a target
+qps — and runs a continuous membership-chaos timeline from one
+``DTX_FAULT_PLAN``:
+
+- kills (``die``) of the PS task, a worker, and a serve replica — each
+  healed by the machinery under test (supervised restart + client
+  reconnect for services; lease EXPIRY for the unsupervised worker);
+- a ``join``: a brand-new worker (and optionally serve replica) process
+  spawned MID-RUN, which acquires a membership lease, pulls current
+  params and contributes with no restart of anything else — the
+  orchestrator half of the membership event kinds (``faults.join_specs``);
+- a ``leave``: a worker departs gracefully (releases its lease, exits 0).
+
+Throughout, the cluster is scraped over the same wires any operator
+tooling uses (``tools/dtxtop.snapshot`` — serve replicas are discovered
+from the LEASE REGISTRY, not static flags, so the elastic pool is
+followed as it changes), and once mid-run the real ``python -m
+tools.dtxtop --json`` CLI is shelled out and must exit 0 showing the
+joined worker's lease.
+
+The run ends in a machine-readable SLO VERDICT (last stdout line, and
+``--out``):
+
+- ``predict_failed == 0`` — zero failed serve requests across the whole
+  kill/join/leave cycle (the ServePool rotation absorbs every fault);
+- ``p99_ms <= p99_bound_ms`` at the achieved qps;
+- the training global step (the served ``model_step``) is MONOTONE
+  across every scrape and STRICTLY advances across the chaos window;
+- the joined worker's lease was observed by the mid-run dtxtop scrape.
+
+Exit code 0 iff every gate holds — the standing acceptance rig ROADMAP
+items 1–4 gate on, runnable on any CPU dev box (``cpu_ok`` in
+``measure_campaign``; baseline gated by ``tools/perf_gate.py``).
+
+Usage::
+
+    python tools/loadsim.py --qps=25 --duration_s=30 --p99_bound_ms=250
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+#: Verdict schema version (tests pin it).
+VERDICT_SCHEMA_VERSION = 1
+
+#: Chaos timeline, as fractions of the load window: when each membership
+#: event fires relative to load start.  Kills come first (heal under
+#: load), the join lands while the killed worker's lease is expiring, the
+#: leave runs last — so the run ends on the JOINED member carrying
+#: training alone, the strongest elasticity evidence.
+PHASES = {
+    "kill_ps": 0.20,
+    "kill_serve": 0.35,
+    "join_worker": 0.45,
+    "kill_worker": 0.60,
+    "leave_worker": 0.75,
+}
+
+
+def free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def build_plan(ready_s: float, duration_s: float, join_worker_id: int) -> str:
+    """The cluster-wide DTX_FAULT_PLAN for one kill/join/leave cycle.
+    ``after_s`` triggers arm at each PROCESS's start, so the offsets
+    include the boot window (``ready_s``) for tasks launched at t0; the
+    ``join`` spec is the orchestrator's own schedule (loadsim spawns the
+    worker — ``faults.join_specs`` — nothing in-process arms it)."""
+    t = {k: ready_s + f * duration_s for k, f in PHASES.items()}
+    return ";".join([
+        f"die:role=ps0,after_s={t['kill_ps']:.1f}",
+        f"die:role=serve0,after_s={t['kill_serve']:.1f}",
+        f"join:role=worker{join_worker_id},after_s={t['join_worker']:.1f}",
+        f"die:role=worker1,after_s={t['kill_worker']:.1f}",
+        f"leave:role=worker0,after_s={t['leave_worker']:.1f}",
+        # Background client-level chaos: transient drops and delays on the
+        # training workers' PS legs, healed by reconnect+replay under load.
+        "drop_conn:role=worker0,op=25,count=2",
+        "delay:role=worker1,op=30,ms=40,count=3",
+    ])
+
+
+class LoadGenerator:
+    """Closed-loop predict load at a target qps over a ServePool, with
+    replica discovery following the LEASE registry (the elastic pool)."""
+
+    def __init__(
+        self, ps_addrs, serve_addrs, *, qps: float, threads: int = 4,
+        deadline_s: float = 60.0,
+    ):
+        from distributed_tensorflow_examples_tpu import serve
+
+        self.qps = float(qps)
+        self.ok = 0
+        self.failed = 0
+        self.errors: list[str] = []
+        self.latencies_ms: list[float] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.pool = serve.ServePool(
+            list(serve_addrs), role="loadsim_sv", deadline_s=deadline_s,
+        )
+        self.discovery = serve.LeaseServeDiscovery(
+            list(ps_addrs), self.pool, poll_s=1.0,
+        )
+        self._threads = [
+            threading.Thread(
+                target=self._loop, args=(i, max(1, threads)), daemon=True,
+                name=f"loadsim-gen{i}",
+            )
+            for i in range(max(1, threads))
+        ]
+
+    def _loop(self, tid: int, n_threads: int) -> None:
+        import numpy as np
+
+        x = np.zeros((4, 784), np.float32)
+        period = n_threads / self.qps
+        next_t = time.monotonic() + tid * period / n_threads
+        while not self._stop.is_set():
+            now = time.monotonic()
+            if now < next_t:
+                time.sleep(min(next_t - now, 0.05))
+                continue
+            next_t += period
+            t0 = time.perf_counter()
+            try:
+                self.pool.predict({"image": x})
+            except Exception as e:  # noqa: BLE001 — every failure is counted
+                with self._lock:
+                    self.failed += 1
+                    if len(self.errors) < 20:
+                        self.errors.append(f"{type(e).__name__}: {e}")
+                continue
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            with self._lock:
+                self.ok += 1
+                self.latencies_ms.append(dt_ms)
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> dict:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10.0)
+        self.discovery.close()
+        self.pool.close()
+        with self._lock:
+            lat = sorted(self.latencies_ms)
+        pct = lambda p: (  # noqa: E731
+            round(lat[min(len(lat) - 1, int(p * len(lat)))], 3) if lat else 0.0
+        )
+        return {
+            "predict_ok": self.ok,
+            "predict_failed": self.failed,
+            "errors": self.errors,
+            "p50_ms": pct(0.50),
+            "p90_ms": pct(0.90),
+            "p99_ms": pct(0.99),
+        }
+
+
+def launch_task(example, common, job, index, logdir, env):
+    log_path = os.path.join(logdir, f"{job}{index}.log")
+    f = open(log_path, "ab")
+    proc = subprocess.Popen(
+        [sys.executable, example, *common, f"--job_name={job}",
+         f"--task_index={index}"],
+        stdout=f, stderr=subprocess.STDOUT, env=env,
+    )
+    proc._dtx_log = log_path  # type: ignore[attr-defined]
+    proc._dtx_logf = f  # type: ignore[attr-defined]
+    return proc
+
+
+def wait_ps_ready(addrs, deadline_s: float) -> bool:
+    from distributed_tensorflow_examples_tpu.parallel import ps_service
+
+    t_end = time.monotonic() + deadline_s
+    pending = list(addrs)
+    while pending and time.monotonic() < t_end:
+        h, p = pending[0]
+        try:
+            c = ps_service.PSClient(h, p, timeout_s=2.0)
+            c.ping()
+            c.close()
+            pending.pop(0)
+        except Exception:  # noqa: BLE001
+            time.sleep(0.3)
+    return not pending
+
+
+def wait_serve_ready(addrs, deadline_s: float) -> bool:
+    from distributed_tensorflow_examples_tpu import serve
+
+    t_end = time.monotonic() + deadline_s
+    pending = list(addrs)
+    while pending and time.monotonic() < t_end:
+        h, p = pending[0]
+        try:
+            c = serve.ServeClient(
+                h, p, op_timeout_s=2.0, reconnect_deadline_s=0.0,
+            )
+            st = c.stats()
+            c.close()
+            if int(st.get("model_step", -1)) >= 0:
+                pending.pop(0)
+                continue
+        except Exception:  # noqa: BLE001
+            pass
+        time.sleep(0.3)
+    return not pending
+
+
+def analyze_steps(step_series: list[tuple[float, int]], markers: dict) -> dict:
+    """Step-progress verdict from the scrape series: monotone everywhere,
+    and strictly advancing across the chaos window (first→last) and past
+    the LAST chaos marker (the joined worker carrying training alone)."""
+    steps = [s for _, s in step_series if s >= 0]
+    monotone = all(b >= a for a, b in zip(steps, steps[1:]))
+    advanced = len(steps) >= 2 and steps[-1] > steps[0]
+    last_marker = max(markers.values()) if markers else 0.0
+    after_last = [s for t, s in step_series if t >= last_marker and s >= 0]
+    advanced_post_chaos = len(after_last) >= 2 and after_last[-1] > after_last[0]
+    return {
+        "step_first": steps[0] if steps else -1,
+        "step_last": steps[-1] if steps else -1,
+        "step_monotone": bool(monotone),
+        "step_advanced": bool(advanced),
+        "step_advanced_post_chaos": bool(advanced_post_chaos),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--qps", type=float, default=25.0)
+    ap.add_argument("--duration_s", type=float, default=30.0)
+    ap.add_argument("--p99_bound_ms", type=float, default=250.0)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--serve_replicas", type=int, default=2)
+    ap.add_argument("--ps_shards", type=int, default=1)
+    ap.add_argument("--ps_replicas", type=int, default=1)
+    ap.add_argument("--lease_ttl_s", type=float, default=3.0)
+    ap.add_argument("--ready_wait_s", type=float, default=90.0)
+    ap.add_argument(
+        "--boot_offset_s", type=float, default=15.0,
+        help="expected boot window baked into the chaos after_s offsets",
+    )
+    ap.add_argument("--no_chaos", action="store_true")
+    ap.add_argument("--out", default="", help="write the verdict JSON here")
+    ap.add_argument(
+        "--logdir", default="", help="task log directory (default: tmp)"
+    )
+    ap.add_argument(
+        "--example", default=os.path.join(ROOT, "examples", "mnist_mlp.py"),
+    )
+    args = ap.parse_args(argv)
+
+    from distributed_tensorflow_examples_tpu.parallel import membership
+    from distributed_tensorflow_examples_tpu.utils import faults
+    from tools import dtxtop
+
+    faults.set_role("loadsim")
+    logdir = args.logdir or tempfile.mkdtemp(prefix="dtx-loadsim-")
+    n_ps = args.ps_shards * args.ps_replicas
+    join_wid = args.workers  # the joiner takes the next task index
+    ports = free_ports(n_ps + args.serve_replicas)
+    ps_ports, serve_ports = ports[:n_ps], ports[n_ps:]
+    ps_addrs = [("127.0.0.1", p) for p in ps_ports]
+    serve_addrs = [("127.0.0.1", p) for p in serve_ports]
+    plan = (
+        ""
+        if args.no_chaos
+        else build_plan(args.boot_offset_s, args.duration_s, join_wid)
+    )
+    common = [
+        "--sync_replicas=false",
+        "--batch_size=64",
+        "--train_steps=1000000",  # outlives the window; loadsim tears down
+        "--hidden_units=32",
+        f"--ps_hosts={','.join(f'127.0.0.1:{p}' for p in ps_ports)}",
+        f"--ps_shards={args.ps_shards}",
+        f"--ps_replicas={args.ps_replicas}",
+        # The joiner's slot rides at the end of the static list (data
+        # sharding math needs a worker count; membership comes from leases).
+        f"--worker_hosts={','.join(f'127.0.0.1:{7000 + i}' for i in range(args.workers + 1))}",
+        f"--serve_hosts={','.join(f'127.0.0.1:{p}' for p in serve_ports)}",
+        "--ps_restarts=3",
+        f"--lease_ttl_s={args.lease_ttl_s}",
+        "--log_every_steps=50",
+    ]
+    env = dict(os.environ)
+    # Children derive their fault role from --job_name/--task_index; the
+    # orchestrator's own exported role must NOT leak into them (it would
+    # defeat every role glob in the plan).
+    env.pop("DTX_FAULT_ROLE", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["DTX_FAULT_PLAN"] = plan
+    procs: dict[str, subprocess.Popen] = {}
+    spawn_t: dict[str, float] = {}
+
+    def spawn(job: str, index: int) -> None:
+        name = f"{job}{index}"
+        spawn_t[name] = time.monotonic()
+        procs[name] = launch_task(
+            args.example, common, job, index, logdir, env
+        )
+
+    verdict: dict = {
+        "schema_version": VERDICT_SCHEMA_VERSION,
+        "metric": "loadsim_slo",  # perf_gate baseline auto-select key
+        "qps_target": args.qps,
+        "duration_s": args.duration_s,
+        "p99_bound_ms": args.p99_bound_ms,
+        "logdir": logdir,
+        "chaos": not args.no_chaos,
+    }
+    gen = None
+    step_series: list[tuple[float, int]] = []
+    scrape_fail = 0
+    markers: dict[str, float] = {}
+    try:
+        for i in range(n_ps):
+            spawn("ps", i)
+        if not wait_ps_ready(ps_addrs, args.ready_wait_s):
+            raise RuntimeError(f"PS tasks never came up (logs: {logdir})")
+        spawn("chief", 0)
+        for i in range(args.workers):
+            spawn("worker", i)
+        for i in range(args.serve_replicas):
+            spawn("serve", i)
+        if not wait_serve_ready(serve_addrs, args.ready_wait_s):
+            raise RuntimeError(
+                f"serve replicas never pulled a model (logs: {logdir})"
+            )
+
+        gen = LoadGenerator(
+            ps_addrs, serve_addrs, qps=args.qps,
+            deadline_s=max(30.0, args.duration_s),
+        )
+        gen.start()
+        t0 = time.monotonic()
+        t_end = t0 + args.duration_s
+        if not args.no_chaos:
+            # The chaos after_s timers are anchored to each PROCESS's own
+            # start (arm time), not to load start — on a fast boot the
+            # last event (the leave) can land past t0 + duration.  Extend
+            # the observed window to cover every scheduled event plus a
+            # grace, so the cycle always completes INSIDE the measured
+            # run (the fired-event gates below then prove it did).
+            last_event = max(
+                spawn_t.get("worker0", t0)
+                + args.boot_offset_s
+                + PHASES["leave_worker"] * args.duration_s,
+                spawn_t.get("worker1", t0)
+                + args.boot_offset_s
+                + PHASES["kill_worker"] * args.duration_s,
+            )
+            t_end = max(t_end, last_event + 4.0)
+        join_at = {
+            s.role: t0 + PHASES["join_worker"] * args.duration_s
+            for s in faults.join_specs(plan)
+        }
+        for name, frac in PHASES.items():
+            markers[name] = t0 + frac * args.duration_s
+        midrun_done = False
+        joined = False
+        while time.monotonic() < t_end:
+            # Orchestrated joins: spawn the new member processes mid-run.
+            for role, when in list(join_at.items()):
+                if time.monotonic() >= when:
+                    wid = membership.member_index(role)
+                    spawn("worker", wid)
+                    joined = True
+                    faults.log_event("loadsim_join_spawned", member=role)
+                    del join_at[role]
+            # Scrape over the same wires any operator tooling uses; serve
+            # replicas come from the LEASE registry (elastic discovery).
+            try:
+                snap = dtxtop.snapshot(
+                    ps_addrs, ps_shards=args.ps_shards,
+                    ps_replicas=args.ps_replicas, timeout_s=3.0,
+                )
+                steps = snap["summary"]["serve"]["model_steps"]
+                step_series.append(
+                    (time.monotonic(), max(steps) if steps else -1)
+                )
+                verdict["members_last"] = snap["summary"]["members"]
+            except Exception:  # noqa: BLE001 — mid-failover scrapes may miss
+                scrape_fail += 1
+            # THE acceptance probe: once the joiner is up, the real dtxtop
+            # CLI must exit 0 and show its lease.
+            if joined and not midrun_done and not args.no_chaos and (
+                time.monotonic()
+                >= markers["join_worker"] + max(3.0, 2 * args.lease_ttl_s)
+            ):
+                midrun_done = True
+                cli = subprocess.run(
+                    [sys.executable, "-m", "tools.dtxtop", "--json",
+                     "--ps_hosts="
+                     + ",".join(f"127.0.0.1:{p}" for p in ps_ports),
+                     f"--ps_shards={args.ps_shards}",
+                     f"--ps_replicas={args.ps_replicas}"],
+                    capture_output=True, text=True, cwd=ROOT, env=env,
+                    timeout=120,
+                )
+                verdict["dtxtop_exit"] = cli.returncode
+                try:
+                    cli_snap = json.loads(cli.stdout.strip().splitlines()[-1])
+                    verdict["join_lease_seen"] = (
+                        f"worker{join_wid}"
+                        in cli_snap["summary"]["members"]["workers"]
+                    )
+                except Exception:  # noqa: BLE001
+                    verdict["join_lease_seen"] = False
+            time.sleep(1.0)
+        verdict["window_s"] = round(time.monotonic() - t0, 1)
+    finally:
+        load = gen.stop() if gen is not None else {
+            "predict_ok": 0, "predict_failed": -1, "errors": ["never ran"],
+            "p50_ms": 0.0, "p90_ms": 0.0, "p99_ms": 0.0,
+        }
+        # Teardown: chief/workers first (SIGKILL — the run is over), then
+        # the supervised services (SIGTERM forwards and ends supervision).
+        for name, p in procs.items():
+            if p.poll() is None:
+                p.send_signal(
+                    signal.SIGTERM
+                    if name.startswith(("ps", "serve"))
+                    else signal.SIGKILL
+                )
+        deadline = time.monotonic() + 15.0
+        for p in procs.values():
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+            getattr(p, "_dtx_logf").close()
+
+    window = verdict.get("window_s") or args.duration_s
+    verdict.update(load)
+    verdict["qps_achieved"] = round(load["predict_ok"] / window, 2)
+    verdict["scrape_failures"] = scrape_fail
+    verdict.update(analyze_steps(step_series, markers))
+    if not args.no_chaos:
+        # The chaos events must have FIRED inside the run (their timers
+        # are per-process; a timing drift that quietly skipped one would
+        # otherwise report a passing verdict for a cycle that never
+        # happened).  The task logs are the evidence.
+        def _fired(name: str, needle: str) -> bool:
+            p = procs.get(name)
+            path = getattr(p, "_dtx_log", "") if p is not None else ""
+            try:
+                with open(path, "rb") as f:
+                    return needle.encode() in f.read()
+            except OSError:
+                return False
+
+        verdict["kills_fired"] = {
+            n: _fired(n, "event=inject_die")
+            for n in ("ps0", "serve0", "worker1")
+        }
+        verdict["leave_fired"] = _fired("worker0", "event=inject_leave")
+    gates = {
+        "zero_failed_predicts": load["predict_failed"] == 0,
+        "p99_under_bound": 0.0 < load["p99_ms"] <= args.p99_bound_ms,
+        "qps_at_target": verdict["qps_achieved"] >= 0.6 * args.qps,
+        "step_monotone": verdict["step_monotone"],
+        "step_advanced": verdict["step_advanced"],
+    }
+    if not args.no_chaos:
+        gates["step_advanced_post_chaos"] = verdict["step_advanced_post_chaos"]
+        gates["dtxtop_midrun_exit0"] = verdict.get("dtxtop_exit") == 0
+        gates["join_lease_seen"] = bool(verdict.get("join_lease_seen"))
+        gates["kills_fired"] = all(verdict["kills_fired"].values())
+        gates["leave_fired"] = verdict["leave_fired"]
+    verdict["gates"] = gates
+    verdict["slo_pass"] = all(gates.values())
+    # The perf-gate metric field: campaign baselines key off it.
+    verdict["loadsim_p99_ms"] = load["p99_ms"]
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(verdict, f, indent=2)
+    print(json.dumps(verdict))
+    return 0 if verdict["slo_pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

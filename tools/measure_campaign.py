@@ -167,6 +167,16 @@ def steps_plan() -> list[dict]:
         dict(name="obs_snapshot",
              cmd=[PY, "tools/obs_snapshot_step.py"], timeout=600,
              cpu_ok=True),
+        # Elasticity acceptance rig (r14): a short closed-loop chaos load
+        # sim — real multi-process train+serve cluster, one kill/join/
+        # leave cycle, SLO-gated verdict (zero failed predicts, p99 under
+        # bound, step monotone through the chaos).  The standing
+        # acceptance ROADMAP items 1-4 gate on; JAX-on-CPU, so cpu_ok.
+        # Verdict gated against tools/loadsim_baseline.json by perf_gate.
+        dict(name="loadsim",
+             cmd=[PY, "tools/loadsim.py", "--qps", "25", "--duration_s",
+                  "30", "--p99_bound_ms", "400"],
+             timeout=900, cpu_ok=True),
     ]
     return plan
 

@@ -64,6 +64,7 @@ BASELINES = {
     "ps_transport_set_get_mbs": "ps_transport_baseline.json",
     "data_service_stream_mbs": "data_service_baseline.json",
     "serving_qps": "serving_baseline.json",
+    "loadsim_slo": "loadsim_baseline.json",
 }
 
 
@@ -75,10 +76,42 @@ def gate(
     result: dict, baseline: dict, *, tolerance: float, if_newer_ratio: float,
     remote_local_ratio: float = 0.5, sharded_speedup: float = 1.3,
     serving_speedup: float = 3.0, replicated_overhead: float = 1.6,
+    loadsim_p99_ratio: float = 20.0,
 ) -> list[str]:
     """Returns a list of human-readable regression lines (empty = pass)."""
     res, base = _detail(result), _detail(baseline)
     failures: list[str] = []
+    # The r14 elasticity acceptance (tools/loadsim.py verdicts): the SLO
+    # verdict itself is binary — every gate (zero failed predicts, p99
+    # under the checked-in bound, step monotone+advancing through the
+    # kill/join/leave cycle, join lease observed) must hold — and a gate
+    # PRESENT in the baseline must still be computed by the result (a
+    # gutted loadsim cannot silently pass by dropping a check).  The p99
+    # compare against baseline is a loose cross-host tripwire only; the
+    # hard latency bound is the result's own p99_bound_ms gate.
+    if "slo_pass" in res or "slo_pass" in base:
+        if not res.get("slo_pass"):
+            bad = sorted(
+                g for g, ok in (res.get("gates") or {}).items() if not ok
+            )
+            failures.append(
+                "loadsim: slo_pass False"
+                + (f" (failing gates: {', '.join(bad)})" if bad else "")
+            )
+        for g in base.get("gates") or {}:
+            if g not in (res.get("gates") or {}):
+                failures.append(
+                    f"loadsim: gate {g!r} missing from result — the SLO "
+                    "check set shrank"
+                )
+        bp99, rp99 = base.get("p99_ms"), res.get("p99_ms")
+        if bp99 and rp99 and rp99 > loadsim_p99_ratio * bp99:
+            failures.append(
+                f"loadsim: p99_ms {rp99:.1f} > {loadsim_p99_ratio} x "
+                f"baseline {bp99:.1f} — serve latency structurally "
+                "regressed under chaos"
+            )
+        return failures  # loadsim verdicts carry no bench rows below
     # The r10 serving acceptance bound, from the result alone: coalescing
     # concurrent requests into one jitted apply must genuinely amortize —
     # batched (N concurrent clients) throughput >= serving_speedup x the
@@ -231,6 +264,11 @@ def main():
                     help="max replicated-push latency multiplier over the "
                     "unreplicated push (r12: the dedup mirror is "
                     "header-only, so ~1 extra small round trip)")
+    ap.add_argument("--loadsim-p99-ratio", type=float, default=20.0,
+                    help="loose cross-host tripwire for loadsim verdicts: "
+                    "max p99_ms multiplier over the checked-in baseline "
+                    "(the hard bound is the verdict's own p99_bound_ms "
+                    "gate)")
     args = ap.parse_args()
     with open(args.result) as f:
         result = json.load(f)
@@ -257,6 +295,7 @@ def main():
         sharded_speedup=args.sharded_speedup,
         serving_speedup=args.serving_speedup,
         replicated_overhead=args.replicated_overhead,
+        loadsim_p99_ratio=args.loadsim_p99_ratio,
     )
     if failures:
         print("PERF_GATE FAIL")
