@@ -1156,7 +1156,7 @@ def host_data_service_task(
     data_dir: str, port: int, *, batch_size: int, seed: int = 0,
     loopback_only: bool = True,
     ps_addrs: list[tuple[str, int]] | None = None,
-    lease_poll_s: float = 2.0,
+    lease_poll_s: float = 2.0, ps_layout_version: int = 0,
 ) -> int:
     """Dedicated data-service task body (``--job_name=data_service``): host
     the server until a client signals DSVC_SHUTDOWN (or the supervisor
@@ -1191,9 +1191,14 @@ def host_data_service_task(
                 server.mark_worker_stale(wid)
 
         try:
+            # follow_epoch (r15): a live PS reshard moves the lease
+            # registry to the new layout's coordinator; the watcher chases
+            # the committed epoch so split reassignment keeps following
+            # the membership signal across an N→M transition.
             watcher = membership.LeaseWatcher(
                 list(ps_addrs), kind="worker", poll_s=lease_poll_s,
-                on_leave=_member_left,
+                on_leave=_member_left, follow_epoch=True,
+                layout_version=ps_layout_version,
             )
         except (OSError, RuntimeError):
             log.warning(

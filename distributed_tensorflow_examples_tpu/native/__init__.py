@@ -171,6 +171,8 @@ def _load() -> ctypes.CDLL:
         lib.ps_server_requests_port.argtypes = [ctypes.c_int]
         lib.ps_server_stop_port.restype = ctypes.c_int
         lib.ps_server_stop_port.argtypes = [ctypes.c_int]
+        lib.ps_server_set_draining.restype = ctypes.c_int
+        lib.ps_server_set_draining.argtypes = [ctypes.c_int, ctypes.c_int]
         _lib = lib
     return _lib
 

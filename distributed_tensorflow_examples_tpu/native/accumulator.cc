@@ -593,6 +593,25 @@ int64_t pstore_step(void* h) {
   return p->step;
 }
 
+// Ranged pull (r15 live resharding): copies elements [start, start+count)
+// of the snapshot into `out` (caller pre-clamps the range to the object's
+// size — the wire layer's ranged REPL_SYNC does); returns the step.  A
+// new-layout shard assembling its slice from several old shards pulls
+// exactly the overlap from each, never a full O(params) copy per source.
+int64_t pstore_get_range(void* h, int64_t start, int64_t count, float* out) {
+  auto* p = static_cast<ParamStore*>(h);
+  std::lock_guard<std::mutex> lock(p->mu);
+  const int64_t n = static_cast<int64_t>(p->data.size());
+  int64_t lo = start < 0 ? 0 : (start > n ? n : start);
+  int64_t c = count < 0 ? 0 : count;
+  // Overflow-safe clamp: lo is within [0, n], so n - lo cannot wrap.
+  if (c > n - lo) c = n - lo;
+  if (c > 0)
+    std::memcpy(out, p->data.data() + lo,
+                static_cast<size_t>(c) * sizeof(float));
+  return p->step;
+}
+
 // Versioned pull: copies the snapshot into `out` ONLY when its step is
 // newer than `have_step`; returns the current step either way.  The caller
 // holding a cached copy of step `have_step` learns "unchanged" for the

@@ -92,6 +92,7 @@ int64_t pstore_get(void*, float*);
 int64_t pstore_step(void*);
 int64_t pstore_get_if_newer(void*, int64_t, float*);
 int64_t pstore_num_elems(void*);
+int64_t pstore_get_range(void*, int64_t, int64_t, float*);
 // Replication mirror/state ops (r12, accumulator.cc).
 int acc_mirror_tagged(void*, int64_t, int64_t, int64_t);
 int64_t acc_global_step(void*);
@@ -184,6 +185,27 @@ enum Op : uint8_t {
   LEASE_ACQUIRE = 31,
   LEASE_RELEASE = 32,
   LEASE_LIST = 33,
+  // Live resharding (r15).  The coordinator shard stores one opaque raw
+  // JSON record per slot (PENDING / COMMITTED) — parallel/reshard.py owns
+  // the schema; the server only versions and hands back the bytes.
+  // RESHARD_BEGIN: a = new epoch version, payload = the record (raw
+  // 4-byte units, never dtype-encoded); stores/overwrites the pending
+  // slot, refused (-2) unless a is above the committed version.
+  // RESHARD_COMMIT: a = version; promotes a matching pending record
+  // (idempotent when already committed at that version).  RESHARD_GET:
+  // a = caller's known version, b = slot (0 committed / 1 pending);
+  // status = the slot's version (0 = empty), payload only when newer
+  // than a — the steady-state epoch poll is O(header).  RESHARD_ABORT:
+  // a = version; clears a matching pending record (1 cleared / 0 none).
+  // All four are excluded from the request counter (poll-cadence
+  // control-plane ops, like STATS/LEASE).  REPL_SYNC additionally
+  // accepts a RANGE (a = start element, b = count > 0): the ranged blob
+  // carries ONLY param-store objects, sliced — the transfer a new-layout
+  // shard task assembles its slice from (see the ranged layout below).
+  RESHARD_BEGIN = 34,
+  RESHARD_COMMIT = 35,
+  RESHARD_GET = 36,
+  RESHARD_ABORT = 37,
 };
 
 // v3 (r12): HELLO b-word field relayout — see wire.py WIRE_VERSION.
@@ -338,6 +360,26 @@ struct Server {
   std::mutex lease_mu;
   std::map<std::string, Lease> leases;
   std::atomic<int64_t> leases_expired{0};
+  // Live resharding (r15): the coordinator-hosted transition records.
+  // PENDING = a transition being prepared (new tasks announce it, the
+  // chief verifies + commits or aborts); COMMITTED = the current layout
+  // epoch every client converges to.  Blobs are opaque JSON bytes
+  // (4-byte padded) — parallel/reshard.py owns the schema.  Own mutex:
+  // the per-iteration client epoch poll must never contend with the
+  // object table's hot path.
+  std::mutex reshard_mu;
+  int64_t reshard_pending_version = 0;
+  std::string reshard_pending_blob;
+  int64_t reshard_version = 0;
+  std::string reshard_blob;
+  // Ranged REPL_SYNC transfers served (the per-shard sync-progress
+  // counter STATS exports as `reshard_syncs` — a mid-transition cluster's
+  // old shards show it advancing as the new layout pulls its slices).
+  std::atomic<int64_t> reshard_syncs{0};
+  // Drain state (r15): set when the host enters drain-then-exit after a
+  // reshard retired this server's layout — exported in STATS so dtxtop
+  // renders a draining old shard distinctly from a serving one.
+  std::atomic<bool> draining{false};
   std::atomic<bool> stopping{false};
   std::thread accept_thread;
   // Live connection fds: stop() shuts them down so blocked readers exit
@@ -701,6 +743,53 @@ std::vector<uint8_t> build_state_blob(Server* s) {
   return blob;
 }
 
+// --- Ranged REPL_SYNC blob (r15 live resharding) ----------------------------
+// A new-layout shard task assembles its slice of the flat parameter vector
+// from the OLD layout's servers: each overlapping old shard answers the
+// requested LOCAL element range of its param-store objects.  Byte layout
+// (little-endian): i64 state_token | u32 n_objects | per 'p' object:
+// u8 'p', u16 name_len, name, i64 total_n, i64 start, i64 count,
+// i64 step, f32 data[count] — start/count are the CLAMPED intersection of
+// the request with [0, total_n), so an out-of-range ask answers count=0
+// instead of garbage.  Param-store objects only: gradient/accumulator
+// contents are in-flight state a reshard deliberately abandons (the same
+// at-most-once posture as a failover), and dedup tables re-scope per
+// epoch on the fresh servers.  Parsed by parallel/reshard.py, never by
+// install_state_blob.
+std::vector<uint8_t> build_ranged_sync_blob(Server* s, int64_t start,
+                                            int64_t count) {
+  std::vector<uint8_t> blob;
+  put<int64_t>(blob, s->state_token);
+  std::vector<std::pair<std::string, Object>> objs;
+  {
+    std::lock_guard<std::mutex> lock(s->mu);
+    for (const auto& kv : s->objects)
+      if (kv.second.kind == 'p') objs.emplace_back(kv.first, kv.second);
+  }
+  put<uint32_t>(blob, static_cast<uint32_t>(objs.size()));
+  for (auto& [name, o] : objs) {
+    const int64_t n = pstore_num_elems(o.handle);
+    int64_t lo = start < 0 ? 0 : (start > n ? n : start);
+    int64_t c = count < 0 ? 0 : count;
+    // Overflow-safe clamp (`lo + c` could wrap on a wire-supplied i64):
+    // lo is already within [0, n], so n - lo cannot.
+    if (c > n - lo) c = n - lo;
+    put<uint8_t>(blob, 'p');
+    put<uint16_t>(blob, static_cast<uint16_t>(name.size()));
+    blob.insert(blob.end(), name.begin(), name.end());
+    put<int64_t>(blob, n);
+    put<int64_t>(blob, lo);
+    put<int64_t>(blob, c);
+    const size_t at = blob.size() + 8;  // step written below, then data
+    blob.resize(blob.size() + 8 + static_cast<size_t>(c) * 4);
+    const int64_t step = pstore_get_range(
+        o.handle, lo, c, reinterpret_cast<float*>(blob.data() + at));
+    std::memcpy(blob.data() + at - 8, &step, 8);
+  }
+  s->reshard_syncs.fetch_add(1, std::memory_order_relaxed);
+  return blob;
+}
+
 // Parse-and-install the peer's state blob (start-time sync: runs before
 // this server accepts connections, so no locking races with handlers).
 // Returns false on a truncated/garbled blob (state left partially
@@ -903,7 +992,13 @@ std::string build_stats_json(Server* s) {
     prune_leases_locked(s, std::chrono::steady_clock::now());
     n_leases = static_cast<int64_t>(s->leases.size());
   }
-  char buf[1152];
+  int64_t rs_pending, rs_committed;
+  {
+    std::lock_guard<std::mutex> lk(s->reshard_mu);
+    rs_pending = s->reshard_pending_version;
+    rs_committed = s->reshard_version;
+  }
+  char buf[1280];
   int n = std::snprintf(
       buf, sizeof(buf),
       "{\"service\":\"ps\",\"shard_id\":%d,\"shard_count\":%d,"
@@ -913,6 +1008,8 @@ std::string build_stats_json(Server* s) {
       "\"fwd_ok\":%lld,\"fwd_peer_down\":%lld,\"fwd_refused\":%lld,"
       "\"repl_syncs_served\":%lld,\"mirror_applies\":%lld,"
       "\"leases\":%lld,\"leases_expired\":%lld,"
+      "\"reshard_syncs\":%lld,\"draining\":%d,"
+      "\"reshard_pending\":%lld,\"reshard_committed\":%lld,"
       "\"acc_deduped\":%lld,\"acc_dropped\":%lld,"
       "\"gq_deduped\":%lld,\"gq_dropped\":%lld}",
       s->shard_id, s->shard_count,
@@ -934,6 +1031,10 @@ std::string build_stats_json(Server* s) {
       static_cast<long long>(n_leases),
       static_cast<long long>(
           s->leases_expired.load(std::memory_order_relaxed)),
+      static_cast<long long>(
+          s->reshard_syncs.load(std::memory_order_relaxed)),
+      s->draining.load() ? 1 : 0, static_cast<long long>(rs_pending),
+      static_cast<long long>(rs_committed),
       static_cast<long long>(acc_ded), static_cast<long long>(acc_drop),
       static_cast<long long>(gq_ded), static_cast<long long>(gq_drop));
   if (n < 0 || n >= static_cast<int>(sizeof(buf))) return "{}";
@@ -1006,6 +1107,13 @@ void serve_conn_impl(Server* s, int fd) {
       case LEASE_ACQUIRE:
       case LEASE_RELEASE:
       case LEASE_LIST:
+      // Reshard ops (r15) are poll-cadence control plane too: every
+      // client polls RESHARD_GET between steps, so counting it would
+      // make after_reqs triggers drift with the poll period.
+      case RESHARD_BEGIN:
+      case RESHARD_COMMIT:
+      case RESHARD_GET:
+      case RESHARD_ABORT:
         break;
       default:
         s->requests.fetch_add(1, std::memory_order_relaxed);
@@ -1045,6 +1153,68 @@ void serve_conn_impl(Server* s, int fd) {
       js.resize((js.size() + 3) & ~size_t{3}, ' ');
       if (!write_frame(fd, 0, static_cast<uint32_t>(js.size() / 4),
                        js.data(), js.size()))
+        break;
+      continue;
+    }
+    // Reshard records (r15): early-dispatched — their payloads are RAW
+    // bytes in 4-byte units on BOTH directions (a bf16 connection's
+    // epoch poll reads the same bytes as an f32 one), so they must
+    // bypass the dtype-encoded paths entirely.
+    if (op == RESHARD_BEGIN || op == RESHARD_COMMIT || op == RESHARD_GET ||
+        op == RESHARD_ABORT) {
+      int64_t status = -2;
+      std::string payload_out;
+      if (op == RESHARD_BEGIN) {
+        // a = the new epoch version; payload = the opaque record.  64 KiB
+        // cap: the record is a host list + a few scalars, never bulk —
+        // checked BEFORE sizing the buffer, so a lying u32 can never
+        // drive a multi-GiB allocation (oversized payloads drain).
+        const bool ok = plen <= (16u << 10);
+        std::string blob;
+        if (ok) blob.assign(static_cast<size_t>(plen) * 4, '\0');
+        if (plen && ok && !read_n(fd, blob.data(), blob.size())) break;
+        if (plen && !ok && !drain_n(fd, static_cast<size_t>(plen) * 4)) break;
+        if (ok && a > 0 && plen) {
+          std::lock_guard<std::mutex> lk(s->reshard_mu);
+          if (a > s->reshard_version) {
+            s->reshard_pending_version = a;
+            s->reshard_pending_blob = std::move(blob);
+            status = 0;
+          }
+        }
+      } else {
+        if (plen && !drain_n(fd, static_cast<size_t>(plen) * 4)) break;
+        std::lock_guard<std::mutex> lk(s->reshard_mu);
+        if (op == RESHARD_COMMIT) {
+          if (a > 0 && a == s->reshard_pending_version) {
+            s->reshard_version = s->reshard_pending_version;
+            s->reshard_blob = std::move(s->reshard_pending_blob);
+            s->reshard_pending_version = 0;
+            s->reshard_pending_blob.clear();
+            status = 0;
+          } else if (a > 0 && a == s->reshard_version) {
+            status = 0;  // idempotent re-commit
+          }
+        } else if (op == RESHARD_ABORT) {
+          status = 0;
+          if (a > 0 && a == s->reshard_pending_version) {
+            s->reshard_pending_version = 0;
+            s->reshard_pending_blob.clear();
+            status = 1;
+          }
+        } else {  // RESHARD_GET: a = known version, b = slot
+          const bool pending = b == 1;
+          const int64_t v =
+              pending ? s->reshard_pending_version : s->reshard_version;
+          status = v;
+          if (v > a)
+            payload_out = pending ? s->reshard_pending_blob : s->reshard_blob;
+        }
+      }
+      payload_out.resize((payload_out.size() + 3) & ~size_t{3}, ' ');
+      if (!write_frame(fd, status,
+                       static_cast<uint32_t>(payload_out.size() / 4),
+                       payload_out.data(), payload_out.size()))
         break;
       continue;
     }
@@ -1206,6 +1376,27 @@ void serve_conn_impl(Server* s, int fd) {
         if (!write_frame(fd, -2, 0, nullptr, 0)) break;
         continue;
       }
+      if (b != 0) {
+        // Ranged form (r15): a = start element, b = count — the
+        // slice-ranged param-store transfer a new-layout shard task
+        // assembles its slice from (see build_ranged_sync_blob).  A
+        // NEGATIVE count is the metadata probe (object names / sizes /
+        // steps, zero data bytes — the layout-discovery read); b == 0
+        // keeps the r12 full-state sync wire unchanged.
+        std::vector<uint8_t> rblob = build_ranged_sync_blob(s, a, b);
+        rblob.resize((rblob.size() + 3) & ~size_t{3});
+        int64_t n_p;
+        {
+          std::lock_guard<std::mutex> lock(s->mu);
+          n_p = 0;
+          for (const auto& kv : s->objects)
+            if (kv.second.kind == 'p') ++n_p;
+        }
+        if (!write_frame(fd, n_p, static_cast<uint32_t>(rblob.size() / 4),
+                         rblob.data(), rblob.size()))
+          break;
+        continue;
+      }
       std::vector<uint8_t> blob = build_state_blob(s);
       blob.resize((blob.size() + 3) & ~size_t{3});  // pad to 4-byte units
       s->repl_syncs_served.fetch_add(1, std::memory_order_relaxed);
@@ -1292,6 +1483,13 @@ void serve_conn_impl(Server* s, int fd) {
       case LEASE_LIST:
         // Dispatched BEFORE this switch (raw JSON blob, like STATS);
         // label pinned for the wire-conformance lint.
+        break;
+      case RESHARD_BEGIN:
+      case RESHARD_COMMIT:
+      case RESHARD_GET:
+      case RESHARD_ABORT:
+        // Dispatched BEFORE this switch (raw record blobs both ways);
+        // labels pinned for the wire-conformance lint.
         break;
       case LEASE_ACQUIRE: {
         // a = ttl_ms.  1 = newly acquired (fresh member, or re-acquire
@@ -1758,6 +1956,18 @@ int ps_server_live_conns_port(int port) {
   std::lock_guard<std::mutex> lock(g_server_mu);
   Server* s = find_port(port);
   return s ? s->live_conns.load() : -1;
+}
+
+// Drain flag (r15 live resharding): a reshard retired this server's
+// layout and the host entered drain-then-exit — exported in STATS as
+// `draining`, so dtxtop renders a draining old shard distinctly while
+// its last clients swap away.  Returns 1 on success, 0 = no such server.
+int ps_server_set_draining(int port, int on) {
+  std::lock_guard<std::mutex> lock(g_server_mu);
+  Server* s = find_port(port);
+  if (!s) return 0;
+  s->draining.store(on != 0);
+  return 1;
 }
 
 }  // extern "C"

@@ -114,6 +114,22 @@ class AsyncPSConfig:
     #: as departed (its splits reassigned, its lease pruned).  Renewals
     #: run at ttl/3.
     lease_ttl_s: float = 10.0
+    #: Live resharding (r15): whether the chief ADOPTS a pending layout
+    #: epoch announced on the coordinator (new shard tasks started with
+    #: ``--ps_reshard_to``), and whether workers/clients follow committed
+    #: epochs.  Off = the pre-r15 frozen-topology posture.
+    reshard_watch: bool = True
+    #: Epoch-poll cadence for every follower (chief pending-poll, worker
+    #: committed-poll).  Each unchanged poll is one O(header) round trip.
+    reshard_poll_s: float = 0.5
+    #: How long the chief waits for every new-layout shard to present a
+    #: synced snapshot before ABORTING the transition loudly (the
+    #: never-half-applies guarantee: a joiner killed mid-transition fails
+    #: this probe and the old topology serves on).
+    reshard_ready_timeout_s: float = 60.0
+    #: How long a retired old-layout task waits out its remaining client
+    #: connections (drain) before exiting anyway.
+    reshard_drain_s: float = 20.0
 
 
 class AsyncPSTrainer:
@@ -271,12 +287,21 @@ class AsyncPSTrainer:
     #: averaged count).  RemotePSChief (the socket path) enables it.
     sync_stall_repush_s: float | None = None
 
+    def _reshard_tick(self) -> None:
+        """Live-resharding hook (r15): overridden by the socket chief to
+        adopt a pending layout epoch; a no-op in thread mode (there is no
+        topology to change inside one process)."""
+
     def _chief_sync(self):
         n_agg = self.cfg.replicas_to_aggregate or self.cfg.num_workers
         acc = self._accs[0]
         acc.set_global_step(self.global_step)
         self._tq.push(self.global_step, self.cfg.num_workers)
         while self.global_step < self.cfg.train_steps:
+            # Accumulators/token queue may be SWAPPED by a reshard tick
+            # (socket chief): tick first, then re-read them.
+            self._reshard_tick()
+            acc = self._accs[0]
             out = acc.take(n_agg, timeout_s=self.sync_stall_repush_s)
             if out is native.TIMED_OUT:
                 faults.log_event(
@@ -540,9 +565,18 @@ class RemotePSChief(AsyncPSTrainer):
         )
         role = faults.current_role() or "chief0"
         self.ps_replicas = int(ps_replicas)
+        self._role = role
+        self._client_kw = dict(client_kw)
         #: Chief reseeds performed (the last-resort path) — the replicated
         #: acceptance gate asserts this stays ZERO across a primary kill.
+        #: The resharding acceptance gate (r15) asserts it stays zero
+        #: across a whole N→M→N cycle too: the new layout's state comes
+        #: from ranged REPL_SYNC + the chief's swap-time republish, never
+        #: from the reseed path.
         self.reseeds = 0
+        #: Committed layout-epoch transitions this chief performed (r15).
+        self.reshards = 0
+        self._next_reshard_poll = 0.0
         if ps_addrs is not None:
             self._owns_server = False
             n = len(ps_addrs) // self.ps_replicas
@@ -608,6 +642,305 @@ class RemotePSChief(AsyncPSTrainer):
         for i, c in enumerate(self._group.clients):
             c.on_reincarnation(lambda i=i: self._reseed_ps_state(i))
         self._publish()
+
+    # -- live resharding (r15): the chief side of the epoch transition -------
+
+    @property
+    def layout_version(self) -> int:
+        return self._layout.version
+
+    def _reshard_tick(self) -> None:
+        """Adopt a PENDING layout epoch announced on the coordinator (new
+        shard tasks started with ``--ps_reshard_to``), time-gated to one
+        O(header) poll per ``cfg.reshard_poll_s``.  Runs between applied
+        updates in both chief loops — the swap happens at a quiescent
+        point of the chief's own state, never mid-gather."""
+        from . import reshard
+
+        if not self.cfg.reshard_watch:
+            return
+        now = time.monotonic()
+        if now < self._next_reshard_poll:
+            return
+        self._next_reshard_poll = now + self.cfg.reshard_poll_s
+        try:
+            rec = reshard.poll_pending(self._group.coordinator)
+        except Exception:  # noqa: BLE001 — coordinator mid-failover
+            return
+        if rec is None or rec["version"] <= self._layout.version:
+            return
+        self._adopt_record(rec)
+
+    def _adopt_record(self, rec: dict) -> bool:
+        """Verify → republish → commit → swap → drain: the whole epoch
+        transition, driven by one pending record.  Returns True when the
+        new layout was committed; a failed verify ABORTS the pending
+        record loudly and keeps the old topology serving — a transition
+        completes or aborts, never half-applies."""
+        from . import ps_service, ps_shard, reshard
+
+        version, total = rec["version"], sum(self._leaf_sizes)
+        faults.log_event(
+            "reshard_adopting", version=version, shards=rec["shards"],
+            step=self.global_step,
+        )
+        if rec["num_elems"] != total:
+            log.error(
+                "reshard v%d names %d elems but this run trains %d — "
+                "aborting the transition", version, rec["num_elems"], total,
+            )
+            self._reshard_abort(version)
+            return False
+        # VERIFY: dial every new shard (epoch-pinned HELLO) and wait for a
+        # synced snapshot.  A joiner killed mid-transition fails here.
+        new_group = None
+        try:
+            new_group = ps_shard.ShardedPSClients.for_record(
+                rec, role=self._role, **self._client_kw
+            )
+            new_layout = new_group.layout_for(total)
+            new_pstore = ps_shard.ShardedParamStore(
+                new_group, "params", new_layout
+            )
+            deadline = time.monotonic() + self.cfg.reshard_ready_timeout_s
+            while True:
+                step, _ = new_pstore.get()
+                if step >= 0:
+                    break
+                if time.monotonic() >= deadline:
+                    raise ConnectionError(
+                        f"new layout v{version} never presented a synced "
+                        f"snapshot within {self.cfg.reshard_ready_timeout_s}s"
+                    )
+                time.sleep(0.1)
+            # Republish the CURRENT params at the CURRENT step onto the
+            # new layout: the swap must never serve the (stale) step the
+            # joiners synced at.
+            new_pstore.set(self.global_step, self._flat_params())
+            # Recreate the coordination objects on the new topology; the
+            # dedup tag space re-scopes with them (fresh servers, fresh
+            # tables — every swapped worker re-announces via
+            # *_RESET_WORKER and restarts its 0-based stream).
+            new_tq = ps_service.RemoteTokenQueue(
+                new_group.coordinator, "tokens"
+            )
+            if self.cfg.mode == "sync_replicas":
+                new_accs = [
+                    ps_shard.ShardedAccumulator(new_group, "acc", new_layout)
+                ]
+                new_accs[0].set_global_step(self.global_step)
+                new_gq = None
+            else:
+                new_accs = []
+                new_gq = ps_shard.ShardedGradientQueue(
+                    new_group, "gq", new_layout,
+                    capacity=max(4, 2 * self.cfg.num_workers),
+                )
+                if self.cfg.max_staleness is not None:
+                    new_gq.set_min_step(
+                        self.global_step - self.cfg.max_staleness
+                    )
+            # Seed the NEW coordinator's record slots: late joiners and
+            # restarted members discover the committed topology from
+            # either end, and dtxtop follows the chain.
+            blob = reshard.pack_record(
+                version, rec["addrs"], total, replicas=rec["replicas"],
+                from_version=rec["from"]["version"],
+                from_addrs=rec["from"]["addrs"],
+                from_replicas=rec["from"]["replicas"],
+            )
+            new_group.coordinator.reshard_announce(version, blob)
+            new_group.coordinator.reshard_commit(version)
+        except Exception as e:  # noqa: BLE001 — abort, keep old topology
+            log.error("reshard v%d failed verification: %s", version, e)
+            faults.log_event(
+                "reshard_aborted", version=version, error=type(e).__name__,
+            )
+            telemetry.REGISTRY.inc("ps_chief/reshard_aborts")
+            if new_group is not None:
+                new_group.close()
+            self._reshard_abort(version)
+            return False
+        # COMMIT on the old coordinator: every polling client now swaps.
+        old_group, old_layout = self._group, self._layout
+        old_replica_addrs = [
+            a for rl in zip(*old_group.replica_addrs) for a in rl
+        ] if old_group.replica_addrs else []
+        try:
+            old_group.coordinator.reshard_commit(version)
+        except Exception:  # noqa: BLE001
+            # The old coordinator died at the worst moment: the pending
+            # record is gone with it, but the NEW topology is already
+            # committed on its own coordinator — finish the swap; old
+            # clients heal through their own recovery paths.
+            log.exception("old-coordinator commit failed; swapping anyway")
+        # SWAP the chief's own state.
+        self._group, self._layout = new_group, new_layout
+        self._client = new_group.coordinator
+        self._pstore, self._tq = new_pstore, new_tq
+        if self.cfg.mode == "sync_replicas":
+            self._accs = new_accs
+            if self.global_step < self.cfg.train_steps:
+                self._tq.push(self.global_step, self.cfg.num_workers)
+        else:
+            self._gq = new_gq
+        for i, c in enumerate(new_group.clients):
+            c.on_reincarnation(lambda i=i: self._reseed_ps_state(i))
+        self.reshards += 1
+        telemetry.REGISTRY.inc("ps_chief/reshards")
+        faults.log_event(
+            "reshard_committed", version=version, shards=rec["shards"],
+            step=self.global_step,
+        )
+        # DRAIN the old layout: flush sync workers first (one round of
+        # tokens on the OLD queue unblocks a worker parked in a token pop
+        # so its next loop iteration polls the epoch and swaps — the
+        # extra tokens' gradients land in the abandoned old accumulator,
+        # the usual harmless at-least-once token posture), close our own
+        # legs (they must not hold the drain open), then signal each old
+        # task drain-then-exit.
+        if self.cfg.mode == "sync_replicas":
+            try:
+                ps_service.RemoteTokenQueue(
+                    old_group.coordinator, "tokens"
+                ).push(self.global_step, self.cfg.num_workers)
+            except Exception:  # noqa: BLE001 — old coordinator may be gone
+                pass
+        try:
+            # Unblock every waiter parked on the OLD layout (a worker
+            # wedged in a full-queue push or a token pop cannot poll the
+            # epoch): cancelled ops answer None, and the worker's
+            # cancelled-path forced epoch poll swaps it immediately
+            # instead of stalling out the drain window.
+            old_group.cancel_all()
+        except Exception:  # noqa: BLE001
+            pass
+        old_group.fail_fast()
+        old_group.close()
+        self._drain_old_layout(old_layout, old_replica_addrs)
+        return True
+
+    def _reshard_abort(self, version: int) -> None:
+        try:
+            self._group.coordinator.reshard_abort(version)
+        except Exception:  # noqa: BLE001 — best effort; record may be gone
+            log.exception("reshard abort signal failed")
+
+    def _drain_old_layout(self, old_layout, old_replica_addrs) -> None:
+        """Retire the old layout's servers.  In-process servers (the
+        chief-hosted topology) stop once their connections drain; external
+        tasks get the DRAIN shutdown token (``ps_shutdown`` value 1 —
+        ``host_ps_task`` flags itself draining, waits out its clients,
+        exits 0)."""
+        from . import ps_service
+
+        if self._owns_server:
+            old_ports = list(self.ports)
+            self.ports = [p for _, p in self._group.addrs]
+            self.port = self.ports[0]
+
+            def _drain() -> None:
+                for p in old_ports:
+                    ps_service.set_server_draining(p, True)
+                deadline = time.monotonic() + self.cfg.reshard_drain_s
+                while time.monotonic() < deadline and any(
+                    ps_service.server_live_conns(p) > 0 for p in old_ports
+                ):
+                    time.sleep(0.2)
+                for p in old_ports:
+                    ps_service.stop_server(p)
+                faults.log_event("reshard_old_stopped", ports=old_ports)
+
+            threading.Thread(
+                target=_drain, daemon=True, name="dtx-reshard-drain"
+            ).start()
+            return
+        self.ports = [p for _, p in self._group.addrs]
+        self.port = self.ports[0]
+        for h, p in old_replica_addrs:
+            try:
+                c = ps_service.PSClient(h, p, timeout_s=5.0)
+                try:
+                    ps_service.RemoteTokenQueue(c, "ps_shutdown").push(1)
+                finally:
+                    c.close()
+            except Exception:  # noqa: BLE001
+                log.info("drain signal not delivered to %s:%d", h, p)
+
+    def reshard_to(
+        self, new_shards: int, ports: list[int] | None = None,
+        adopt: bool = False,
+    ) -> bool:
+        """In-process N→M reshard (tests / the chief-hosted topology):
+        start ``new_shards`` fresh in-process servers on the next layout
+        epoch, sync their slices from the live old layout over ranged
+        REPL_SYNC, and ANNOUNCE the transition on the coordinator — the
+        chief loop's own ``_reshard_tick`` then adopts it at its next
+        quiescent point (callable from any thread while training runs).
+        ``adopt=True`` runs the adopt/commit/swap/drain inline instead —
+        only safe when the chief loop is NOT running.  External clusters
+        never call this — their joiners are ``--ps_reshard_to`` tasks and
+        the chief adopts the pending record they announce."""
+        from . import ps_service, reshard
+
+        if not self._owns_server:
+            raise RuntimeError(
+                "reshard_to() drives the chief-hosted topology only; "
+                "external clusters start --ps_reshard_to tasks instead"
+            )
+        version = max(self._layout.version, 0) + 1
+        old_version = self._layout.version
+        ports = list(ports) if ports else [0] * new_shards
+        bound = [
+            ps_service.start_server(
+                p, shard_id=j, shard_count=new_shards,
+                layout_version=version,
+            )
+            for j, p in enumerate(ports)
+        ]
+        new_addrs = [("127.0.0.1", p) for p in bound]
+        meta = reshard.discover_old_layout(
+            self._group.replica_addrs, old_version=old_version
+        )
+        for j, addr in enumerate(new_addrs):
+            reshard.install_assembled(
+                addr,
+                reshard.assemble_for_shard(
+                    self._group.replica_addrs, j, new_shards,
+                    old_version=old_version, layout_meta=meta,
+                ),
+                layout_version=version,
+            )
+        old_replica_major = [
+            a for rl in zip(*self._group.replica_addrs) for a in rl
+        ]
+        blob = reshard.pack_record(
+            version, new_addrs, sum(self._leaf_sizes),
+            from_version=old_version, from_addrs=old_replica_major,
+            from_replicas=self.ps_replicas,
+        )
+        self._group.coordinator.reshard_announce(version, blob)
+        if adopt:
+            return self._adopt_record(reshard.parse_record(blob))
+        return True
+
+    def _chief_async(self):
+        # The socket chief's async loop: the thread-mode semantics (each
+        # gradient applies individually, in arrival order) plus a bounded
+        # pop so a pending reshard is adopted even between gradient
+        # arrivals (workers may all be mid-swap).
+        while self.global_step < self.cfg.train_steps:
+            self._reshard_tick()
+            item = self._gq.pop(timeout_s=2.0)
+            if item is native.TIMED_OUT:
+                continue
+            if item is None:
+                return
+            _, flat = item
+            self._apply_update(self._unflatten_concat(flat))
+            if self.cfg.max_staleness is not None:
+                self._gq.set_min_step(self.global_step - self.cfg.max_staleness)
+            self._maybe_checkpoint()
 
     def _reseed_ps_state(self, shard: int = 0) -> None:
         """Run after a reconnect re-created the (empty) objects on a
@@ -737,6 +1070,10 @@ def host_ps_task(
     shard_count: int = 1, layout_version: int = 0,
     peer: tuple[str, int] | None = None, peer_role: str = "",
     sync_wait_s: float = 0.0,
+    coordinator_addrs: list[tuple[str, int]] | None = None,
+    reshard_from: dict | None = None,
+    lease_ttl_s: float = 10.0,
+    drain_timeout_s: float = 20.0,
 ) -> int:
     """Dedicated PS-task body (``--job_name=ps`` under cross-process PS
     emulation): host the C++ state service on ``port`` and block until the
@@ -766,16 +1103,118 @@ def host_ps_task(
     replicated pair, forwarded mirror traffic counts too), the
     deterministic "kill the PS at request N" fault the recovery tests
     inject; a supervisor (``supervise()``) restarts the task and the
-    clients reconnect into the fresh incarnation."""
+    clients reconnect into the fresh incarnation.
+
+    Live resharding (r15): ``reshard_from`` makes this task a JOINER of a
+    layout-epoch transition (``--ps_reshard_to``): before entering the
+    serve loop it assembles its slice of every param-store object from
+    the OLD layout over ranged REPL_SYNC, installs it locally, announces
+    the transition as the old coordinator's PENDING record (idempotent —
+    every joiner announces the same record; the chief verifies, commits
+    or aborts), and heartbeats a membership lease (``psv<V>s<j>``, kind
+    "ps") on the NEW topology's coordinator, so a mid-transition cluster
+    is readable in dtxtop.  Keys: ``addrs`` (the old replica-major host
+    list), ``shards``/``replicas``/``version`` (the old topology),
+    ``new_addrs`` (the target topology; this task serves entry
+    ``shard_id``), ``wait_published_s``.
+
+    ``coordinator_addrs`` (r15, RUNBOOK 4e): the lease/epoch registry this
+    task consults for the idle-pair self-exit — a REPLICATED task whose
+    peer is alive but that has served no client, sees no live worker/
+    serve/chief lease and is claimed by no pending reshard record for a
+    sustained window concludes the run is over and exits 0 on its own
+    (the both-replicas-restarted corner that used to need an operator
+    stop).  Defaults to this task's own server (correct for single-shard
+    topologies)."""
     import time as _time
 
-    from . import ps_service
+    from . import membership, ps_service, reshard
 
     bound = ps_service.start_server(
         port, loopback_only=loopback_only, shard_id=shard_id,
         shard_count=shard_count, layout_version=layout_version,
         peer=peer, sync_wait_s=sync_wait_s,
     )
+    heartbeat = None
+    if reshard_from is not None:
+        old_shards = int(reshard_from.get("shards") or 1)
+        old_replicas = int(reshard_from.get("replicas") or 1)
+        old_version = int(reshard_from.get("version") or 0)
+        old_addrs = list(reshard_from["addrs"])
+        new_addrs = list(reshard_from["new_addrs"])
+        from .ps_shard import replica_major
+
+        old_by_shard = replica_major(old_addrs, old_shards, old_replicas)
+        try:
+            meta = reshard.join_new_shard(
+                ("127.0.0.1", bound), shard_id, shard_count, layout_version,
+                old_by_shard, old_version=old_version,
+                wait_published_s=float(
+                    reshard_from.get("wait_published_s") or 60.0
+                ),
+            )
+        except (ConnectionError, OSError) as e:
+            # A joiner RESTARTED after the commit finds the old tier
+            # drained: if its own topology is already committed, serve on
+            # empty — the chief's client-side reincarnation path reseeds
+            # this shard (the standard restarted-shard healing); anything
+            # else is a genuine failed join and must fail the task loudly.
+            committed = 0
+            try:
+                probe = ps_service.PSClient(
+                    new_addrs[0][0], new_addrs[0][1], timeout_s=5.0
+                )
+                try:
+                    committed, _ = probe.reshard_poll(0)
+                finally:
+                    probe.close()
+            except Exception:  # noqa: BLE001
+                pass
+            if committed != layout_version:
+                ps_service.stop_server(bound)
+                raise
+            log.warning(
+                "reshard joiner shard %d: old layout gone but v%d already "
+                "committed — serving empty, chief reseed heals (%s)",
+                shard_id, layout_version, e,
+            )
+            meta = None
+        if meta is not None:
+            num_elems = meta["num_elems"].get(
+                "params", max(meta["num_elems"].values(), default=0)
+            )
+            blob = reshard.pack_record(
+                layout_version, new_addrs, num_elems,
+                from_version=old_version, from_addrs=old_addrs,
+                from_replicas=old_replicas,
+            )
+            try:
+                c = ps_service.PSClient(
+                    old_by_shard[0][0][0], old_by_shard[0][0][1],
+                    timeout_s=10.0,
+                    addrs=old_by_shard[0] if old_replicas > 1 else None,
+                )
+                try:
+                    c.reshard_announce(layout_version, blob)
+                finally:
+                    c.close()
+            except ps_service.PSError as e:
+                # Another joiner (or the chief) already moved the record
+                # past pending — announce is idempotent only below commit.
+                log.info("reshard announce v%d: %s", layout_version, e)
+            faults.log_event(
+                "reshard_join_synced", shard=shard_id,
+                version=layout_version, num_elems=num_elems,
+            )
+        try:
+            heartbeat = membership.LeaseHeartbeat(
+                [new_addrs[0]], f"psv{layout_version}s{shard_id}",
+                kind="ps",
+                addr=f"{new_addrs[shard_id][0]}:{new_addrs[shard_id][1]}",
+                ttl_s=lease_ttl_s,
+            )
+        except Exception:  # noqa: BLE001 — visibility only, never fatal
+            log.warning("reshard joiner lease unavailable", exc_info=True)
 
     def _partition(spec) -> bool:
         if peer_role and not spec.matches_peer(peer_role):
@@ -803,6 +1242,46 @@ def host_ps_task(
     supervised = os.environ.get("DTX_PS_SUPERVISED") == "1"
     ppid0 = os.getppid()
     orphan_polls = 0
+    desert_polls = 0
+    # The registry the idle-pair self-exit consults (RUNBOOK 4e fix, r15):
+    # live non-PS leases or a pending reshard record naming this server
+    # are evidence of a live cluster; created lazily, fail-fast — a scrape
+    # failure is NO evidence and resets the counter.
+    desert_client: ps_service.PSClient | None = None
+    coord = (coordinator_addrs or [("127.0.0.1", bound)])[0]
+    own_addr_in = None
+    if reshard_from is not None:
+        na = reshard_from["new_addrs"][shard_id]
+        own_addr_in = (str(na[0]), int(na[1]))
+
+    def _cluster_deserted() -> bool:
+        """True when the coordinator registry shows NO live worker/serve/
+        chief lease AND no pending reshard record claims this server —
+        the dead-cluster evidence the idle-pair exit requires.  Any
+        scrape failure answers False (no evidence)."""
+        nonlocal desert_client
+        try:
+            if desert_client is None:
+                desert_client = ps_service.PSClient(
+                    coord[0], coord[1], timeout_s=2.0,
+                )
+            live = membership.parse_leases(desert_client.lease_list())
+            if any(m["kind"] != "ps" for m in live):
+                return False
+            v, blob = desert_client.reshard_poll(0, pending=True)
+            if v > 0 and blob:
+                rec = reshard.parse_record(blob)
+                if own_addr_in in rec["addrs"] or (
+                    "127.0.0.1", bound
+                ) in rec["addrs"]:
+                    return False  # we are a claimed joiner mid-transition
+            return True
+        except Exception:  # noqa: BLE001 — registry unreachable: no evidence
+            if desert_client is not None:
+                desert_client.close()
+                desert_client = None
+            return False
+
     while True:
         # Bounded pops keep this thread responsive (fault triggers, signal
         # delivery) without consuming the shutdown contract below; 2 s
@@ -821,12 +1300,7 @@ def host_ps_task(
             # the orphan state: the PEER is gone AND nobody but our own
             # shutdown client is connected, for a sustained window — a
             # peer merely crashing mid-run keeps the clients' connections
-            # here, so a serving replica can never match this.  Known
-            # limitation: if BOTH replicas restart after the run ended,
-            # each probes the other alive and neither self-exits — that
-            # double-fault corner needs an operator stop (RUNBOOK 4e); a
-            # liveness-only probe cannot distinguish it from a slow
-            # cluster launch without risking a mid-startup suicide.
+            # here, so a serving replica can never match this.
             if peer is not None and ps_service.server_live_conns(bound) <= 1:
                 try:
                     import socket as _socket
@@ -834,7 +1308,29 @@ def host_ps_task(
                     probe = _socket.create_connection(peer, timeout=0.5)
                     probe.close()
                     orphan_polls = 0
+                    # Idle-PAIR exit (r15, the RUNBOOK 4e double-restart
+                    # corner): the peer is ALIVE — but if neither of us
+                    # has a client, the registry shows no live member of
+                    # any other role, and no pending reshard claims this
+                    # server, the run is over and BOTH replicas may exit
+                    # on their own.  The window is deliberately long
+                    # (~60 s of sustained evidence): a cluster merely
+                    # booting brings its chief/workers — and their leases
+                    # and connections — well inside it.
+                    if _cluster_deserted():
+                        desert_polls += 1
+                        if desert_polls >= 30:
+                            log.warning(
+                                "PS task: peer alive but no client, no "
+                                "live member lease and no reshard claim "
+                                "for ~%ds; idle replica pair exiting "
+                                "(RUNBOOK 4e)", 2 * desert_polls,
+                            )
+                            break
+                    else:
+                        desert_polls = 0
                 except OSError:
+                    desert_polls = 0
                     orphan_polls += 1
                     if orphan_polls >= 10:
                         log.warning(
@@ -844,8 +1340,27 @@ def host_ps_task(
                         break
             else:
                 orphan_polls = 0
+                desert_polls = 0
             continue
         if token is not None:
+            if token == 1:
+                # DRAIN shutdown (r15): a reshard retired this layout.
+                # Flag draining (visible in STATS/dtxtop), wait out the
+                # remaining client connections as they swap to the new
+                # epoch, then exit 0 like any clean shutdown.
+                if heartbeat is not None:
+                    heartbeat.close()
+                    heartbeat = None
+                ps_service.set_server_draining(bound, True)
+                faults.log_event("ps_draining", port=bound)
+                deadline = _time.monotonic() + drain_timeout_s
+                while _time.monotonic() < deadline and \
+                        ps_service.server_live_conns(bound) > 1:
+                    _time.sleep(0.2)
+                log.info(
+                    "PS task: drained (conns=%d); retired layout exiting",
+                    ps_service.server_live_conns(bound),
+                )
             break
         # cancel_all reaches this queue too (the chief cancels before its
         # final counter reads); give the real shutdown push a grace window
@@ -855,6 +1370,10 @@ def host_ps_task(
             log.warning("PS task: repeated cancels without shutdown; exiting")
             break
         _time.sleep(0.5)
+    if desert_client is not None:
+        desert_client.close()
+    if heartbeat is not None:
+        heartbeat.close()
     client.close()
     ps_service.stop_server()
     return bound
@@ -1001,7 +1520,7 @@ def remote_worker_loop(
     republished snapshot instead of training on zeros (the OTHER shards'
     versioned caches stay valid throughout).
     """
-    from . import ps_shard, ps_service
+    from . import ps_shard, ps_service, reshard
     from ..utils import metrics
     from ..utils.metrics import MetricsWriter
 
@@ -1013,20 +1532,91 @@ def remote_worker_loop(
         reconnect_deadline_s=cfg.ps_reconnect_deadline_s,
         wire_dtype=cfg.ps_wire_dtype,
     )
-    group = ps_shard.ShardedPSClients(
-        addrs, role=role, worker_tag=wid, replicas=ps_replicas,
-        layout_version=layout_version, **client_kw
-    )
-    client = group.coordinator
     template = init_fn(jax.random.key(0))
     total, unflatten = ps_shard.flat_param_spec(template)
-    layout = ps_shard.ShardLayout(
-        total, group.num_shards, num_replicas=ps_replicas,
-        version=layout_version,
-    )
 
-    pstore = ps_shard.ShardedParamStore(group, "params", layout)
-    tq = ps_service.RemoteTokenQueue(client, "tokens")
+    class _Epoch:
+        """One layout epoch's client-side objects, rebuilt whole on a
+        committed reshard (r15): new pools, new layout, fresh dedup-tag
+        streams (the Remote* ctors run *_RESET_WORKER and restart the
+        0-based sequence — the per-epoch re-scoping that keeps a replayed
+        pre-epoch push from ever colliding with the new stream)."""
+
+        def __init__(self, e_addrs, e_replicas, e_version):
+            self.acc = self.gq = self.prefetcher = None
+            self._addrs = list(e_addrs)
+            self._replicas, self._version = e_replicas, e_version
+            self.group = ps_shard.ShardedPSClients(
+                self._addrs, role=role, worker_tag=wid,
+                replicas=e_replicas, layout_version=e_version, **client_kw
+            )
+            # Everything past the pool is one ctor transaction: a failed
+            # object ensure must close the pool(s), or the swap-retry
+            # loop would leak N sockets per poll against an erroring
+            # new shard.
+            try:
+                self._build()
+            except BaseException:
+                self.close()
+                raise
+
+        def _build(self):
+            self.layout = self.group.layout_for(total)
+            self.pstore = ps_shard.ShardedParamStore(
+                self.group, "params", self.layout
+            )
+            self.tq = ps_service.RemoteTokenQueue(
+                self.group.coordinator, "tokens"
+            )
+            if cfg.mode == "sync_replicas":
+                self.acc = ps_shard.ShardedAccumulator(
+                    self.group, "acc", self.layout
+                )
+                self.push_ms_src = self.acc
+            else:
+                self.gq = ps_shard.ShardedGradientQueue(
+                    self.group, "gq", self.layout,
+                    capacity=max(4, 2 * cfg.num_workers),
+                )
+                self.push_ms_src = self.gq
+                if cfg.ps_prefetch:
+                    # Async only: double-buffer the pull on dedicated
+                    # connections (one per shard) so the next snapshot
+                    # streams while this step's gradient computes.
+                    # Distinct fault role ("<role>_pf", shard i > 0
+                    # appending "_s<i>") so plans can target the prefetch
+                    # connections specifically; "worker*" globs match both.
+                    pf_group = ps_shard.ShardedPSClients(
+                        self._addrs, role=f"{role}_pf",
+                        replicas=self._replicas,
+                        layout_version=self._version, **client_kw
+                    )
+                    try:
+                        pf_store = ps_shard.ShardedParamStore(
+                            pf_group, "params", self.layout
+                        )
+                    except BaseException:
+                        pf_group.close()
+                        raise
+                    self.prefetcher = ParamPrefetcher(
+                        pf_group, pf_store,
+                        wait_budget_s=max(cfg.ps_reconnect_deadline_s, 5.0),
+                    )
+                    self.pstore_timing = pf_store
+            if self.prefetcher is None:
+                self.pstore_timing = self.pstore
+            # The committed-epoch poll rides the coordinator connection —
+            # O(header) per cfg.reshard_poll_s while unchanged.
+            self.follower = reshard.EpochFollower(
+                self.group.coordinator, self._version, cfg.reshard_poll_s
+            )
+
+        def close(self):
+            if self.prefetcher is not None:
+                self.prefetcher.close()
+            self.group.close()
+
+    E = _Epoch(addrs, ps_replicas, layout_version)
     # Membership (r14): announce this worker in the coordinator's lease
     # registry and keep the lease renewed for the life of the loop — a
     # worker started MID-RUN becomes visible to the chief/data-service/
@@ -1037,7 +1627,7 @@ def remote_worker_loop(
         from . import membership
 
         heartbeat = membership.LeaseHeartbeat(
-            group.replica_addrs[0], role, kind="worker",
+            E.group.coordinator_replica_addrs, role, kind="worker",
             ttl_s=cfg.lease_ttl_s, role=role,
             op_timeout_s=cfg.ps_op_timeout_s,
             reconnect_deadline_s=cfg.ps_reconnect_deadline_s,
@@ -1045,42 +1635,14 @@ def remote_worker_loop(
         # A ``leave`` fault (graceful departure) releases the lease on
         # its way out, so the registry records a departure, not a lapse.
         faults.register_leave_hook(heartbeat.close)
-    prefetcher = None
-    gq = None
     writer = None
     contributed = 0
+    reshards_followed = 0
     # Everything below runs under one finally: an exception anywhere
     # (a ctor op against a failing PS, a terminal PSDeadlineError in
     # the loop) must still release the lease — a leaked heartbeat
     # would advertise a dead worker as live forever.
     try:
-        if cfg.mode == "sync_replicas":
-            acc = ps_shard.ShardedAccumulator(group, "acc", layout)
-            push_ms_src = acc
-        else:
-            gq = ps_shard.ShardedGradientQueue(
-                group, "gq", layout, capacity=max(4, 2 * cfg.num_workers)
-            )
-            push_ms_src = gq
-            if cfg.ps_prefetch:
-                # Async only: double-buffer the pull on dedicated connections
-                # (one per shard) so the next snapshot streams while this
-                # step's gradient computes.  Distinct fault role ("<role>_pf",
-                # shard i > 0 appending "_s<i>") so plans can target the
-                # prefetch connections specifically; "worker*" globs still
-                # match both.
-                pf_group = ps_shard.ShardedPSClients(
-                    addrs, role=f"{role}_pf", replicas=ps_replicas,
-                    layout_version=layout_version, **client_kw
-                )
-                pf_store = ps_shard.ShardedParamStore(pf_group, "params", layout)
-                prefetcher = ParamPrefetcher(
-                    pf_group, pf_store,
-                    wait_budget_s=max(cfg.ps_reconnect_deadline_s, 5.0),
-                )
-                pstore_timing = pf_store  # pulls run on the prefetch store
-        if prefetcher is None:
-            pstore_timing = pstore
         writer = MetricsWriter(metrics_dir) if metrics_dir else None
         model_state = model_state if model_state is not None else {}
         rng = rng if rng is not None else jax.random.key(0)
@@ -1094,21 +1656,72 @@ def remote_worker_loop(
         grad_fn = jax.jit(_grad)
 
         def await_params():
-            return _await_published(pstore, max(cfg.ps_reconnect_deadline_s, 5.0))
+            return _await_published(
+                E.pstore, max(cfg.ps_reconnect_deadline_s, 5.0)
+            )
+
+        def maybe_swap_epoch(force: bool = False) -> bool:
+            """Follow a committed reshard: rebuild the whole epoch object
+            set onto the record's topology; True when a swap happened.  A
+            failed rebuild keeps the CURRENT epoch serving (the old tier
+            drains only after every client swaps or times out) and
+            retries on the next poll."""
+            nonlocal E, reshards_followed
+            if not cfg.reshard_watch:
+                return False
+            rec = E.follower.poll(force=force)
+            if rec is None:
+                return False
+            if rec["num_elems"] != total:
+                log.error(
+                    "worker %d: reshard v%d names %d elems, this run "
+                    "trains %d — ignoring the record", wid, rec["version"],
+                    rec["num_elems"], total,
+                )
+                return False
+            old_version = E.layout.version
+            try:
+                new_e = _Epoch(rec["addrs"], rec["replicas"], rec["version"])
+            except (ps_service.PSError, OSError, RuntimeError) as e:
+                E.follower.version = old_version  # retry next poll
+                faults.log_event(
+                    "worker_epoch_swap_failed", role=role,
+                    version=rec["version"], error=type(e).__name__,
+                )
+                return False
+            old, E = E, new_e
+            old.close()
+            reshards_followed += 1
+            if heartbeat is not None:
+                heartbeat.retarget(E.group.coordinator_replica_addrs)
+            faults.log_event(
+                "worker_epoch_swapped", role=role, version=rec["version"],
+                shards=E.layout.num_shards,
+            )
+            return True
 
         it = 0
         while True:
             # EVERY remote call is inside the guard: the chief exiting (socket
             # closed mid-recv) must end the worker cleanly, not crash it.
             try:
+                maybe_swap_epoch()
                 if cfg.mode == "sync_replicas":
-                    token = tq.pop()
+                    token = E.tq.pop()
                     if token is None:
+                        # Cancelled: the chief finished — or the OLD
+                        # coordinator just drain-stopped after a reshard
+                        # this worker hasn't followed yet.  A forced epoch
+                        # poll disambiguates: swap and continue, or exit.
+                        if maybe_swap_epoch(force=True):
+                            continue
                         break
                     local_step = token
                     got = await_params()
                 else:
-                    got = prefetcher.get() if prefetcher else await_params()
+                    got = (
+                        E.prefetcher.get() if E.prefetcher else await_params()
+                    )
                 if got is None:
                     log.warning("worker %d: no republished params; exiting", wid)
                     break
@@ -1117,11 +1730,11 @@ def remote_worker_loop(
                     if step >= cfg.train_steps:
                         break
                     local_step = max(step, 0)
-                    if prefetcher:
+                    if E.prefetcher:
                         # Overlap the NEXT pull with this step's gradient
                         # compute (the communication/compute overlap the
                         # transport fast path exists for).
-                        prefetcher.kick()
+                        E.prefetcher.kick()
             except (RuntimeError, ConnectionError, OSError):
                 break
             params = unflatten(flat)
@@ -1136,11 +1749,18 @@ def remote_worker_loop(
             ).astype(np.float32)
             try:
                 if cfg.mode == "sync_replicas":
-                    acc.apply(local_step, flat_g)
+                    E.acc.apply(local_step, flat_g)
                 else:
-                    pushed = gq.push(local_step, flat_g)
+                    pushed = E.gq.push(local_step, flat_g)
                     if pushed is None:
-                        break  # cancelled: the chief is done or failed
+                        # Cancelled: the chief is done — or this epoch was
+                        # RETIRED under us (the chief cancels the old
+                        # layout's waiters at drain).  A forced epoch poll
+                        # disambiguates; the un-pushed gradient is lost
+                        # exactly like a stale drop (at-most-once holds).
+                        if maybe_swap_epoch(force=True):
+                            continue
+                        break
             except (RuntimeError, ConnectionError, OSError):
                 break  # chief finished and tore the service down
             contributed += 1
@@ -1152,16 +1772,14 @@ def remote_worker_loop(
                 writer.scalars(
                     local_step,
                     {
-                        **metrics.shard_scalars("pull", pstore_timing.last_pull_ms),
-                        **metrics.shard_scalars("push", push_ms_src.last_push_ms),
+                        **metrics.shard_scalars("pull", E.pstore_timing.last_pull_ms),
+                        **metrics.shard_scalars("push", E.push_ms_src.last_push_ms),
                     },
                 )
     finally:
         if writer is not None:
             writer.close()
-        if prefetcher is not None:
-            prefetcher.close()
         if heartbeat is not None:
             heartbeat.close()  # releases the lease: the clean leave signal
-        group.close()
+        E.close()
     return contributed

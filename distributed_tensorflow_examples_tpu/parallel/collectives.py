@@ -56,6 +56,15 @@ def ring_permute(x, axis_name: str, *, shift: int = 1):
     return lax.ppermute(x, axis_name, perm=perm)
 
 
+#: Whether this jax ships native partial-manual shard_map
+#: (``jax.shard_map`` with ``axis_names``).  False = the experimental API,
+#: where :func:`shard_map` lowers partial-manual regions to FULL-manual
+#: (see below) — bodies must then skip auto-axis sharding CONSTRAINTS
+#: (there are no auto axes left to constrain, and the old API provides no
+#: mesh context for bare PartitionSpecs inside the region).
+PARTIAL_MANUAL_NATIVE = hasattr(jax, "shard_map")
+
+
 def shard_map(
     fn, mesh, *, in_specs, out_specs, check_vma: bool = False,
     axis_names=None,
@@ -81,14 +90,38 @@ def shard_map(
         mesh.axis_names
     ):
         # Old jax spells partial-manual as the complement set ``auto=``,
-        # but that path hard-ABORTS the process (jaxlib CHECK failure) on
-        # the CPU interpret configs our tests run — a clean refusal here
-        # must never become a suite-killing abort.  Full-manual regions
-        # (axis_names == every mesh axis) need no translation at all.
-        raise NotImplementedError(
-            "partial-manual shard_map (axis_names ⊂ mesh axes) requires "
-            "jax.shard_map; this jax only ships the experimental API"
-        )
+        # but that path hard-ABORTS the process (jaxlib CHECK failure:
+        # spmd_partitioner IsManualSubgroup mismatch) on the CPU
+        # interpret configs our tests run — so partial-manual lowers to a
+        # FULL-manual region instead.  Semantics: the would-be-auto axes
+        # become manual with their in/out specs unchanged, i.e. any array
+        # not spec-sharded over them is REPLICATED there and each of
+        # their mesh coordinates computes the region redundantly (one
+        # independent copy per coordinate) — identical results for the
+        # deterministic bodies this project writes, at the cost of the
+        # GSPMD sharding the auto axes would have inserted inside the
+        # body.  The one thing that must not leak through: a spec naming
+        # a would-be-auto axis relies on GSPMD resharding semantics this
+        # translation cannot reproduce — refuse that loudly.
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+
+        def _spec_axes(spec):
+            for part in spec:
+                if part is None:
+                    continue
+                yield from (part if isinstance(part, tuple) else (part,))
+
+        named = {
+            ax
+            for spec in list(in_specs) + [out_specs]
+            for ax in _spec_axes(spec)
+        }
+        if named & auto:
+            raise NotImplementedError(
+                f"partial-manual shard_map with specs naming auto axes "
+                f"{sorted(named & auto)} requires jax.shard_map; this jax "
+                "only ships the experimental API"
+            )
     return _shard_map(
         fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_rep=check_vma,

@@ -241,6 +241,35 @@ class LeaseHeartbeat:
         if self.enabled:
             self._thread.start()
 
+    def retarget(self, addrs: list[tuple[str, int]]) -> None:
+        """Move the heartbeat onto a NEW coordinator (r15 live resharding:
+        the registry lives on the new layout's shard 0 after a commit).
+        The fresh client acquires immediately — the member is visible in
+        the new registry before this returns — and the old client is
+        closed; an in-flight tick racing the swap fails once on the dead
+        client (counted in ``errors``) and renews on the new one next
+        period."""
+        if not self.enabled:
+            return
+        new = ps_service.PSClient(
+            addrs[0][0], addrs[0][1],
+            op_timeout_s=self._client._op_timeout,
+            reconnect_deadline_s=self._client._reconnect_deadline,
+            role=self.role,
+            addrs=list(addrs) if len(addrs) > 1 else None,
+        )
+        try:
+            new.lease_acquire(self.name, self.ttl_s)
+        except (ps_service.PSError, OSError):
+            self.errors += 1
+            _OBS_HB_ERRORS.inc()  # next tick retries on the new client
+        old, self._client = self._client, new
+        old.close()
+        faults.log_event(
+            "lease_retargeted", role=self.role, member=self.member,
+            coordinator=f"{addrs[0][0]}:{addrs[0][1]}",
+        )
+
     def _loop(self) -> None:
         period = self.ttl_s / 3.0
         while not self._stop.wait(period):
@@ -284,7 +313,16 @@ class LeaseWatcher:
     in-flight splits immediately; dtxtop uses the live set to discover
     dynamically-joined roles.  Scrape failures are tolerated (the
     registry may be failing over): no transition is synthesized from a
-    failed poll — a missing answer is not evidence of a missing member."""
+    failed poll — a missing answer is not evidence of a missing member.
+
+    ``follow_epoch`` (r15 live resharding): each poll additionally asks
+    the coordinator for a newer COMMITTED layout epoch (O(header) while
+    unchanged) and re-targets the watcher onto the new topology's
+    coordinator when one lands — so a data service (or any registry
+    consumer) keeps seeing the live set across an N→M reshard without
+    restarting.  No membership transition is synthesized from the swap
+    itself: members re-acquire on the new coordinator within one TTL,
+    and the watcher's known set carries across."""
 
     def __init__(
         self,
@@ -297,6 +335,8 @@ class LeaseWatcher:
         role: str | None = None,
         op_timeout_s: float | None = 5.0,
         reconnect_deadline_s: float = 10.0,
+        follow_epoch: bool = False,
+        layout_version: int = 0,
     ):
         self.kind = kind
         self.poll_s = max(0.05, float(poll_s))
@@ -306,6 +346,11 @@ class LeaseWatcher:
         self.joins_seen = 0
         self.leaves_seen = 0
         self.poll_errors = 0
+        self.follow_epoch = bool(follow_epoch)
+        self.epoch = int(layout_version)
+        self.epoch_swaps = 0
+        self._op_timeout_s = op_timeout_s
+        self._reconnect_deadline_s = max(0.1, reconnect_deadline_s)
         self._known: dict[str, dict] = {}
         self._stop = threading.Event()
         # A positive reconnect budget is load-bearing: a fail-fast client
@@ -327,9 +372,41 @@ class LeaseWatcher:
         """The last successfully scraped live set."""
         return list(self._known.values())
 
+    def _follow_epoch_once(self) -> None:
+        """One committed-epoch probe; on a bump, re-dial the NEW
+        coordinator (the registry moved with the layout)."""
+        from . import reshard
+
+        try:
+            rec = reshard.poll_committed(self._client, self.epoch)
+        except (ps_service.PSError, OSError, ValueError):
+            return  # coordinator mid-failover / garbled record: next poll
+        if rec is None:
+            return
+        addrs = reshard.coordinator_addrs_of(rec)
+        try:
+            new_client = ps_service.PSClient(
+                addrs[0][0], addrs[0][1], op_timeout_s=self._op_timeout_s,
+                reconnect_deadline_s=self._reconnect_deadline_s,
+                role=self.role,
+                addrs=list(addrs) if len(addrs) > 1 else None,
+            )
+        except (ps_service.PSError, OSError):
+            return  # new coordinator not dialable yet: retry next poll
+        old, self._client = self._client, new_client
+        old.close()
+        self.epoch = rec["version"]
+        self.epoch_swaps += 1
+        faults.log_event(
+            "lease_watcher_retargeted", role=self.role, epoch=self.epoch,
+            coordinator=f"{addrs[0][0]}:{addrs[0][1]}",
+        )
+
     def poll_once(self) -> None:
         """One scrape + transition dispatch (the loop body; callable from
         tests for deterministic sequencing)."""
+        if self.follow_epoch:
+            self._follow_epoch_once()
         try:
             live = {
                 m["member"]: m

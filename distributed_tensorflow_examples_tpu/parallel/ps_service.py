@@ -118,6 +118,10 @@ _STATS = wire.PS_OPS["STATS"]
 _LEASE_ACQUIRE = wire.PS_OPS["LEASE_ACQUIRE"]
 _LEASE_RELEASE = wire.PS_OPS["LEASE_RELEASE"]
 _LEASE_LIST = wire.PS_OPS["LEASE_LIST"]
+_RESHARD_BEGIN = wire.PS_OPS["RESHARD_BEGIN"]
+_RESHARD_COMMIT = wire.PS_OPS["RESHARD_COMMIT"]
+_RESHARD_GET = wire.PS_OPS["RESHARD_GET"]
+_RESHARD_ABORT = wire.PS_OPS["RESHARD_ABORT"]
 
 # Client-side observability (r13 dtxobs): every PSClient in the process
 # accumulates into these process-wide instruments — cached handles, so the
@@ -248,6 +252,16 @@ def server_live_conns(port: int) -> int:
     """Live client connections at the server at ``port`` (-1 = none
     there) — the orphaned-replica signal ``host_ps_task`` watches."""
     return int(native._load().ps_server_live_conns_port(port))
+
+
+def set_server_draining(port: int, on: bool = True) -> bool:
+    """Mark the server at ``port`` DRAINING (r15): a reshard retired its
+    layout and the host is waiting out the last connections before exit —
+    exported in STATS so a mid-transition cluster reads correctly in
+    dtxtop."""
+    return bool(
+        native._load().ps_server_set_draining(port, 1 if on else 0)
+    )
 
 
 def stop_server(port: int | None = None) -> None:
@@ -838,6 +852,7 @@ class PSClient:
         payload: np.ndarray | None = None, *, replay_safe: bool = True,
         server_wait_s: float = 0.0, fault_point: bool = True,
         out: np.ndarray | None = None, raw: bool = False,
+        raw_payload: bool = False,
     ) -> tuple[int, np.ndarray]:
         """One request/response; recovers + replays on transport failure
         when recovery is enabled and the op is ``replay_safe`` (idempotent
@@ -847,10 +862,16 @@ class PSClient:
         whether this call advances the fault-injection op counter — the
         chunked re-issues of one logical blocking op pass False so plan
         indices count LOGICAL ops, not timing-dependent chunks.  ``out``:
-        optional preallocated response destination (see ``_attempt``)."""
+        optional preallocated response destination (see ``_attempt``).
+        ``raw_payload``: the payload is an UN-encoded byte blob already
+        framed as 4-byte units (the RESHARD_BEGIN record shape) — sent
+        verbatim, never dtype-converted, so a bf16 connection ships the
+        same bytes as an f32 one."""
         # Encode once, outside the retry loop: a replay re-sends the same
         # wire bytes without re-converting (bf16) or re-checking layout.
-        wire_payload = self._encode_payload(payload)
+        wire_payload = (
+            payload if raw_payload else self._encode_payload(payload)
+        )
         deadline = (
             self._op_timeout + server_wait_s
             if self._op_timeout is not None
@@ -999,6 +1020,62 @@ class PSClient:
                 f"LEASE_LIST (status {status}; pre-r14 server?)"
             )
         return json.loads(bytes(blob).decode())
+
+    # -- live resharding (r15) ----------------------------------------------
+
+    def reshard_announce(self, version: int, blob: bytes) -> None:
+        """Store ``blob`` as the coordinator's PENDING reshard record at
+        epoch ``version`` (``parallel/reshard.py`` owns the schema).
+        Idempotent — every joining shard task may announce the same
+        record; refused for a version not above the committed one."""
+        padded = blob + b" " * (-len(blob) % 4)
+        status, _ = self.call(
+            _RESHARD_BEGIN, "", version, raw_payload=True, fault_point=False,
+            payload=np.frombuffer(padded, np.uint8).view(np.float32),
+        )
+        if status < 0:
+            raise PSError(
+                f"reshard announce v{version} rejected ({status}): version "
+                "not above the committed epoch, record oversized, or "
+                "pre-r15 server"
+            )
+
+    def reshard_commit(self, version: int) -> None:
+        """Promote the matching PENDING record to COMMITTED — the epoch
+        flip every polling client converges to.  Idempotent when already
+        committed at ``version``."""
+        status, _ = self.call(_RESHARD_COMMIT, "", version, fault_point=False)
+        if status < 0:
+            raise PSError(
+                f"reshard commit v{version} rejected ({status}): no "
+                "matching pending record (aborted, superseded, or pre-r15 "
+                "server)"
+            )
+
+    def reshard_abort(self, version: int) -> bool:
+        """Clear a matching PENDING record (the loud mid-transition
+        bail-out); True when one was cleared."""
+        status, _ = self.call(_RESHARD_ABORT, "", version, fault_point=False)
+        if status < 0:
+            raise PSError(f"reshard abort v{version} rejected ({status})")
+        return status == 1
+
+    def reshard_poll(
+        self, have_version: int = 0, *, pending: bool = False,
+    ) -> tuple[int, bytes]:
+        """The coordinator's reshard record: ``(version, blob)`` where the
+        blob is non-empty only when ``version > have_version`` — the
+        steady-state epoch poll is O(header), like an unchanged-step
+        pull.  ``version`` 0 = no record.  A pre-r15 server answers -2,
+        surfaced as ``(0, b"")`` so pollers degrade to the static
+        topology silently (resharding simply never fires)."""
+        status, blob = self.call(
+            _RESHARD_GET, "", have_version, 1 if pending else 0, raw=True,
+            fault_point=False,
+        )
+        if status < 0:
+            return 0, b""
+        return status, bytes(blob).rstrip(b" ") if blob else b""
 
     def cancel_all(self) -> None:
         self.call(_CANCEL_ALL)

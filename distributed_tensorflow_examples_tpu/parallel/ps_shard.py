@@ -343,6 +343,29 @@ class ShardedPSClients:
             self.close()
             raise
 
+    @classmethod
+    def for_record(cls, rec: dict, *, role: str | None = None, **client_kw):
+        """The next layout epoch's client pool, built from a committed
+        reshard record (``parallel/reshard.py`` schema) — THE one spelling
+        of the record→pool swap every epoch follower (worker loop, serve
+        refresher, chief) uses: addrs replica-major from the record, every
+        connection pinned to the record's epoch, so a swap onto a stale or
+        half-written record fails its dials loudly instead of scattering
+        onto the wrong partition."""
+        return cls(
+            list(rec["addrs"]), role=role, replicas=rec["replicas"],
+            layout_version=rec["version"], **client_kw,
+        )
+
+    def layout_for(self, num_elems: int) -> ShardLayout:
+        """This pool's deterministic partition of ``num_elems`` — shard
+        count/replicas/epoch all from the pool, so a rebuilt pool and its
+        layout can never disagree about the topology."""
+        return ShardLayout(
+            num_elems, self.num_shards, num_replicas=self.replicas,
+            version=self.layout_version,
+        )
+
     @property
     def num_shards(self) -> int:
         return len(self.addrs)
@@ -352,6 +375,13 @@ class ShardedPSClients:
         """Shard 0's client — where step tokens and other unsharded
         coordination scalars live."""
         return self.clients[0]
+
+    @property
+    def coordinator_replica_addrs(self) -> list[tuple[str, int]]:
+        """The coordinator shard's full replica address list — where the
+        lease registry and the reshard records live (heartbeats re-target
+        here on an epoch swap)."""
+        return list(self.replica_addrs[0])
 
     def cancel_all(self) -> None:
         """Broadcast CANCEL_ALL to every shard server (chief teardown:

@@ -1,0 +1,532 @@
+"""Live PS resharding N→M under traffic: coordinator-driven layout epochs
+(r15 tentpole).
+
+The PS tier was the last role frozen at process start: replication (r12)
+removed its single points of failure and elasticity (r14) let every OTHER
+role join/leave mid-run, but the shard COUNT — the thing that sets the
+tier's aggregate NIC and memory budget — could only change with a full
+cluster restart.  The layout-version word has ridden in every HELLO since
+r12 exactly for this moment ("the plumbing live N->M resharding rides
+on"); this module builds the actual transition, grounded in the automatic
+cross-replica weight-update sharding story (PAPERS.md, arxiv 2004.13336)
+and the TensorFlow paper's PS placement rebalancing (arxiv 1605.08695).
+
+Protocol — one epoch bump, four phases, zero reseeds, zero failed ops:
+
+1. **JOIN** — fresh shard tasks for the new :class:`~.ps_shard.ShardLayout`
+   (epoch ``V = V_old + 1``) start serving their new identity, ANNOUNCE
+   the transition as the coordinator's PENDING record (``RESHARD_BEGIN``,
+   idempotent — every joiner may announce the same record), and pull
+   their slices from the OLD layout over ranged ``REPL_SYNC`` (param-store
+   objects only, sliced to the exact overlap with each old shard — the
+   r12 state-transfer machinery extended to ranges).  They heartbeat
+   membership leases like every other role (``psv<V>s<j>``, kind "ps")
+   and carry data only once synced — clients cannot reach them before the
+   commit, and the mixed-epoch HELLO guard makes any stale dial fail
+   loudly naming both versions.
+2. **VERIFY** — the chief (``RemotePSChief``) observes the pending record
+   on its coordinator poll, probes every new shard for a synced snapshot,
+   republishes the CURRENT params onto the new layout (so the swap never
+   serves a stale step), and seeds the new coordinator's record slots.
+   A joiner that dies mid-transition fails the probe: the chief ABORTS
+   (``RESHARD_ABORT``) loudly and the old topology serves on — a
+   transition either completes or aborts, never half-applies.
+3. **COMMIT** — ``RESHARD_COMMIT`` flips the pending record to COMMITTED
+   on the old coordinator (and the record is planted committed on the new
+   coordinator, so late/restarted members discover the current topology
+   from either end).  Every client — worker loops, prefetchers, the
+   serve refresher, the data service's lease watcher, dtxtop — polls
+   ``RESHARD_GET`` with its known version (O(header) while unchanged,
+   the ``PSTORE_GET_IF_NEWER`` discipline) and swaps: new client pool,
+   new layout, leases re-targeted at the new coordinator.  In-flight
+   at-most-once pushes are preserved by the existing (worker, seq) dedup
+   tags RE-SCOPED per epoch: the new servers start with empty dedup
+   tables, every swapped client opens a fresh 0-based stream behind a
+   ``*_RESET_WORKER`` announce, and a pre-epoch push replayed at the OLD
+   server still answers "duplicate" there — the two epochs' tag spaces
+   can never collide.
+4. **DRAIN** — the chief signals every old-layout task a DRAIN shutdown
+   (``ps_shutdown`` token 1): the task flags itself ``draining`` (visible
+   in STATS/dtxtop), waits out its remaining connections as the last
+   clients swap away, and exits 0.
+
+Record schema (the ``RESHARD_*`` blob; the server stores it opaque):
+``{"version", "num_elems", "shards", "replicas", "addrs": ["h:p", ...],
+"from": {"version", "shards", "replicas", "addrs"}}``.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import time
+
+import numpy as np
+
+from . import wire
+
+__all__ = [
+    "pack_record",
+    "parse_record",
+    "coordinator_addrs_of",
+    "poll_committed",
+    "poll_pending",
+    "EpochFollower",
+    "ranged_sync",
+    "discover_old_layout",
+    "assemble_slice",
+    "assemble_for_shard",
+    "install_assembled",
+    "join_new_shard",
+]
+
+#: Hard cap on record size (mirrors the server's RESHARD_BEGIN bound).
+MAX_RECORD_BYTES = 16 << 10
+
+
+def pack_record(
+    version: int, addrs, num_elems: int, *, replicas: int = 1,
+    from_version: int = 0, from_addrs=(), from_replicas: int = 1,
+) -> bytes:
+    """The wire form of a transition record.  ``addrs`` lists the NEW
+    topology replica-major (shards = len(addrs) // replicas, the
+    ``--ps_hosts`` convention); ``from_*`` names the OLD topology the new
+    shards pull from — kept in the record so a restarted joiner (or an
+    operator reading dtxtop) can reconstruct the whole transition from
+    the coordinator alone."""
+    addrs = [f"{h}:{p}" for h, p in addrs]
+    if version <= 0 or version > wire.HELLO_LAYOUT_MASK:
+        raise ValueError(
+            f"reshard version {version} outside the 16-bit HELLO epoch "
+            "field (1..65535)"
+        )
+    if not addrs or len(addrs) % max(1, replicas):
+        raise ValueError(
+            f"{len(addrs)} addresses do not tile {replicas} replicas"
+        )
+    blob = json.dumps({
+        "version": int(version),
+        "num_elems": int(num_elems),
+        "shards": len(addrs) // max(1, replicas),
+        "replicas": int(replicas),
+        "addrs": addrs,
+        "from": {
+            "version": int(from_version),
+            "shards": (
+                len(list(from_addrs)) // max(1, from_replicas)
+                if from_addrs else 0
+            ),
+            "replicas": int(from_replicas),
+            "addrs": [f"{h}:{p}" for h, p in from_addrs],
+        },
+    }).encode()
+    if len(blob) > MAX_RECORD_BYTES:
+        raise ValueError(f"reshard record is {len(blob)} bytes (> 16 KiB)")
+    return blob
+
+
+def _parse_addrs(entries) -> list[tuple[str, int]]:
+    out = []
+    for e in entries:
+        host, _, port_s = str(e).rpartition(":")
+        if not host or not port_s.isdigit():
+            raise ValueError(f"reshard record address {e!r} is not host:port")
+        out.append((host, int(port_s)))
+    return out
+
+
+def parse_record(blob: bytes) -> dict:
+    """Inverse of :func:`pack_record`; addresses come back as tuples.
+    Raises ``ValueError`` on a malformed record — a garbled epoch record
+    must fail the poller loudly, never swap clients onto garbage."""
+    d = json.loads(blob.decode())
+    rec = {
+        "version": int(d["version"]),
+        "num_elems": int(d["num_elems"]),
+        "shards": int(d["shards"]),
+        "replicas": int(d.get("replicas", 1)),
+        "addrs": _parse_addrs(d["addrs"]),
+    }
+    f = d.get("from") or {}
+    rec["from"] = {
+        "version": int(f.get("version", 0)),
+        "shards": int(f.get("shards", 0)),
+        "replicas": int(f.get("replicas", 1)),
+        "addrs": _parse_addrs(f.get("addrs", [])),
+    }
+    if rec["shards"] < 1 or len(rec["addrs"]) != rec["shards"] * rec["replicas"]:
+        raise ValueError(
+            f"reshard record v{rec['version']}: {len(rec['addrs'])} addrs "
+            f"!= {rec['shards']} shards x {rec['replicas']} replicas"
+        )
+    return rec
+
+
+def coordinator_addrs_of(rec: dict) -> list[tuple[str, int]]:
+    """The record's coordinator replica addresses (replica-major entry
+    ``r * shards`` — the one grouping convention, ps_shard.replica_major)."""
+    n = rec["shards"]
+    return [
+        rec["addrs"][r * n]
+        for r in range(rec["replicas"])
+        if r * n < len(rec["addrs"])
+    ]
+
+
+def poll_committed(client, have_version: int = 0) -> dict | None:
+    """The coordinator's committed record when NEWER than
+    ``have_version`` (else None) — the one poll every epoch follower
+    runs.  O(header) while unchanged."""
+    version, blob = client.reshard_poll(have_version)
+    if version <= have_version or not blob:
+        return None
+    return parse_record(blob)
+
+
+def poll_pending(client) -> dict | None:
+    """The coordinator's pending record, if any — the chief's adoption
+    trigger and the joiner's restart-discovery read."""
+    version, blob = client.reshard_poll(0, pending=True)
+    if version <= 0 or not blob:
+        return None
+    return parse_record(blob)
+
+
+class EpochFollower:
+    """Time-gated committed-epoch poll over an EXISTING coordinator
+    client: ``poll()`` answers a parsed record exactly once per committed
+    epoch bump, None otherwise.  The unchanged-epoch steady state costs
+    one O(header) round trip per ``min_poll_s`` — cheap enough to ride
+    every worker-loop iteration and serve-refresher tick.  Poll errors
+    are swallowed (the coordinator may be failing over; a missed poll is
+    not a missed epoch — the next one sees the same record)."""
+
+    def __init__(self, client, have_version: int, min_poll_s: float = 0.5):
+        self._client = client
+        self.version = int(have_version)
+        self.min_poll_s = float(min_poll_s)
+        self._next_t = 0.0
+
+    def rebind(self, client, version: int) -> None:
+        """Follow a swap: poll the NEW coordinator from now on."""
+        self._client = client
+        self.version = int(version)
+
+    def poll(self, *, force: bool = False) -> dict | None:
+        now = time.monotonic()
+        if not force and now < self._next_t:
+            return None
+        self._next_t = now + self.min_poll_s
+        try:
+            rec = poll_committed(self._client, self.version)
+        except Exception:  # noqa: BLE001 — coordinator mid-failover
+            return None
+        if rec is not None:
+            self.version = rec["version"]
+        return rec
+
+
+# ----------------------------------------------------------------------------
+# Ranged REPL_SYNC: the slice transfer (raw socket — one-shot pulls need no
+# recovery machinery, and the repl-flagged HELLO is not a client-pool leg)
+# ----------------------------------------------------------------------------
+
+
+def _dial_repl(
+    addr: tuple[str, int], *, layout_version: int = 0, timeout_s: float = 10.0,
+) -> socket.socket:
+    """A repl-flagged connection to an old-layout server, epoch-pinned:
+    a server on a DIFFERENT epoch (or a partitioned one) refuses the
+    HELLO loudly instead of serving the wrong slice."""
+    sock = socket.create_connection(addr, timeout=timeout_s)
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        b = wire.pack_hello_b(0, layout_version=layout_version, repl=True)
+        sock.sendall(
+            wire.pack_request(wire.HELLO_OP, "", wire.WIRE_VERSION, b, 0)
+        )
+        hdr = bytearray(wire.RESP_HDR.size)
+        wire.recv_exact(sock, memoryview(hdr))
+        status, plen = wire.RESP_HDR.unpack(hdr)
+        if plen:
+            wire.recv_exact(sock, memoryview(bytearray(plen * 4)))
+        if status != wire.WIRE_VERSION:
+            if status <= wire.HELLO_SHARD_MISMATCH:
+                _, _, got_v = wire.unpack_shard_mismatch(status)
+                raise ConnectionError(
+                    f"ranged sync refused: {addr[0]}:{addr[1]} serves shard "
+                    f"layout EPOCH {got_v} but this puller expected epoch "
+                    f"{layout_version} — the old topology moved underneath "
+                    "the transition"
+                )
+            raise ConnectionError(
+                f"ranged sync HELLO with {addr[0]}:{addr[1]} failed "
+                f"({status}): partitioned peer or pre-r15 server"
+            )
+        return sock
+    except BaseException:
+        sock.close()
+        raise
+
+
+def _parse_ranged_blob(blob: bytes) -> dict[str, dict]:
+    """``{name: {"total", "start", "count", "step", "data"}}`` out of a
+    ranged REPL_SYNC blob (layout: ps_server.cc build_ranged_sync_blob)."""
+    out: dict[str, dict] = {}
+    at = 8  # skip the state token
+    (n_obj,) = struct.unpack_from("<I", blob, at)
+    at += 4
+    for _ in range(n_obj):
+        kind = blob[at]
+        (nlen,) = struct.unpack_from("<H", blob, at + 1)
+        at += 3
+        name = blob[at : at + nlen].decode()
+        at += nlen
+        if kind != ord("p"):
+            raise ValueError(f"ranged sync blob carries non-pstore kind {kind}")
+        total, start, count, step = struct.unpack_from("<qqqq", blob, at)
+        at += 32
+        data = np.frombuffer(blob, np.float32, count, at).copy()
+        at += count * 4
+        out[name] = {
+            "total": total, "start": start, "count": count, "step": step,
+            "data": data,
+        }
+    return out
+
+
+def ranged_sync(
+    addr: tuple[str, int], start: int, count: int, *,
+    layout_version: int = 0, timeout_s: float = 10.0,
+) -> dict[str, dict]:
+    """One ranged state pull from an old-layout server: every param-store
+    object's ``[start, start + count)`` LOCAL element range (clamped
+    server-side), with each object's total size and published step.
+    ``count = 0`` is the metadata probe — object names/sizes/steps with
+    zero data bytes — the layout-discovery read."""
+    sock = _dial_repl(addr, layout_version=layout_version, timeout_s=timeout_s)
+    try:
+        # count <= 0 probes metadata: sent as -1 (b == 0 would select the
+        # r12 FULL state sync, a different blob the range parser must
+        # never see; the server clamps a negative count to zero data).
+        sock.sendall(wire.pack_request(
+            wire.PS_OPS["REPL_SYNC"], "", start, count if count > 0 else -1, 0
+        ))
+        hdr = bytearray(wire.RESP_HDR.size)
+        wire.recv_exact(sock, memoryview(hdr))
+        status, plen = wire.RESP_HDR.unpack(hdr)
+        if status < 0:
+            raise ConnectionError(
+                f"ranged REPL_SYNC at {addr[0]}:{addr[1]} rejected "
+                f"({status}) — pre-r15 server?"
+            )
+        blob = bytearray(plen * 4)
+        if plen:
+            wire.recv_exact(sock, memoryview(blob))
+        return _parse_ranged_blob(bytes(blob))
+    finally:
+        sock.close()
+
+
+def _as_replica_list(entry) -> list[tuple[str, int]]:
+    """Normalize an old-shard address entry: a bare ``(host, port)`` or a
+    replica list ``[(host, port), ...]`` — pulls fall over to the next
+    replica of the SAME shard, so a dead old primary never blocks a
+    joiner (the r12 failover posture, applied to the transfer)."""
+    if entry and isinstance(entry[0], (list, tuple)):
+        return [tuple(a) for a in entry]
+    return [tuple(entry)]
+
+
+def _ranged_sync_any(
+    replicas: list[tuple[str, int]], start: int, count: int, *,
+    layout_version: int = 0, timeout_s: float = 10.0,
+) -> dict[str, dict]:
+    last: Exception | None = None
+    for addr in replicas:
+        try:
+            return ranged_sync(
+                addr, start, count, layout_version=layout_version,
+                timeout_s=timeout_s,
+            )
+        except OSError as e:
+            last = e
+    raise ConnectionError(
+        f"ranged sync failed on every replica of {replicas}: {last!r}"
+    )
+
+
+def discover_old_layout(
+    old_addrs, *, old_version: int = 0, timeout_s: float = 10.0,
+) -> dict:
+    """The old tier's per-shard object sizes, from metadata probes against
+    each old shard (entries may be bare primary addresses or replica
+    lists): ``{"objects": {name: [n_shard0, ...]}, "steps": {name: [...]},
+    "num_elems": {name: total}}``.  A shard carrying no objects yet
+    (pre-first-publish) contributes zeros — the caller decides whether
+    that is fatal (a reshard needs a published store)."""
+    objects: dict[str, list[int]] = {}
+    steps: dict[str, list[int]] = {}
+    metas = [
+        _ranged_sync_any(
+            _as_replica_list(a), 0, 0, layout_version=old_version,
+            timeout_s=timeout_s,
+        )
+        for a in old_addrs
+    ]
+    names = sorted({n for m in metas for n in m})
+    for name in names:
+        objects[name] = [m[name]["total"] if name in m else 0 for m in metas]
+        steps[name] = [m[name]["step"] if name in m else -1 for m in metas]
+    return {
+        "objects": objects,
+        "steps": steps,
+        "num_elems": {n: sum(sizes) for n, sizes in objects.items()},
+    }
+
+
+def assemble_slice(
+    old_addrs, name: str, lo: int, hi: int, *, old_version: int = 0,
+    layout_meta: dict | None = None, timeout_s: float = 10.0,
+) -> tuple[int, np.ndarray]:
+    """Assemble GLOBAL flat-vector range ``[lo, hi)`` of param-store
+    object ``name`` from the old layout: for each old shard whose slice
+    overlaps, pull exactly the overlap (ranged REPL_SYNC) and
+    concatenate.  Returns ``(step, data)`` with ``step`` the MINIMUM
+    across contributing shards (the sharded-store tear convention).
+    Byte-exact: the concatenation over any partition of
+    ``[0, num_elems)`` reproduces the old tier's stored bytes —
+    tests/test_reshard.py pins this for N→M and M→N."""
+    meta = layout_meta or discover_old_layout(
+        old_addrs, old_version=old_version, timeout_s=timeout_s
+    )
+    sizes = meta["objects"].get(name)
+    if sizes is None:
+        raise KeyError(f"old layout carries no param-store object {name!r}")
+    total = sum(sizes)
+    lo_c, hi_c = max(0, lo), min(hi, total)
+    parts: list[np.ndarray] = []
+    step = None
+    off = 0
+    for shard_i, n in enumerate(sizes):
+        s_lo, s_hi = off, off + n
+        off += n
+        olo, ohi = max(lo_c, s_lo), min(hi_c, s_hi)
+        if olo >= ohi:
+            continue
+        pulled = _ranged_sync_any(
+            _as_replica_list(old_addrs[shard_i]), olo - s_lo, ohi - olo,
+            layout_version=old_version, timeout_s=timeout_s,
+        )[name]
+        if pulled["count"] != ohi - olo:
+            raise ConnectionError(
+                f"ranged sync of {name!r} shard {shard_i} answered "
+                f"{pulled['count']} elems for a {ohi - olo}-elem ask — "
+                "the old layout changed mid-transition"
+            )
+        parts.append(pulled["data"])
+        step = pulled["step"] if step is None else min(step, pulled["step"])
+    data = np.concatenate(parts) if parts else np.empty((0,), np.float32)
+    return (step if step is not None else -1, data)
+
+
+def assemble_for_shard(
+    old_addrs, shard_id: int, new_shards: int, *, old_version: int = 0,
+    layout_meta: dict | None = None, timeout_s: float = 10.0,
+) -> dict[str, tuple[int, np.ndarray]]:
+    """Every param-store object's slice for NEW shard ``shard_id`` of a
+    ``new_shards``-way layout, assembled from the old tier.  Each object
+    is partitioned by its OWN deterministic :class:`~.ps_shard.ShardLayout`
+    over its own total (the same rule every client derives), so a joiner
+    and the clients that will dial it can never disagree about the
+    slice."""
+    from . import ps_shard
+
+    meta = layout_meta or discover_old_layout(
+        old_addrs, old_version=old_version, timeout_s=timeout_s
+    )
+    out: dict[str, tuple[int, np.ndarray]] = {}
+    for name, total in meta["num_elems"].items():
+        layout = ps_shard.ShardLayout(total, new_shards)
+        rng = layout.slice(shard_id)
+        out[name] = assemble_slice(
+            old_addrs, name, rng.start, rng.stop, old_version=old_version,
+            layout_meta=meta, timeout_s=timeout_s,
+        )
+    return out
+
+
+def install_assembled(
+    addr: tuple[str, int], objects: dict[str, tuple[int, np.ndarray]], *,
+    layout_version: int = 0, timeout_s: float = 10.0,
+) -> None:
+    """Create-and-fill the assembled param-store slices on a NEW shard
+    server (epoch-pinned dial, so installing onto the wrong epoch fails
+    loudly).  Zero-size slices (more shards than elements) are skipped —
+    the native services reject zero-element objects, exactly the
+    empty-shard convention ShardedParamStore handles client-side."""
+    from . import ps_service
+
+    c = ps_service.PSClient(
+        addr[0], addr[1], timeout_s=timeout_s, expect_layout=layout_version,
+    )
+    try:
+        for name, (step, data) in objects.items():
+            if data.size == 0:
+                continue
+            ps_service._check(
+                c.ensure_object(ps_service._PSTORE_GET_OBJ, name, data.size),
+                "pstore_get_obj",
+            )
+            if step >= 0:
+                ps_service._check(
+                    c.call(
+                        ps_service._PSTORE_SET, name, step, payload=data
+                    )[0],
+                    "pstore_set",
+                )
+    finally:
+        c.close()
+
+
+def join_new_shard(
+    own_addr: tuple[str, int], shard_id: int, new_shards: int,
+    new_version: int, old_addrs, *, old_version: int = 0,
+    wait_published_s: float = 60.0, timeout_s: float = 10.0,
+) -> dict:
+    """The whole joiner sync: wait for the old layout to hold a PUBLISHED
+    store, assemble this new shard's slices, install them on ``own_addr``.
+    Returns the discovered old-layout meta (the joiner announces the
+    transition record from its ``num_elems``).  Raises ConnectionError
+    when the old tier never publishes within the budget — a joiner
+    against an unpublished (or already-drained) old layout must fail
+    loudly, not serve zeros."""
+    deadline = time.monotonic() + wait_published_s
+    while True:
+        meta = discover_old_layout(
+            old_addrs, old_version=old_version, timeout_s=timeout_s
+        )
+        published = bool(meta["objects"]) and all(
+            step >= 0
+            for name, steps in meta["steps"].items()
+            for n, step in zip(meta["objects"][name], steps)
+            if n > 0
+        )
+        if published:
+            break
+        if time.monotonic() >= deadline:
+            raise ConnectionError(
+                f"old layout v{old_version} at {old_addrs} never presented "
+                f"a published store within {wait_published_s}s"
+            )
+        time.sleep(0.25)
+    install_assembled(
+        own_addr,
+        assemble_for_shard(
+            old_addrs, shard_id, new_shards, old_version=old_version,
+            layout_meta=meta, timeout_s=timeout_s,
+        ),
+        layout_version=new_version, timeout_s=timeout_s,
+    )
+    return meta

@@ -137,6 +137,33 @@ PS_OPS: dict[str, int] = {
     "LEASE_ACQUIRE": 31,
     "LEASE_RELEASE": 32,
     "LEASE_LIST": 33,
+    # Live resharding (r15).  The COORDINATOR shard stores one RESHARD
+    # RECORD per slot — PENDING (a transition being prepared) and
+    # COMMITTED (the current layout epoch) — as an opaque raw JSON blob
+    # (``parallel/reshard.py`` owns the schema; payloads are raw 4-byte
+    # units like STATS, never dtype-encoded).  RESHARD_BEGIN: a = the new
+    # epoch version, payload = the record; stores/overwrites the pending
+    # slot (idempotent — every joining shard task may announce the same
+    # record); refused (-2) for a version not above the committed one.
+    # RESHARD_COMMIT: a = version; promotes a matching pending record to
+    # committed (idempotent when already committed at that version).
+    # RESHARD_GET: a = caller's known version, b = slot (0 committed / 1
+    # pending); answers the slot's version as the status (0 = empty) with
+    # the record payload only when it is newer than ``a`` — so the
+    # steady-state epoch poll every client runs costs O(header), exactly
+    # like an unchanged-step PSTORE_GET_IF_NEWER.  RESHARD_ABORT: a =
+    # version; clears a matching pending record (1 cleared / 0 nothing) —
+    # the loud mid-transition bail-out.  All four are control-plane ops
+    # excluded from the request counter (they fire on poll cadence, like
+    # STATS/LEASE ops, and must not perturb ``die:after_reqs`` triggers).
+    # REPL_SYNC additionally accepts a RANGE (a = start element, b =
+    # element count > 0): the slice-ranged state transfer a new-layout
+    # shard task assembles its slice from (param-store objects only; see
+    # ps_server.cc for the ranged blob layout).
+    "RESHARD_BEGIN": 34,
+    "RESHARD_COMMIT": 35,
+    "RESHARD_GET": 36,
+    "RESHARD_ABORT": 37,
 }
 
 #: Data-service op codes (data/data_service.py).  Disjoint from the PS

@@ -235,7 +235,8 @@ class LeaseServeDiscovery:
 
     def __init__(
         self, ps_addrs, pool, *, poll_s: float = 1.0,
-        role: str | None = None,
+        role: str | None = None, follow_epoch: bool = True,
+        layout_version: int = 0,
     ):
         from ..parallel import membership
 
@@ -258,9 +259,14 @@ class LeaseServeDiscovery:
                 self.pool.set_addrs(addrs)
                 self.updates += 1
 
+        # follow_epoch (r15): the registry moves with a live PS reshard;
+        # chasing the committed epoch keeps replica discovery alive
+        # across an N→M transition (a pre-r15 coordinator answers the
+        # poll -2 and nothing changes).
         self._watcher = membership.LeaseWatcher(
             list(ps_addrs), kind="serve", poll_s=poll_s,
             on_join=_reconcile, on_leave=_reconcile, role=role,
+            follow_epoch=follow_epoch, layout_version=layout_version,
         )
 
     def poll_once(self) -> None:

@@ -113,22 +113,41 @@ class ModelReplicaServer:
         reconnect_deadline_s: float = 60.0, role: str | None = None,
         metrics_dir: str | None = None, metrics_every: int = 100,
         membership: bool = True, lease_ttl_s: float = 10.0,
-        advertise_addr: str | None = None,
+        advertise_addr: str | None = None, ps_replicas: int = 1,
+        layout_version: int = 0, follow_reshard: bool = True,
     ):
         import jax
+
+        from ..parallel import reshard
 
         total, self._unflatten = flat_param_spec(init_fn)
         self._predict = jax.jit(predict_fn)
         self.role = role if role is not None else (
             faults.current_role() or "serve0"
         )
+        self._op_timeout_s = op_timeout_s
+        self._reconnect_deadline_s = reconnect_deadline_s
         self._group = ps_shard.ShardedPSClients(
             list(ps_addrs), role=self.role, op_timeout_s=op_timeout_s,
             reconnect_deadline_s=reconnect_deadline_s,
+            replicas=ps_replicas, layout_version=layout_version,
         )
-        self._layout = ps_shard.ShardLayout(total, self._group.num_shards)
+        self._layout = self._group.layout_for(total)
         self._pstore = ps_shard.ShardedParamStore(
             self._group, "params", self._layout
+        )
+        # Live resharding (r15): the refresher polls the coordinator for a
+        # committed layout epoch (O(header) while unchanged) and swaps its
+        # whole PS-side onto the new topology — a replica keeps
+        # hot-tracking through an N→M reshard with zero restarts.
+        self._reshards = 0
+        self._follower = (
+            reshard.EpochFollower(
+                self._group.coordinator, layout_version,
+                max(0.5, refresh_ms / 1e3),
+            )
+            if follow_reshard
+            else None
         )
         self.max_batch = int(max_batch)
         self._refresh_s = max(refresh_ms, 1.0) / 1e3
@@ -260,10 +279,56 @@ class ModelReplicaServer:
 
     # -- the param refresher (hot-tracking thread) ---------------------------
 
+    def _swap_epoch(self, rec: dict) -> None:
+        """Rebuild the PS-side onto a committed reshard record (refresher
+        thread only — the predict path reads ``self._model``, an immutable
+        tuple this swap never touches).  A failed rebuild keeps the
+        current epoch and retries on the next poll."""
+        old_version = self._layout.version
+        if rec["num_elems"] != self._layout.num_elems:
+            log.error(
+                "serve %s: reshard v%d names %d elems, this replica "
+                "serves %d — ignoring the record", self.role,
+                rec["version"], rec["num_elems"], self._layout.num_elems,
+            )
+            return
+        group = None
+        try:
+            group = ps_shard.ShardedPSClients.for_record(
+                rec, role=self.role, op_timeout_s=self._op_timeout_s,
+                reconnect_deadline_s=self._reconnect_deadline_s,
+            )
+            layout = group.layout_for(self._layout.num_elems)
+            pstore = ps_shard.ShardedParamStore(group, "params", layout)
+        except Exception as e:  # noqa: BLE001 — keep old epoch, retry
+            if group is not None:
+                group.close()
+            self._follower.version = old_version
+            faults.log_event(
+                "serve_epoch_swap_failed", role=self.role,
+                version=rec["version"], error=type(e).__name__,
+            )
+            return
+        old_group = self._group
+        self._group, self._layout, self._pstore = group, layout, pstore
+        self._follower.rebind(group.coordinator, rec["version"])
+        self._reshards += 1
+        if self._heartbeat is not None:
+            self._heartbeat.retarget(group.coordinator_replica_addrs)
+        old_group.close()
+        faults.log_event(
+            "serve_epoch_swapped", role=self.role, version=rec["version"],
+            shards=layout.num_shards,
+        )
+
     def _refresh_loop(self) -> None:
         from ..parallel import ps_service
 
         while not self._stop.is_set():
+            if self._follower is not None:
+                rec = self._follower.poll()
+                if rec is not None:
+                    self._swap_epoch(rec)
             try:
                 step, flat = self._pstore.get()
             except (ps_service.PSError, OSError) as e:
@@ -349,6 +414,8 @@ class ModelReplicaServer:
                 "refreshes": self._refreshes,
                 "refresh_errors": self._refresh_errors,
                 "ps_shards": self._group.num_shards,
+                "layout_epoch": self._layout.version,
+                "reshards_followed": self._reshards,
                 "leased": bool(
                     self._heartbeat is not None and self._heartbeat.enabled
                 ),
@@ -509,7 +576,8 @@ def host_serve_task(
     refresh_ms: float = 50.0, op_timeout_s: float | None = 10.0,
     reconnect_deadline_s: float = 60.0, metrics_dir: str | None = None,
     membership: bool = True, lease_ttl_s: float = 10.0,
-    advertise_addr: str | None = None,
+    advertise_addr: str | None = None, ps_replicas: int = 1,
+    layout_version: int = 0,
 ) -> int:
     """Dedicated serve-task body (``--job_name=serve``): host one replica
     until a client signals SRV_SHUTDOWN (or the supervisor dies).  Arms
@@ -524,7 +592,8 @@ def host_serve_task(
         refresh_ms=refresh_ms, op_timeout_s=op_timeout_s,
         reconnect_deadline_s=reconnect_deadline_s, metrics_dir=metrics_dir,
         membership=membership, lease_ttl_s=lease_ttl_s,
-        advertise_addr=advertise_addr,
+        advertise_addr=advertise_addr, ps_replicas=ps_replicas,
+        layout_version=layout_version,
     )
     faults.arm_process_faults(
         request_count_fn=server.request_count,

@@ -398,6 +398,9 @@ def run_ps_cluster_task(
             FLAGS.data_dir, int(my_port), batch_size=local_bs,
             seed=FLAGS.seed, loopback_only=not listen_all,
             ps_addrs=lease_addrs,
+            ps_layout_version=int(
+                getattr(FLAGS, "ps_layout_version", 0) or 0
+            ),
         )
         print(f"DSVC_DONE port={bound}")
         return None
@@ -457,7 +460,12 @@ def run_ps_cluster_task(
         bound = serve_pkg.host_serve_task(
             init_fn=init_fn,
             predict_fn=predict_fn,
-            ps_addrs=primary_addrs,
+            # Full replica-major list (r15): the replica's PS legs get the
+            # same failover the training clients have, and its refresher
+            # follows committed layout epochs from the same topology.
+            ps_addrs=shard_addrs,
+            ps_replicas=n_replicas,
+            layout_version=layout_version,
             port=int(my_port),
             loopback_only=not listen_all,
             max_batch=int(getattr(FLAGS, "serve_max_batch", 32)),
@@ -495,6 +503,54 @@ def run_ps_cluster_task(
                 "--job_name=ps contradicts --ps_tasks=0 (chief hosts the "
                 "service); launch without the PS task or drop --ps_tasks=0"
             )
+        from ..parallel.membership import coordinator_addrs as _coord_addrs
+
+        reshard_spec = getattr(FLAGS, "ps_reshard_to", "") or ""
+        if reshard_spec:
+            # Live-reshard JOINER (r15): this task serves shard
+            # --task_index of the TARGET topology named by
+            # --ps_reshard_to, assembling its slice from the OLD topology
+            # (--ps_hosts / --ps_shards / --ps_layout_version) before it
+            # carries data.  See RUNBOOK "Live resharding".
+            from ..utils.flags import parse_reshard_to
+
+            new_version, new_entries = parse_reshard_to(reshard_spec)
+            if new_version <= layout_version:
+                raise ValueError(
+                    f"--ps_reshard_to epoch {new_version} must exceed the "
+                    f"old --ps_layout_version {layout_version}"
+                )
+            tid = FLAGS.task_index
+            if tid >= len(new_entries):
+                raise ValueError(
+                    f"--task_index={tid} exceeds the {len(new_entries)}-"
+                    "entry --ps_reshard_to topology"
+                )
+            my_host, my_port = new_entries[tid]
+            listen_all = _resolve_listen_all(
+                FLAGS, my_host, "--ps_reshard_to"
+            )
+            rc = _supervised_reexec(FLAGS, child_env_flag="DTX_PS_SUPERVISED")
+            if rc is not None:
+                if rc != 0:
+                    raise SystemExit(rc)
+                return None
+            bound = async_ps.host_ps_task(
+                int(my_port), loopback_only=not listen_all,
+                shard_id=tid, shard_count=len(new_entries),
+                layout_version=new_version,
+                coordinator_addrs=[new_entries[0]],
+                lease_ttl_s=float(getattr(FLAGS, "lease_ttl_s", 10.0) or 10.0),
+                reshard_from={
+                    "addrs": shard_addrs,
+                    "shards": n_shards,
+                    "replicas": n_replicas,
+                    "version": layout_version,
+                    "new_addrs": new_entries,
+                },
+            )
+            print(f"PS_DONE port={bound}")
+            return None
         tid = min(FLAGS.task_index, len(entries) - 1)
         my_host, my_port = entries[tid]
         listen_all = _resolve_listen_all(FLAGS, my_host)
@@ -547,6 +603,11 @@ def run_ps_cluster_task(
                 shard_id=s_id, shard_count=n_shards,
                 layout_version=layout_version, peer=peer,
                 peer_role=peer_role, sync_wait_s=sync_wait_s,
+                # The coordinator's registry backs the idle-pair self-exit
+                # (RUNBOOK 4e) and the drain/epoch reads.
+                coordinator_addrs=_coord_addrs(
+                    entries, n_shards, n_replicas
+                ),
             )
         print(f"PS_DONE port={bound}")
         return None

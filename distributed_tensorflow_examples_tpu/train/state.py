@@ -49,6 +49,65 @@ def _split_init(init_params_fn, rng):
     return params, model_state
 
 
+#: Lazily probed, cached PER MESH SHAPE: whether this jax/XLA generates
+#: the same random bits under a sharded ``out_shardings`` jit as eagerly.
+#: Some pinned jaxlibs partition the threefry computation non-invariantly
+#: (different counter slices per shard -> different draws, even with
+#: ``jax_threefry_partitionable``) — and whether it manifests depends on
+#: the MESH (observed: single-axis whole-device meshes stay invariant,
+#: multi-axis meshes do not) — which silently breaks every "born-sharded
+#: init == eager init" parity contract the tests (and the PS workers'
+#: ``init_fn`` template convention) rely on.
+_PARTITIONED_RNG_INVARIANT: dict[tuple, bool] = {}
+
+
+def _partitioned_rng_invariant(mesh: Mesh) -> bool:
+    axis = next((a for a, n in mesh.shape.items() if n > 1), None)
+    if axis is None:
+        return True  # trivial mesh: nothing partitions
+    key = tuple(sorted(mesh.shape.items()))
+    cached = _PARTITIONED_RNG_INVARIANT.get(key)
+    if cached is not None:
+        return cached
+    # Probe the INIT-SHAPED pattern per non-trivial axis: a stack of
+    # per-key draws with its leading dim sharded over that axis — the
+    # layer-stacked kernel shape the rule tables produce — at a
+    # representative block size (the observed drift is size-dependent:
+    # tiny draws partition invariantly while kernel-sized ones do not).
+    ok = True
+    for axis, n in mesh.shape.items():
+        if n <= 1:
+            continue
+
+        def mk(r, n=n):
+            ks = jax.random.split(r, n)
+            return jnp.stack(
+                [jax.random.uniform(k, (32, 96)) for k in ks]
+            )
+
+        eager = mk(jax.random.key(7))
+        sharded = jax.jit(
+            mk,
+            out_shardings=jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(axis)
+            ),
+        )(jax.random.key(7))
+        if not bool(jnp.all(eager == sharded)):
+            ok = False
+            break
+    _PARTITIONED_RNG_INVARIANT[key] = ok
+    if not ok:
+        import logging
+
+        logging.getLogger("dtx.state").warning(
+            "this jax partitions RNG non-invariantly under sharded "
+            "out_shardings on mesh %s; create_sharded_state falls back "
+            "to init-then-place (params materialise replicated on the "
+            "host first)", dict(mesh.shape),
+        )
+    return ok
+
+
 def create_sharded_state(
     init_params_fn: Callable,
     optimizer,
@@ -119,7 +178,17 @@ def create_sharded_state(
         shardings.opt_state = _zero_shard_opt(
             shardings.opt_state, abstract.opt_state, mesh, zero_min_elements
         )
-    state = jax.jit(_init, out_shardings=shardings)(rng)
+    if _partitioned_rng_invariant(mesh):
+        state = jax.jit(_init, out_shardings=shardings)(rng)
+    else:
+        # Value-correct fallback for jaxlibs whose SPMD partitioner draws
+        # DIFFERENT random bits under sharded generation (see the probe
+        # above): init unsharded — bitwise the eager values — then place
+        # onto the rule shardings.  Costs one replicated materialisation
+        # of the state on the host; the born-distributed memory property
+        # returns automatically on a jax whose partitioned RNG is
+        # invariant.
+        state = jax.device_put(jax.jit(_init)(rng), shardings)
     return state, shardings
 
 

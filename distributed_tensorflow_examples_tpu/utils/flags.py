@@ -189,6 +189,24 @@ def define_legacy_cluster_flags():
         "scattering onto the wrong partition.  0 = unversioned.",
     )
     _define(
+        "string",
+        "ps_reshard_to",
+        "",
+        "Live PS resharding (r15): makes a --job_name=ps task a JOINER of "
+        "a layout-epoch transition.  Format 'V:host:port,host:port,...' — "
+        "V is the NEW epoch (> --ps_layout_version) and the list is the "
+        "new topology (this task serves entry --task_index).  The joiner "
+        "assembles its slice of the flat parameter vector from the OLD "
+        "topology (--ps_hosts/--ps_shards/--ps_layout_version) over "
+        "slice-ranged REPL_SYNC, announces the transition as the "
+        "coordinator's pending record, and heartbeats a 'ps'-kind lease; "
+        "the running chief verifies every joiner, republishes current "
+        "params, commits the epoch, every client swaps (in-flight pushes "
+        "stay at-most-once via epoch-scoped dedup tags), and the old "
+        "tasks drain and exit 0.  Empty = a normal (non-joiner) PS task.  "
+        "See RUNBOOK 'Live resharding'.",
+    )
+    _define(
         "integer",
         "ps_restarts",
         3,
@@ -386,6 +404,19 @@ def ps_shard_topology(FLAGS) -> tuple[list[tuple[str, int]], int, int]:
             "or -1 shards for one shard per host)"
         )
     return addrs, n, r
+
+
+def parse_reshard_to(spec: str) -> tuple[int, list[tuple[str, int]]]:
+    """Validate a ``--ps_reshard_to`` spec: ``V:host:port,host:port,...``
+    into ``(new_version, new_addrs)``.  Malformed specs fail the launch
+    loudly — a typo'd target topology must never half-join a transition."""
+    version_s, sep, hosts = spec.partition(":")
+    if not sep or not version_s.isdigit() or int(version_s) <= 0:
+        raise ValueError(
+            f"--ps_reshard_to {spec!r} must be 'V:host:port,...' with a "
+            "positive integer epoch V"
+        )
+    return int(version_s), parse_hostports(hosts, "--ps_reshard_to")
 
 
 def resolve_legacy_cluster(FLAGS) -> dict:

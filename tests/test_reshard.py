@@ -1,0 +1,438 @@
+"""Live PS resharding N→M (r15): the coordinator-driven layout-epoch
+protocol — record visibility, ranged REPL_SYNC byte-exactness, epoch-scoped
+dedup tags, drain-then-exit, the mid-transition chaos abort, and the
+in-process end-to-end transition under live training (the loadsim scenario's
+multi-process twin is ``tools/loadsim.py --scenario=reshard``).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_examples_tpu.parallel import (
+    ps_service,
+    ps_shard,
+    reshard,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _stop_servers():
+    yield
+    ps_service.stop_server()
+
+
+def _sharded_store(n_shards: int, flat: np.ndarray, *, version: int = 1):
+    ports = [
+        ps_service.start_server(
+            0, shard_id=i, shard_count=n_shards, layout_version=version
+        )
+        for i in range(n_shards)
+    ]
+    addrs = [("127.0.0.1", p) for p in ports]
+    group = ps_shard.ShardedPSClients(addrs, layout_version=version)
+    store = ps_shard.ShardedParamStore(
+        group, "params", group.layout_for(flat.size)
+    )
+    store.set(7, flat)
+    return ports, addrs, group, store
+
+
+# ---------------------------------------------------------------------------
+# Epoch record protocol
+# ---------------------------------------------------------------------------
+
+
+def test_reshard_record_bump_visibility_and_idempotence():
+    port = ps_service.start_server(0)
+    c = ps_service.PSClient("127.0.0.1", port, timeout_s=5.0)
+    try:
+        assert c.reshard_poll(0) == (0, b"")  # no record, O(header)
+        blob = reshard.pack_record(
+            2, [("127.0.0.1", 1), ("127.0.0.1", 2)], 10,
+            from_version=1, from_addrs=[("127.0.0.1", 9)],
+        )
+        c.reshard_announce(2, blob)
+        c.reshard_announce(2, blob)  # every joiner announces: idempotent
+        # Pending is visible on the pending slot only.
+        assert reshard.poll_pending(c)["version"] == 2
+        assert c.reshard_poll(0) == (0, b"")
+        c.reshard_commit(2)
+        c.reshard_commit(2)  # idempotent re-commit
+        rec = reshard.poll_committed(c, 0)
+        assert rec["version"] == 2 and rec["shards"] == 2
+        assert rec["from"]["version"] == 1
+        # Unchanged poll answers status-only: the steady-state epoch poll
+        # moves O(header), never the record.
+        assert c.reshard_poll(2) == (2, b"")
+        assert reshard.poll_pending(c) is None  # consumed by the commit
+        # A version at/below the committed epoch can never re-enter.
+        with pytest.raises(ps_service.PSError):
+            c.reshard_announce(2, blob)
+        with pytest.raises(ps_service.PSError):
+            c.reshard_commit(3)  # nothing pending
+    finally:
+        c.close()
+
+
+def test_reshard_abort_clears_pending_only():
+    port = ps_service.start_server(0)
+    c = ps_service.PSClient("127.0.0.1", port, timeout_s=5.0)
+    try:
+        blob = reshard.pack_record(5, [("127.0.0.1", 1)], 4)
+        c.reshard_announce(5, blob)
+        assert c.reshard_abort(5) is True
+        assert reshard.poll_pending(c) is None
+        assert c.reshard_abort(5) is False  # idempotent: nothing to clear
+        assert c.reshard_poll(0) == (0, b"")  # committed slot untouched
+    finally:
+        c.close()
+
+
+def test_record_pack_parse_roundtrip_and_validation():
+    addrs = [("h", 1), ("h", 2), ("h", 3), ("h", 4)]
+    rec = reshard.parse_record(
+        reshard.pack_record(3, addrs, 100, replicas=2, from_version=2,
+                            from_addrs=[("h", 9)], from_replicas=1)
+    )
+    assert rec["shards"] == 2 and rec["replicas"] == 2
+    assert rec["addrs"] == addrs and rec["from"]["addrs"] == [("h", 9)]
+    with pytest.raises(ValueError):
+        reshard.pack_record(0, addrs, 100)  # epoch must be positive
+    with pytest.raises(ValueError):
+        reshard.pack_record(3, addrs, 100, replicas=3)  # does not tile
+    with pytest.raises(ValueError):
+        reshard.parse_record(b'{"version": 1, "num_elems": 1, "shards": 2,'
+                             b' "addrs": ["h:1"]}')  # addr count mismatch
+
+
+# ---------------------------------------------------------------------------
+# Ranged REPL_SYNC: byte-exactness N→M and M→N
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_old,n_new", [(2, 3), (3, 2), (2, 5), (4, 1)])
+def test_ranged_sync_byte_exact_across_layouts(n_old, n_new):
+    rng = np.random.default_rng(0)
+    flat = rng.normal(size=37).astype(np.float32)
+    _, addrs, group, _ = _sharded_store(n_old, flat)
+    try:
+        meta = reshard.discover_old_layout(addrs, old_version=1)
+        assert meta["num_elems"]["params"] == flat.size
+        new_layout = ps_shard.ShardLayout(flat.size, n_new)
+        rebuilt = np.empty_like(flat)
+        for j in range(n_new):
+            rng_j = new_layout.slice(j)
+            step, data = reshard.assemble_slice(
+                addrs, "params", rng_j.start, rng_j.stop, old_version=1,
+                layout_meta=meta,
+            )
+            assert step == 7
+            rebuilt[rng_j] = data
+        # BYTE-exact: the reassembly over the new partition reproduces the
+        # old tier's stored bytes bit for bit.
+        assert rebuilt.tobytes() == flat.tobytes()
+    finally:
+        group.close()
+
+
+def test_ranged_sync_clamps_out_of_range_and_probes_metadata():
+    flat = np.arange(9, dtype=np.float32)
+    ports, addrs, group, _ = _sharded_store(1, flat)
+    try:
+        # Metadata probe: names/sizes/steps, zero data bytes.
+        meta = reshard.ranged_sync(addrs[0], 0, 0, layout_version=1)
+        assert meta["params"]["total"] == 9 and meta["params"]["count"] == 0
+        # Past-the-end asks clamp instead of answering garbage.
+        got = reshard.ranged_sync(addrs[0], 5, 100, layout_version=1)
+        np.testing.assert_array_equal(got["params"]["data"], flat[5:])
+    finally:
+        group.close()
+
+
+def test_install_assembled_roundtrip_via_new_layout_clients():
+    flat = (np.arange(11) * 1.5).astype(np.float32)
+    _, addrs, group, _ = _sharded_store(2, flat)
+    nports = [
+        ps_service.start_server(0, shard_id=j, shard_count=3,
+                                layout_version=2)
+        for j in range(3)
+    ]
+    naddrs = [("127.0.0.1", p) for p in nports]
+    ngroup = None
+    try:
+        for j in range(3):
+            reshard.install_assembled(
+                naddrs[j],
+                reshard.assemble_for_shard(addrs, j, 3, old_version=1),
+                layout_version=2,
+            )
+        ngroup = ps_shard.ShardedPSClients(naddrs, layout_version=2)
+        s, got = ps_shard.ShardedParamStore(
+            ngroup, "params", ngroup.layout_for(flat.size)
+        ).get()
+        assert s == 7
+        assert got.tobytes() == flat.tobytes()
+    finally:
+        group.close()
+        if ngroup is not None:
+            ngroup.close()
+
+
+# ---------------------------------------------------------------------------
+# Mixed-epoch guards
+# ---------------------------------------------------------------------------
+
+
+def test_mixed_epoch_dial_fails_loudly_naming_both_versions():
+    port = ps_service.start_server(0, layout_version=3)
+    with pytest.raises(ps_service.PSError) as e:
+        ps_service.PSClient("127.0.0.1", port, timeout_s=5.0, expect_layout=5)
+    msg = str(e.value)
+    assert "EPOCH 3" in msg and "epoch 5" in msg
+
+
+def test_ranged_sync_refuses_wrong_epoch():
+    port = ps_service.start_server(0, layout_version=3)
+    with pytest.raises(ConnectionError) as e:
+        reshard.ranged_sync(("127.0.0.1", port), 0, 0, layout_version=5)
+    assert "EPOCH 3" in str(e.value)
+
+
+# ---------------------------------------------------------------------------
+# Dedup-tag epoch re-scoping
+# ---------------------------------------------------------------------------
+
+
+def test_pre_epoch_push_replay_never_double_applies():
+    """The (worker, seq) tag spaces re-scope per epoch: a replayed
+    PRE-epoch push still answers "duplicate" at the OLD server, and the new
+    epoch's fresh 0-based stream on the NEW server is independent — one
+    gradient per epoch, never two."""
+    old_port = ps_service.start_server(0, layout_version=1)
+    c_old = ps_service.PSClient(
+        "127.0.0.1", old_port, timeout_s=5.0, worker_tag=3, expect_layout=1,
+    )
+    gq_old = ps_service.RemoteGradientQueue(c_old, "gq", 4, capacity=4)
+    g = np.ones(4, np.float32)
+    assert gq_old.push(0, g) is True  # (worker 3, seq 1) applied
+    # Replay of the SAME pre-epoch tag at the old server: deduped, queue
+    # still holds exactly one gradient.
+    s, _ = c_old.call(
+        ps_service._GQ_PUSH_TAGGED, "gq", 0, ps_service._pack_tag(3, 1),
+        payload=g,
+    )
+    assert s == 2  # duplicate-of-enqueued
+    assert gq_old.deduped == 1
+
+    # The new epoch: fresh server, fresh tables; the swapped client's
+    # stream restarts at seq 1 behind a RESET_WORKER announce and is
+    # accepted — not mistaken for the old epoch's seq 1.
+    new_port = ps_service.start_server(0, layout_version=2)
+    c_new = ps_service.PSClient(
+        "127.0.0.1", new_port, timeout_s=5.0, worker_tag=3, expect_layout=2,
+    )
+    gq_new = ps_service.RemoteGradientQueue(c_new, "gq", 4, capacity=4)
+    assert gq_new.push(0, 2 * g) is True
+    assert gq_new.deduped == 0
+    step, out = gq_new.pop(timeout_s=5.0)
+    np.testing.assert_array_equal(out, 2 * g)
+    # Exactly one gradient per epoch's queue: drained new queue is empty.
+    assert gq_new.pop(timeout_s=0.2) is ps_service.TIMED_OUT
+    c_old.close()
+    c_new.close()
+
+
+# ---------------------------------------------------------------------------
+# Drain-then-exit of old tasks
+# ---------------------------------------------------------------------------
+
+
+_DRAIN_SCRIPT = """
+import sys
+sys.path.insert(0, {root!r})
+from distributed_tensorflow_examples_tpu.parallel import async_ps
+bound = async_ps.host_ps_task({port}, drain_timeout_s=30.0)
+print("TASK_EXIT", bound, flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.mark.slow
+def test_drain_token_waits_out_connections_then_exits_zero():
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _DRAIN_SCRIPT.format(root=ROOT, port=port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+    try:
+        deadline = time.monotonic() + 60
+        client = None
+        while time.monotonic() < deadline:
+            try:
+                client = ps_service.PSClient("127.0.0.1", port, timeout_s=2.0)
+                break
+            except OSError:
+                time.sleep(0.3)
+        assert client is not None, "PS task never came up"
+        # A lingering data-path connection holds the drain open.
+        lingerer = ps_service.PSClient("127.0.0.1", port, timeout_s=5.0)
+        lingerer.ping()
+        ps_service.RemoteTokenQueue(client, "ps_shutdown").push(1)
+        client.close()
+        time.sleep(2.0)
+        assert proc.poll() is None, "task exited before its clients drained"
+        # Mid-drain the STATS blob flags the server draining (the dtxtop
+        # signal a mid-transition cluster reads).
+        assert lingerer.stats()["draining"] == 1
+        lingerer.close()
+        assert proc.wait(timeout=30) == 0
+        out = proc.stdout.read()
+        assert "TASK_EXIT" in out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+# ---------------------------------------------------------------------------
+# Chaos: a joiner killed mid-transition → abort, never half-applied
+# ---------------------------------------------------------------------------
+
+
+def _mini_chief(train_steps=50, **kw):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_tensorflow_examples_tpu.parallel import async_ps
+
+    dim = 6
+
+    def init_fn(rng):
+        return {"w": jnp.zeros((dim,), jnp.float32)}
+
+    def loss_fn(params, ms, batch, rng):
+        pred = batch["x"] @ params["w"]
+        loss = jnp.mean((pred - batch["y"]) ** 2)
+        return loss, (ms, {"loss": loss})
+
+    cfg = async_ps.AsyncPSConfig(
+        num_workers=1, mode="async", train_steps=train_steps,
+        max_staleness=8, reshard_poll_s=0.1,
+        ps_op_timeout_s=5.0, ps_reconnect_deadline_s=3.0,
+        reshard_ready_timeout_s=5.0, reshard_drain_s=3.0, **kw,
+    )
+    chief = async_ps.RemotePSChief(
+        cfg, loss_fn, optax.sgd(0.05), init_fn(jax.random.key(0)),
+        ports=[0, 0], layout_version=1,
+    )
+    return chief, init_fn, loss_fn, cfg, dim
+
+
+def test_mid_transition_joiner_death_aborts_never_half_applies():
+    chief, *_ = _mini_chief()
+    old_ports = list(chief.ports)
+    # One live new-layout server + one DEAD address: the verify probe can
+    # never complete, so the transition must ABORT loudly and the old
+    # topology must keep serving.
+    live = ps_service.start_server(0, shard_id=0, shard_count=3,
+                                   layout_version=2)
+    dead = _free_port()
+    blob = reshard.pack_record(
+        2,
+        [("127.0.0.1", live), ("127.0.0.1", dead), ("127.0.0.1", dead)],
+        6, from_version=1,
+        from_addrs=[("127.0.0.1", p) for p in old_ports],
+    )
+    chief._group.coordinator.reshard_announce(2, blob)
+    assert chief._adopt_record(reshard.parse_record(blob)) is False
+    # Not half-applied: the chief still runs the OLD topology...
+    assert chief.layout_version == 1
+    assert chief._layout.num_shards == 2
+    assert chief.ports == old_ports
+    assert chief.reshards == 0
+    # ...the pending record is gone (a retrying joiner re-announces)...
+    assert reshard.poll_pending(chief._group.coordinator) is None
+    # ...nothing was committed, and the old store still serves publishes.
+    assert chief._group.coordinator.reshard_poll(0)[0] == 0
+    chief._publish()
+    step, flat = chief._pstore.get()
+    assert step == chief.global_step and flat.size == 6
+    chief._group.close()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end in-process transition under live training
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_e2e_reshard_2_3_2_under_training_zero_reseeds():
+    import jax
+
+    from distributed_tensorflow_examples_tpu.parallel import async_ps
+
+    chief, init_fn, loss_fn, cfg, dim = _mini_chief(train_steps=300)
+    W_TRUE = np.arange(dim, dtype=np.float32)
+
+    def batches(seed):
+        r = np.random.default_rng(seed)
+        while True:
+            x = r.normal(size=(32, dim)).astype(np.float32)
+            yield {"x": x, "y": x @ W_TRUE}
+
+    worker_n = []
+    wt = threading.Thread(
+        target=lambda: worker_n.append(async_ps.remote_worker_loop(
+            "127.0.0.1", chief.port, 1, cfg=cfg, loss_fn=loss_fn,
+            init_fn=init_fn, batches=batches(1),
+            addrs=[("127.0.0.1", p) for p in chief.ports],
+            layout_version=1,
+        )),
+        daemon=True,
+    )
+    ct = threading.Thread(target=chief.run_chief, daemon=True)
+    ct.start()
+    wt.start()
+    deadline = time.monotonic() + 60
+    while chief.global_step < 40 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert chief.global_step >= 40, "training never started"
+    assert chief.reshard_to(3)
+    while chief.reshards < 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert chief.reshards == 1 and chief._layout.num_shards == 3
+    assert chief.reshard_to(2)
+    while chief.reshards < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert chief.reshards == 2 and chief._layout.num_shards == 2
+    ct.join(120)
+    assert not ct.is_alive(), "chief stalled after the transitions"
+    wt.join(30)
+    err = float(np.abs(np.asarray(chief.params["w"]) - W_TRUE).max())
+    # The whole N→M→N cycle: full step count, converged, ZERO reseeds
+    # (the acceptance gate), the worker followed both epochs.
+    assert chief.global_step == 300
+    assert chief.reseeds == 0
+    assert chief.layout_version == 3
+    assert err < 0.5, err
+    assert worker_n and worker_n[0] > 0

@@ -68,9 +68,12 @@ _CONST_PAIRS = {
 #: standalone op name on ALL THREE wires (PS 30 / DSVC 69 / SRV 97 — the
 #: observability scrape), so it joins the exact-match list: a restated
 #: STATS literal or an undispatched STATS case must fail like any op.
+#: RESHARD_ (r15) joins the namespace prefixes: the live-resharding op
+#: family (BEGIN/COMMIT/GET/ABORT) gets the same restated-literal and
+#: client-op-dispatch coverage as every other PS op.
 _PS_NAME = re.compile(
-    r"^_?(?:(?:ACC|TQ|GQ|PSTORE|REPL|LEASE)_\w+|CANCEL_ALL|PING|INCARNATION"
-    r"|HELLO|STATS)$"
+    r"^_?(?:(?:ACC|TQ|GQ|PSTORE|REPL|LEASE|RESHARD)_\w+|CANCEL_ALL|PING"
+    r"|INCARNATION|HELLO|STATS)$"
 )
 _DSVC_NAME = re.compile(r"^DSVC_\w+$")
 _SRV_NAME = re.compile(r"^SRV_\w+$")

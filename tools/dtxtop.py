@@ -170,6 +170,56 @@ def scrape_leases(
     return list(merged.values())
 
 
+def follow_reshard(
+    ps_addrs, ps_shards: int, ps_replicas: int, timeout_s: float,
+) -> tuple[list, int, int, dict]:
+    """Chase committed reshard records (r15): the given ``--ps_hosts`` may
+    name a topology that live-resharded away since the operator copied
+    it.  Each hop reads the current coordinator's committed record and
+    re-targets; the PENDING record (a transition in flight) is surfaced
+    too, so a mid-transition cluster reads at a glance.  Returns the
+    resolved ``(ps_addrs, ps_shards, ps_replicas, reshard_info)``."""
+    from distributed_tensorflow_examples_tpu.parallel import (
+        membership,
+        reshard,
+    )
+
+    info: dict = {"followed_from": None, "committed": 0, "pending": 0,
+                  "pending_shards": 0}
+    seen: set = set()
+    for _ in range(4):  # bounded: a record cycle must not loop forever
+        n_shards = resolve_shards(ps_addrs, ps_shards, ps_replicas)
+        rec = pending = None
+        for host, port in membership.coordinator_addrs(
+            ps_addrs, n_shards, ps_replicas
+        ):
+            try:
+                c = ps_service.PSClient(host, port, timeout_s=timeout_s)
+                try:
+                    rec = reshard.poll_committed(c, 0)
+                    pending = reshard.poll_pending(c)
+                finally:
+                    c.close()
+                break
+            except Exception:  # noqa: BLE001 — try the next replica
+                continue
+        if pending is not None:
+            info["pending"] = pending["version"]
+            info["pending_shards"] = pending["shards"]
+        if rec is None or tuple(rec["addrs"]) in seen:
+            break
+        seen.add(tuple(rec["addrs"]))
+        info["committed"] = rec["version"]
+        if rec["addrs"] != list(ps_addrs):
+            if info["followed_from"] is None:
+                info["followed_from"] = [f"{h}:{p}" for h, p in ps_addrs]
+            ps_addrs = rec["addrs"]
+            ps_shards, ps_replicas = rec["shards"], rec["replicas"]
+            continue
+        break
+    return list(ps_addrs), ps_shards, ps_replicas, info
+
+
 def snapshot(
     ps_addrs=(), *, ps_shards: int = 0, ps_replicas: int = 1,
     dsvc_addrs=(), serve_addrs=(), timeout_s: float = 5.0,
@@ -184,9 +234,20 @@ def snapshot(
     scraped too, and every LEASED serve replica whose address is not in
     the static ``serve_addrs`` is discovered and scraped as a live role —
     a dynamically-joined pool is never rendered as missing.  Leased
-    workers (no dialable address) are reported in the ``members`` list."""
+    workers (no dialable address) are reported in the ``members`` list.
+
+    Live resharding (r15): the committed layout epoch is FOLLOWED first —
+    a host list naming a resharded-away topology resolves to the current
+    one through the coordinator's records, and any pending (in-flight)
+    transition is reported in ``summary.ps.reshard``."""
     from distributed_tensorflow_examples_tpu.parallel import membership
 
+    reshard_info = {"followed_from": None, "committed": 0, "pending": 0,
+                    "pending_shards": 0}
+    if ps_addrs:
+        ps_addrs, ps_shards, ps_replicas, reshard_info = follow_reshard(
+            list(ps_addrs), ps_shards, ps_replicas, timeout_s
+        )
     members = (
         scrape_leases(
             ps_addrs, timeout_s, ps_shards=ps_shards,
@@ -239,6 +300,16 @@ def snapshot(
         "roles_total": len(roles),
         "roles_ok": sum(1 for r in roles if r["ok"]),
         "ps": {
+            "reshard": reshard_info,
+            "epochs": sorted({
+                int(r["stats"].get("layout_version", 0)) for r in ps_rows
+            }),
+            "draining": sorted(
+                r["role"] for r in ps_rows if r["stats"].get("draining")
+            ),
+            "reshard_syncs": sum(
+                r["stats"].get("reshard_syncs", 0) for r in ps_rows
+            ),
             "requests": sum(r["stats"]["requests"] for r in ps_rows),
             "deduped": sum(
                 r["stats"]["acc_deduped"] + r["stats"]["gq_deduped"]
@@ -299,14 +370,19 @@ def _fmt_ps_row(r: dict) -> str:
         "R" if s.get("replicated") else "-",
         "P" if s.get("partitioned") else "-",
         "D" if s.get("diverged") else "-",
+        # X = draining: this shard's layout was retired by a reshard and
+        # the task is waiting out its last connections before exit (r15).
+        "X" if s.get("draining") else "-",
     ))
     return (
         f"{s['requests']:>9} conns={s['live_conns']:<3} "
-        f"shard={s['shard_id']}/{s['shard_count']} {flags} "
+        f"shard={s['shard_id']}/{s['shard_count']}"
+        f"@v{s.get('layout_version', 0)} {flags} "
         f"dedup={s['acc_deduped'] + s['gq_deduped']:<5} "
         f"mirror={s['mirror_applies']:<6} fwd={s['fwd_ok']}"
         f"/{s['fwd_peer_down']}/{s['fwd_refused']} "
         f"syncs={s['repl_syncs_served']}"
+        f"+r{s.get('reshard_syncs', 0)}"
     )
 
 
@@ -374,6 +450,24 @@ def render(snap: dict, prev: dict | None = None) -> str:
         f"(workers={','.join(mem.get('workers', [])) or 'none'} "
         f"serve={','.join(mem.get('serve', [])) or 'none'})"
     )
+    rs = su["ps"].get("reshard", {})
+    if rs.get("committed") or rs.get("pending"):
+        lines.append(
+            f"reshard: epoch v{rs.get('committed', 0)} committed"
+            + (
+                f", v{rs['pending']} PENDING -> "
+                f"{rs.get('pending_shards', '?')} shard(s) "
+                f"(syncs={su['ps'].get('reshard_syncs', 0)}, "
+                f"draining={','.join(su['ps'].get('draining', [])) or 'none'})"
+                if rs.get("pending")
+                else f" (draining={','.join(su['ps'].get('draining', [])) or 'none'})"
+            )
+            + (
+                f" [followed from {','.join(rs['followed_from'])}]"
+                if rs.get("followed_from")
+                else ""
+            )
+        )
     lines.append(
         f"totals: ps_reqs={su['ps']['requests']} dedup={su['ps']['deduped']} "
         f"syncs={su['ps']['repl_syncs_served']} "

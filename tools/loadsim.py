@@ -75,6 +75,17 @@ PHASES = {
     "leave_worker": 0.75,
 }
 
+#: Reshard-scenario timeline (r15, ``--scenario=reshard``): resize the PS
+#: tier N→N+1→N shards mid-run under closed-loop predict load, with one
+#: worker kill landing between the transitions — the ROADMAP item 3
+#: acceptance: zero reseeds, zero failed predicts, monotone strictly
+#: advancing step, both epoch transitions visible to dtxtop.
+RESHARD_PHASES = {
+    "reshard_up": 0.20,
+    "kill_worker": 0.45,
+    "reshard_down": 0.55,
+}
+
 
 def free_ports(n: int) -> list[int]:
     socks, ports = [], []
@@ -190,8 +201,8 @@ class LoadGenerator:
         }
 
 
-def launch_task(example, common, job, index, logdir, env):
-    log_path = os.path.join(logdir, f"{job}{index}.log")
+def launch_task(example, common, job, index, logdir, env, log_name=None):
+    log_path = os.path.join(logdir, f"{log_name or f'{job}{index}'}.log")
     f = open(log_path, "ab")
     proc = subprocess.Popen(
         [sys.executable, example, *common, f"--job_name={job}",
@@ -261,6 +272,280 @@ def analyze_steps(step_series: list[tuple[float, int]], markers: dict) -> dict:
     }
 
 
+def run_reshard(args) -> int:
+    """The live-resharding acceptance scenario (``--scenario=reshard``):
+    boot a real multi-process cluster at N PS shards (layout epoch 1),
+    hold closed-loop predict load, then mid-run spawn N+1 ``--ps_reshard_to``
+    joiner tasks (epoch 2), kill a worker while the new layout serves,
+    and reshard back down to N shards (epoch 3).  SLO verdict
+    (``reshard_slo``): zero failed predicts, zero chief reseeds, p99
+    under bound, monotone strictly-advancing step, both transitions
+    committed within ``--reshard_bound_s`` each, every retired PS task
+    drained and exited 0, and all three epochs visible to dtxtop."""
+    from distributed_tensorflow_examples_tpu.utils import faults
+    from tools import dtxtop
+
+    faults.set_role("loadsim")
+    logdir = args.logdir or tempfile.mkdtemp(prefix="dtx-loadsim-rs-")
+    n1 = max(1, args.ps_shards)
+    n2 = n1 + 1
+    topo_shards = {1: n1, 2: n2, 3: n1}
+    ports = free_ports(n1 + n2 + n1 + args.serve_replicas)
+    topo_ports = {
+        1: ports[:n1],
+        2: ports[n1 : n1 + n2],
+        3: ports[n1 + n2 : n1 + n2 + n1],
+    }
+    serve_ports = ports[n1 + n2 + n1 :]
+    topo_addrs = {
+        v: [("127.0.0.1", p) for p in topo_ports[v]] for v in (1, 2, 3)
+    }
+    serve_addrs = [("127.0.0.1", p) for p in serve_ports]
+
+    def hosts(v):
+        return ",".join(f"127.0.0.1:{p}" for p in topo_ports[v])
+
+    def common_for(old_epoch: int):
+        return [
+            "--sync_replicas=false",
+            "--batch_size=64",
+            "--train_steps=1000000",  # outlives the window; loadsim tears down
+            "--hidden_units=32",
+            f"--ps_hosts={hosts(old_epoch)}",
+            f"--ps_shards={topo_shards[old_epoch]}",
+            "--ps_replicas=1",
+            f"--ps_layout_version={old_epoch}",
+            f"--worker_hosts={','.join(f'127.0.0.1:{7000 + i}' for i in range(args.workers))}",
+            f"--serve_hosts={','.join(f'127.0.0.1:{p}' for p in serve_ports)}",
+            "--ps_restarts=3",
+            f"--lease_ttl_s={args.lease_ttl_s}",
+            "--log_every_steps=50",
+        ]
+
+    t_kill = args.boot_offset_s + RESHARD_PHASES["kill_worker"] * args.duration_s
+    plan = "" if args.no_chaos else f"die:role=worker1,after_s={t_kill:.1f}"
+    env = dict(os.environ)
+    env.pop("DTX_FAULT_ROLE", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["DTX_FAULT_PLAN"] = plan
+    procs: dict[str, subprocess.Popen] = {}
+
+    def spawn(name: str, job: str, index: int, extra=(), old_epoch: int = 1):
+        procs[name] = launch_task(
+            args.example, common_for(old_epoch) + list(extra), job, index,
+            logdir, env, log_name=name,
+        )
+
+    verdict: dict = {
+        "schema_version": VERDICT_SCHEMA_VERSION,
+        "metric": "loadsim_reshard_slo",  # perf_gate baseline auto-select
+        "qps_target": args.qps,
+        "duration_s": args.duration_s,
+        "p99_bound_ms": args.p99_bound_ms,
+        "reshard_bound_s": args.reshard_bound_s,
+        "logdir": logdir,
+        "chaos": not args.no_chaos,
+        "shards": [n1, n2, n1],
+    }
+    gen = None
+    step_series: list[tuple[float, int]] = []
+    epochs_seen: set[int] = set()
+    committed_at: dict[int, float] = {}
+    spawned_at: dict[int, float] = {}
+    scrape_fail = 0
+    cli_probe: dict = {}
+    try:
+        for i in range(n1):
+            spawn(f"ps_v1_{i}", "ps", i)
+        if not wait_ps_ready(topo_addrs[1], args.ready_wait_s):
+            raise RuntimeError(f"PS tasks never came up (logs: {logdir})")
+        spawn("chief0", "chief", 0)
+        for i in range(args.workers):
+            spawn(f"worker{i}", "worker", i)
+        for i in range(args.serve_replicas):
+            spawn(f"serve{i}", "serve", i)
+        if not wait_serve_ready(serve_addrs, args.ready_wait_s):
+            raise RuntimeError(
+                f"serve replicas never pulled a model (logs: {logdir})"
+            )
+
+        gen = LoadGenerator(
+            topo_addrs[1], serve_addrs, qps=args.qps,
+            deadline_s=max(30.0, args.duration_s),
+        )
+        gen.start()
+        t0 = time.monotonic()
+        t_end = t0 + args.duration_s
+        markers = {
+            name: t0 + frac * args.duration_s
+            for name, frac in RESHARD_PHASES.items()
+        }
+        while time.monotonic() < t_end or (
+            len(committed_at) < 2 and time.monotonic() < t_end + 45.0
+        ):
+            now = time.monotonic()
+            if 2 not in spawned_at and now >= markers["reshard_up"]:
+                spawned_at[2] = now
+                for j in range(n2):
+                    spawn(
+                        f"ps_v2_{j}", "ps", j,
+                        extra=[f"--ps_reshard_to=2:{hosts(2)}"], old_epoch=1,
+                    )
+                faults.log_event("loadsim_reshard_spawned", version=2)
+            if 3 not in spawned_at and now >= markers["reshard_down"] and \
+                    2 in committed_at:
+                spawned_at[3] = now
+                for j in range(n1):
+                    spawn(
+                        f"ps_v3_{j}", "ps", j,
+                        extra=[f"--ps_reshard_to=3:{hosts(3)}"], old_epoch=2,
+                    )
+                faults.log_event("loadsim_reshard_spawned", version=3)
+            # Scrape the newest LIVE topology: a retired tier drains and
+            # exits quickly once every client swapped, so the scrape must
+            # not stay pinned to a dead coordinator (an operator keeps
+            # their --ps_hosts fresh the same way; dtxtop's record-chasing
+            # covers the drain window, not a long-gone tier).
+            snap = None
+            for v in sorted({1, *spawned_at}, reverse=True):
+                try:
+                    s = dtxtop.snapshot(
+                        topo_addrs[v], ps_shards=topo_shards[v],
+                        ps_replicas=1, timeout_s=3.0,
+                    )
+                except Exception:  # noqa: BLE001 — try the next tier
+                    continue
+                if s["summary"]["roles_ok"] > 0:
+                    snap = s
+                    break
+            if snap is None:
+                scrape_fail += 1
+            else:
+                steps = snap["summary"]["serve"]["model_steps"]
+                step_series.append(
+                    (time.monotonic(), max(steps) if steps else -1)
+                )
+                epochs_seen.update(snap["summary"]["ps"].get("epochs", []))
+                committed = snap["summary"]["ps"]["reshard"].get(
+                    "committed", 0
+                )
+                for v in (2, 3):
+                    if committed >= v and v not in committed_at:
+                        committed_at[v] = time.monotonic()
+                verdict["members_last"] = snap["summary"]["members"]
+            # THE acceptance probe: after the second commit, the real
+            # dtxtop CLI must exit 0 against the CURRENT topology and
+            # show the final epoch — both transitions chased and visible.
+            if 3 in committed_at and not cli_probe:
+                cli = subprocess.run(
+                    [sys.executable, "-m", "tools.dtxtop", "--json",
+                     f"--ps_hosts={hosts(3)}",
+                     f"--ps_shards={topo_shards[3]}", "--ps_replicas=1"],
+                    capture_output=True, text=True, cwd=ROOT, env=env,
+                    timeout=120,
+                )
+                cli_probe["exit"] = cli.returncode
+                try:
+                    s = json.loads(cli.stdout.strip().splitlines()[-1])
+                    cli_probe["committed"] = (
+                        s["summary"]["ps"]["reshard"]["committed"]
+                    )
+                    cli_probe["epochs"] = s["summary"]["ps"]["epochs"]
+                except Exception:  # noqa: BLE001
+                    cli_probe["committed"] = -1
+            time.sleep(1.0)
+        verdict["window_s"] = round(time.monotonic() - t0, 1)
+    finally:
+        load = gen.stop() if gen is not None else {
+            "predict_ok": 0, "predict_failed": -1, "errors": ["never ran"],
+            "p50_ms": 0.0, "p90_ms": 0.0, "p99_ms": 0.0,
+        }
+        # Give retired tiers a moment to finish their drain-exit before
+        # the verdict reads their exit codes.
+        deadline = time.monotonic() + 10.0
+        retired = [
+            n for n in procs
+            if n.startswith(("ps_v1_", "ps_v2_")) and len(committed_at) >= 2
+        ]
+        while time.monotonic() < deadline and any(
+            procs[n].poll() is None for n in retired
+        ):
+            time.sleep(0.5)
+        verdict["old_ps_exit"] = {n: procs[n].poll() for n in retired}
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + 15.0
+        for p in procs.values():
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+            getattr(p, "_dtx_logf").close()
+
+    window = verdict.get("window_s") or args.duration_s
+    verdict.update(load)
+    verdict["qps_achieved"] = round(load["predict_ok"] / window, 2)
+    verdict["scrape_failures"] = scrape_fail
+    verdict["epochs_seen"] = sorted(epochs_seen)
+    verdict["transition_s"] = {
+        str(v): round(committed_at[v] - spawned_at[v], 1)
+        for v in committed_at
+        if v in spawned_at
+    }
+    verdict["dtxtop_probe"] = cli_probe
+    markers_t = {f"reshard_v{v}": t for v, t in committed_at.items()}
+    verdict.update(analyze_steps(step_series, markers_t))
+
+    verdict["chief_reseeds_seen"] = _fired_in(
+        procs.get("chief0"), "event=chief_reseed"
+    )
+    verdict["reshard_commits_seen"] = _fired_in(
+        procs.get("chief0"), "event=reshard_committed"
+    )
+    verdict["kill_fired"] = _fired_in(
+        procs.get("worker1"), "event=inject_die"
+    )
+    gates = {
+        "zero_failed_predicts": load["predict_failed"] == 0,
+        "p99_under_bound": 0.0 < load["p99_ms"] <= args.p99_bound_ms,
+        "qps_at_target": verdict["qps_achieved"] >= 0.6 * args.qps,
+        "step_monotone": verdict["step_monotone"],
+        "step_advanced": verdict["step_advanced"],
+        "step_advanced_post_chaos": verdict["step_advanced_post_chaos"],
+        "zero_reseeds": not verdict["chief_reseeds_seen"],
+        "both_transitions_committed": len(committed_at) == 2,
+        "transitions_bounded": bool(verdict["transition_s"]) and all(
+            t <= args.reshard_bound_s for t in verdict["transition_s"].values()
+        ),
+        "epochs_all_seen": {1, 2, 3} <= epochs_seen,
+        "dtxtop_probe_exit0": cli_probe.get("exit") == 0,
+        "dtxtop_probe_final_epoch": cli_probe.get("committed") == 3,
+        "old_ps_drained_exit0": bool(verdict["old_ps_exit"]) and all(
+            rc == 0 for rc in verdict["old_ps_exit"].values()
+        ),
+    }
+    if not args.no_chaos:
+        gates["kill_fired"] = verdict["kill_fired"]
+    verdict["gates"] = gates
+    verdict["slo_pass"] = all(gates.values())
+    verdict["loadsim_p99_ms"] = load["p99_ms"]
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(verdict, f, indent=2)
+    print(json.dumps(verdict))
+    return 0 if verdict["slo_pass"] else 1
+
+
+def _fired_in(p, needle: str) -> bool:
+    path = getattr(p, "_dtx_log", "") if p is not None else ""
+    try:
+        with open(path, "rb") as f:
+            return needle.encode() in f.read()
+    except OSError:
+        return False
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--qps", type=float, default=25.0)
@@ -276,6 +561,16 @@ def main(argv=None) -> int:
         "--boot_offset_s", type=float, default=15.0,
         help="expected boot window baked into the chaos after_s offsets",
     )
+    ap.add_argument(
+        "--scenario", choices=("chaos", "reshard"), default="chaos",
+        help="chaos = the r14 kill/join/leave cycle; reshard = the r15 "
+        "live N->N+1->N PS resizing under load (one worker kill)",
+    )
+    ap.add_argument(
+        "--reshard_bound_s", type=float, default=30.0,
+        help="reshard scenario: max wall-time per epoch transition "
+        "(joiner spawn -> commit observed)",
+    )
     ap.add_argument("--no_chaos", action="store_true")
     ap.add_argument("--out", default="", help="write the verdict JSON here")
     ap.add_argument(
@@ -285,6 +580,11 @@ def main(argv=None) -> int:
         "--example", default=os.path.join(ROOT, "examples", "mnist_mlp.py"),
     )
     args = ap.parse_args(argv)
+
+    if args.scenario == "reshard":
+        if args.ps_shards < 2:
+            args.ps_shards = 2  # the acceptance resizes 2->3->2
+        return run_reshard(args)
 
     from distributed_tensorflow_examples_tpu.parallel import membership
     from distributed_tensorflow_examples_tpu.utils import faults

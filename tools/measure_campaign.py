@@ -177,6 +177,16 @@ def steps_plan() -> list[dict]:
              cmd=[PY, "tools/loadsim.py", "--qps", "25", "--duration_s",
                   "30", "--p99_bound_ms", "400"],
              timeout=900, cpu_ok=True),
+        # Live PS resharding acceptance (r15): resize the PS tier 2→3→2
+        # shards mid-run under closed-loop predict load with one worker
+        # kill — zero reseeds, zero failed predicts, monotone step, both
+        # epoch transitions bounded and dtxtop-visible.  JAX-on-CPU, so
+        # cpu_ok; verdict gated against tools/loadsim_reshard_baseline.json
+        # by perf_gate (metric loadsim_reshard_slo).
+        dict(name="loadsim_reshard",
+             cmd=[PY, "tools/loadsim.py", "--scenario", "reshard", "--qps",
+                  "25", "--duration_s", "45", "--p99_bound_ms", "400"],
+             timeout=900, cpu_ok=True),
     ]
     return plan
 

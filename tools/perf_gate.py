@@ -65,6 +65,13 @@ BASELINES = {
     "data_service_stream_mbs": "data_service_baseline.json",
     "serving_qps": "serving_baseline.json",
     "loadsim_slo": "loadsim_baseline.json",
+    # r15 live-resharding acceptance (tools/loadsim.py --scenario=reshard):
+    # same binary slo_pass discipline as loadsim_slo — the reshard_slo
+    # gate set (zero failed predicts, zero reseeds, both transitions
+    # committed inside the wall-time bound, retired tasks drained exit 0,
+    # every epoch visible to dtxtop) must hold, and a gate present in the
+    # baseline must still be computed by the result.
+    "loadsim_reshard_slo": "loadsim_reshard_baseline.json",
 }
 
 
