@@ -91,6 +91,12 @@ DSVC_STATS = wire.DSVC_OPS["STATS"]
 DSVC_GET_EVAL = wire.DSVC_OPS["GET_EVAL"]
 DSVC_SHUTDOWN = wire.DSVC_OPS["SHUTDOWN"]
 
+#: Ops excluded from the request counter — derived from the one
+#: control-plane registry (wire.CONTROL_OPS; dtxlint pins this site).
+_DSVC_CONTROL_OPS = frozenset(
+    wire.DSVC_OPS[n] for n in wire.CONTROL_OPS["dsvc"]
+)
+
 #: HELLO answer payload: the service tag a client must verify (one shared
 #: registry in parallel/wire.py — r10).
 SERVICE_TAG = wire.SERVICE_TAGS["dsvc"]
@@ -518,13 +524,11 @@ class DataServiceServer:
                         view = memoryview(sink)[: min(left, len(sink))]
                         wire.recv_exact(conn, view)
                         left -= len(view)
-                # Handshake/observability ops — and the scraper's
-                # metadata-only REGISTER probe (negative worker id) — are
-                # excluded (r13): ``request_count`` is the die:after_reqs
-                # fault trigger, and a dtxtop poll loop (HELLO + REGISTER
-                # probe + STATS per refresh) must not perturb when a
-                # chaos run's injected kills fire.
-                counted = op not in (DSVC_HELLO, DSVC_STATS) and not (
+                # Control-plane ops (wire.CONTROL_OPS) never count toward
+                # ``request_count``; nor does the scraper's metadata-only
+                # REGISTER probe (negative worker id — an op-level rule
+                # cannot carry it, so it stays spelled out here).
+                counted = op not in _DSVC_CONTROL_OPS and not (
                     op == DSVC_REGISTER and a < 0
                 )
                 if counted:
@@ -1212,12 +1216,18 @@ def host_data_service_task(
     )
     supervised = os.environ.get("DTX_DSVC_SUPERVISED") == "1"
     ppid0 = os.getppid()
-    while not server.shutdown_requested.wait(timeout=2.0):
-        if supervised and os.getppid() != ppid0:
-            log.warning("data service task: supervisor died; exiting")
-            break
-    bound = server.port
-    if watcher is not None:
-        watcher.close()
-    server.stop()
+    try:
+        while not server.shutdown_requested.wait(timeout=2.0):
+            if supervised and os.getppid() != ppid0:
+                log.warning("data service task: supervisor died; exiting")
+                break
+        bound = server.port
+    finally:
+        # Every exit — shutdown, supervisor death, or an exception out of
+        # the wait loop — stops the watcher's poll thread and client: a
+        # leaked watcher keeps dialing the PS forever (the r14 leaked-
+        # heartbeat bug class; dtxlint's lifecycle pass pins this shape).
+        if watcher is not None:
+            watcher.close()
+        server.stop()
     return bound

@@ -28,9 +28,16 @@ def _load() -> ctypes.CDLL:
     with _build_lock:
         if _lib is not None:
             return _lib
+        # DTX_NATIVE_LIB selects a prebuilt alternative library (the TSAN
+        # gate points it at libdtx_native_tsan.so under an LD_PRELOADed
+        # libtsan); the caller owns building it — no freshness check.
+        override = os.environ.get("DTX_NATIVE_LIB", "")
+        lib_path = override or _LIB_PATH
         sources = ("accumulator.cc", "dataloader.cc", "ps_server.cc")
-        if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < max(
-            os.path.getmtime(os.path.join(_DIR, s)) for s in sources
+        if not override and (
+            not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < max(
+                os.path.getmtime(os.path.join(_DIR, s)) for s in sources
+            )
         ):
             proc = subprocess.run(
                 ["make", "-s"], cwd=_DIR, capture_output=True, text=True
@@ -40,7 +47,7 @@ def _load() -> ctypes.CDLL:
                     f"native build failed (exit {proc.returncode}):\n"
                     f"{proc.stdout}\n{proc.stderr}"
                 )
-        lib = ctypes.CDLL(_LIB_PATH)
+        lib = ctypes.CDLL(lib_path)
         lib.acc_new.restype = ctypes.c_void_p
         lib.acc_new.argtypes = [ctypes.c_int64]
         lib.acc_free.argtypes = [ctypes.c_void_p]

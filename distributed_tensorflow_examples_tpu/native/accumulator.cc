@@ -31,6 +31,25 @@
 
 namespace {
 
+// Timed condvar waits go through a SYSTEM_CLOCK wait_until, not wait_for:
+// libstdc++'s wait_for lowers to pthread_cond_clockwait(CLOCK_MONOTONIC),
+// which older ThreadSanitizer runtimes (gcc 10's libtsan) do not
+// intercept — the sanitizer then never sees the mutex release inside the
+// wait, and the TSAN gate (tools/tsan_step.py) drowns every blocking op
+// in false double-lock/race reports.  pthread_cond_timedwait (the
+// system_clock path) is intercepted everywhere.  These waits are short
+// re-issued chunks (the client re-polls on -3), so a wall-clock jump
+// merely stretches or clips ONE chunk — never correctness.
+template <typename Pred>
+bool timed_wait(std::condition_variable& cv,
+                std::unique_lock<std::mutex>& lock, int64_t timeout_ms,
+                Pred pred) {
+  return cv.wait_until(lock,
+                       std::chrono::system_clock::now() +
+                           std::chrono::milliseconds(timeout_ms),
+                       pred);
+}
+
 // Tagged-op dedup (fault recovery): a client that loses its connection
 // mid-op replays the op after reconnecting; a per-worker monotone sequence
 // number makes the replay idempotent — the server records the highest seq
@@ -254,8 +273,7 @@ int64_t acc_take_timed(void* h, int64_t num_required, int64_t timeout_ms,
   auto ready = [&] { return a->cancelled || a->count >= num_required; };
   if (timeout_ms <= 0) {
     a->cv.wait(lock, ready);
-  } else if (!a->cv.wait_for(lock, std::chrono::milliseconds(timeout_ms),
-                             ready)) {
+  } else if (!timed_wait(a->cv, lock, timeout_ms, ready)) {
     return -3;
   }
   if (a->cancelled) return -1;
@@ -324,8 +342,7 @@ int64_t tq_pop_timed(void* h, int64_t timeout_ms) {
   auto ready = [&] { return q->cancelled || !q->tokens.empty(); };
   if (timeout_ms <= 0) {
     q->cv.wait(lock, ready);
-  } else if (!q->cv.wait_for(lock, std::chrono::milliseconds(timeout_ms),
-                             ready)) {
+  } else if (!timed_wait(q->cv, lock, timeout_ms, ready)) {
     return -3;
   }
   if (q->cancelled && q->tokens.empty()) return -1;
@@ -398,8 +415,7 @@ int gq_push_tagged(void* h, int64_t local_step, int64_t worker, int64_t seq,
   auto ready = [&] { return q->cancelled || q->q.size() < q->capacity; };
   if (timeout_ms <= 0) {
     q->cv_space.wait(lock, ready);
-  } else if (!q->cv_space.wait_for(lock, std::chrono::milliseconds(timeout_ms),
-                                   ready)) {
+  } else if (!timed_wait(q->cv_space, lock, timeout_ms, ready)) {
     return -3;
   }
   if (q->cancelled) return -1;
@@ -488,8 +504,7 @@ int64_t gq_pop_timed(void* h, int64_t timeout_ms, float* out) {
   auto ready = [&] { return q->cancelled || !q->q.empty(); };
   if (timeout_ms <= 0) {
     q->cv.wait(lock, ready);
-  } else if (!q->cv.wait_for(lock, std::chrono::milliseconds(timeout_ms),
-                             ready)) {
+  } else if (!timed_wait(q->cv, lock, timeout_ms, ready)) {
     return -3;
   }
   if (q->q.empty()) return -1;  // cancelled and drained

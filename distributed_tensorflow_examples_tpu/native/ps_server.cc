@@ -208,6 +208,24 @@ enum Op : uint8_t {
   RESHARD_ABORT = 37,
 };
 
+// Control-plane ops (r16): the C++ mirror of wire.CONTROL_OPS["ps"] — the
+// ops excluded from the request counter because they fire on connection
+// and poll cadence, not data-plane progress.  tools/dtxlint's control
+// pass parses THIS block (like the enum above) and pins it against the
+// Python registry both directions; grow wire.CONTROL_OPS first, then
+// mirror here.
+constexpr Op kControlOps[] = {
+    HELLO,          INCARNATION,    REPL_TOKEN,  STATS,
+    LEASE_ACQUIRE,  LEASE_RELEASE,  LEASE_LIST,
+    RESHARD_BEGIN,  RESHARD_COMMIT, RESHARD_GET, RESHARD_ABORT,
+};
+
+constexpr bool is_control_op(uint8_t op) {
+  for (Op c : kControlOps)
+    if (op == c) return true;
+  return false;
+}
+
 // v3 (r12): HELLO b-word field relayout — see wire.py WIRE_VERSION.
 constexpr int64_t kWireVersion = 3;
 
@@ -1087,37 +1105,10 @@ void serve_conn_impl(Server* s, int fd) {
     // ``payload_obj`` is reused by the dispatch below (one lookup, one
     // mutex acquisition per request on the gradient-push hot path).
     //
-    // Handshake/identity/observability ops are EXCLUDED from the request
-    // counter (r13): ``requests`` is the fault layer's deterministic
-    // "kill at request N" trigger AND an exported metric, and these four
-    // ops are functions of connection management and scrape cadence —
-    // every dtxtop refresh dials a fresh client (HELLO + INCARNATION +
-    // STATS), every reconnect probes identity — not of training
-    // progress.  Observation (and re-dialing) must not perturb the
-    // observed trigger; state/service traffic alone advances it.
-    // Lease ops (r14) are excluded for the same reason: heartbeats and
-    // membership scrapes fire on WALL-CLOCK cadence, not training
-    // progress, so counting them would make every ``after_reqs`` trigger
-    // drift with the heartbeat period.
-    switch (op) {
-      case HELLO:
-      case INCARNATION:
-      case REPL_TOKEN:
-      case STATS:
-      case LEASE_ACQUIRE:
-      case LEASE_RELEASE:
-      case LEASE_LIST:
-      // Reshard ops (r15) are poll-cadence control plane too: every
-      // client polls RESHARD_GET between steps, so counting it would
-      // make after_reqs triggers drift with the poll period.
-      case RESHARD_BEGIN:
-      case RESHARD_COMMIT:
-      case RESHARD_GET:
-      case RESHARD_ABORT:
-        break;
-      default:
-        s->requests.fetch_add(1, std::memory_order_relaxed);
-    }
+    // Control-plane ops never count: kControlOps (the pinned mirror of
+    // wire.CONTROL_OPS — see its comment for the why).
+    if (!is_control_op(op))
+      s->requests.fetch_add(1, std::memory_order_relaxed);
     // Partition (r12): an ALREADY-ESTABLISHED repl connection must go
     // dark too — every op on it is refused by policy, so the forwarding
     // side observes kReplRefused on its next mutate and latches

@@ -1282,100 +1282,107 @@ def host_ps_task(
                 desert_client = None
             return False
 
-    while True:
-        # Bounded pops keep this thread responsive (fault triggers, signal
-        # delivery) without consuming the shutdown contract below; 2 s
-        # keeps idle polling to a trickle so ``die:after_reqs`` triggers
-        # stay dominated by real coordination traffic.
-        token = tq.pop(timeout_s=2.0)
-        if token is ps_service.TIMED_OUT:
-            if supervised and os.getppid() != ppid0:
-                log.warning("PS task: supervisor died; exiting")
-                break
-            # Orphaned-replica exit (r12): a replicated task that restarts
-            # AFTER training ended can miss the chief's ps_shutdown push
-            # entirely (its clients failed over to the peer and never came
-            # back — training no longer stalls on a dead primary, so the
-            # run may finish before this incarnation is even up).  Detect
-            # the orphan state: the PEER is gone AND nobody but our own
-            # shutdown client is connected, for a sustained window — a
-            # peer merely crashing mid-run keeps the clients' connections
-            # here, so a serving replica can never match this.
-            if peer is not None and ps_service.server_live_conns(bound) <= 1:
-                try:
-                    import socket as _socket
+    try:
+        while True:
+            # Bounded pops keep this thread responsive (fault triggers, signal
+            # delivery) without consuming the shutdown contract below; 2 s
+            # keeps idle polling to a trickle so ``die:after_reqs`` triggers
+            # stay dominated by real coordination traffic.
+            token = tq.pop(timeout_s=2.0)
+            if token is ps_service.TIMED_OUT:
+                if supervised and os.getppid() != ppid0:
+                    log.warning("PS task: supervisor died; exiting")
+                    break
+                # Orphaned-replica exit (r12): a replicated task that restarts
+                # AFTER training ended can miss the chief's ps_shutdown push
+                # entirely (its clients failed over to the peer and never came
+                # back — training no longer stalls on a dead primary, so the
+                # run may finish before this incarnation is even up).  Detect
+                # the orphan state: the PEER is gone AND nobody but our own
+                # shutdown client is connected, for a sustained window — a
+                # peer merely crashing mid-run keeps the clients' connections
+                # here, so a serving replica can never match this.
+                if peer is not None and ps_service.server_live_conns(bound) <= 1:
+                    try:
+                        import socket as _socket
 
-                    probe = _socket.create_connection(peer, timeout=0.5)
-                    probe.close()
-                    orphan_polls = 0
-                    # Idle-PAIR exit (r15, the RUNBOOK 4e double-restart
-                    # corner): the peer is ALIVE — but if neither of us
-                    # has a client, the registry shows no live member of
-                    # any other role, and no pending reshard claims this
-                    # server, the run is over and BOTH replicas may exit
-                    # on their own.  The window is deliberately long
-                    # (~60 s of sustained evidence): a cluster merely
-                    # booting brings its chief/workers — and their leases
-                    # and connections — well inside it.
-                    if _cluster_deserted():
-                        desert_polls += 1
-                        if desert_polls >= 30:
+                        probe = _socket.create_connection(peer, timeout=0.5)
+                        probe.close()
+                        orphan_polls = 0
+                        # Idle-PAIR exit (r15, the RUNBOOK 4e double-restart
+                        # corner): the peer is ALIVE — but if neither of us
+                        # has a client, the registry shows no live member of
+                        # any other role, and no pending reshard claims this
+                        # server, the run is over and BOTH replicas may exit
+                        # on their own.  The window is deliberately long
+                        # (~60 s of sustained evidence): a cluster merely
+                        # booting brings its chief/workers — and their leases
+                        # and connections — well inside it.
+                        if _cluster_deserted():
+                            desert_polls += 1
+                            if desert_polls >= 30:
+                                log.warning(
+                                    "PS task: peer alive but no client, no "
+                                    "live member lease and no reshard claim "
+                                    "for ~%ds; idle replica pair exiting "
+                                    "(RUNBOOK 4e)", 2 * desert_polls,
+                                )
+                                break
+                        else:
+                            desert_polls = 0
+                    except OSError:
+                        desert_polls = 0
+                        orphan_polls += 1
+                        if orphan_polls >= 10:
                             log.warning(
-                                "PS task: peer alive but no client, no "
-                                "live member lease and no reshard claim "
-                                "for ~%ds; idle replica pair exiting "
-                                "(RUNBOOK 4e)", 2 * desert_polls,
+                                "PS task: peer gone and no clients for ~%ds; "
+                                "orphaned replica exiting", 2 * orphan_polls,
                             )
                             break
-                    else:
-                        desert_polls = 0
-                except OSError:
+                else:
+                    orphan_polls = 0
                     desert_polls = 0
-                    orphan_polls += 1
-                    if orphan_polls >= 10:
-                        log.warning(
-                            "PS task: peer gone and no clients for ~%ds; "
-                            "orphaned replica exiting", 2 * orphan_polls,
-                        )
-                        break
-            else:
-                orphan_polls = 0
-                desert_polls = 0
-            continue
-        if token is not None:
-            if token == 1:
-                # DRAIN shutdown (r15): a reshard retired this layout.
-                # Flag draining (visible in STATS/dtxtop), wait out the
-                # remaining client connections as they swap to the new
-                # epoch, then exit 0 like any clean shutdown.
-                if heartbeat is not None:
-                    heartbeat.close()
-                    heartbeat = None
-                ps_service.set_server_draining(bound, True)
-                faults.log_event("ps_draining", port=bound)
-                deadline = _time.monotonic() + drain_timeout_s
-                while _time.monotonic() < deadline and \
-                        ps_service.server_live_conns(bound) > 1:
-                    _time.sleep(0.2)
-                log.info(
-                    "PS task: drained (conns=%d); retired layout exiting",
-                    ps_service.server_live_conns(bound),
-                )
-            break
-        # cancel_all reaches this queue too (the chief cancels before its
-        # final counter reads); give the real shutdown push a grace window
-        # rather than tearing the service down under the chief.
-        cancelled += 1
-        if cancelled >= 10:
-            log.warning("PS task: repeated cancels without shutdown; exiting")
-            break
-        _time.sleep(0.5)
-    if desert_client is not None:
-        desert_client.close()
-    if heartbeat is not None:
-        heartbeat.close()
-    client.close()
-    ps_service.stop_server()
+                continue
+            if token is not None:
+                if token == 1:
+                    # DRAIN shutdown (r15): a reshard retired this layout.
+                    # Flag draining (visible in STATS/dtxtop), wait out the
+                    # remaining client connections as they swap to the new
+                    # epoch, then exit 0 like any clean shutdown.
+                    if heartbeat is not None:
+                        heartbeat.close()
+                        heartbeat = None
+                    ps_service.set_server_draining(bound, True)
+                    faults.log_event("ps_draining", port=bound)
+                    deadline = _time.monotonic() + drain_timeout_s
+                    while _time.monotonic() < deadline and \
+                            ps_service.server_live_conns(bound) > 1:
+                        _time.sleep(0.2)
+                    log.info(
+                        "PS task: drained (conns=%d); retired layout exiting",
+                        ps_service.server_live_conns(bound),
+                    )
+                break
+            # cancel_all reaches this queue too (the chief cancels before its
+            # final counter reads); give the real shutdown push a grace window
+            # rather than tearing the service down under the chief.
+            cancelled += 1
+            if cancelled >= 10:
+                log.warning("PS task: repeated cancels without shutdown; exiting")
+                break
+            _time.sleep(0.5)
+    finally:
+        # EVERY exit — clean shutdown, drain, orphan/idle-pair exit,
+        # or an exception out of the serve loop — releases the lease
+        # heartbeat and the clients: a leaked heartbeat advertises a
+        # dead PS task forever (the r14 leaked-worker-heartbeat bug
+        # class; dtxlint's lifecycle pass pins this shape).
+        if desert_client is not None:
+            desert_client.close()
+        if heartbeat is not None:
+            heartbeat.close()
+        client.close()
+        ps_service.stop_server()
     return bound
 
 

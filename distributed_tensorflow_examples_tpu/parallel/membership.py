@@ -32,7 +32,11 @@ posture as step tokens.
 Fault-plan role: membership connections run under ``<role>_lm`` so
 ``DTX_FAULT_PLAN`` specs can target the heartbeat/watcher legs without
 firing on a process's data-path clients (the ``_pf``/``_ds``/``_sv``
-convention; see tests/test_faults.py for the matrix run).
+convention; see tests/test_faults.py for the matrix run).  These clients
+opt INTO counting control ops as fault points
+(``control_ops_are_fault_points=True``): the lease stream is their whole
+logical traffic, whereas every other client skips control ops in its op
+index (wire.CONTROL_OPS) so plan indices track data-plane ops only.
 """
 
 from __future__ import annotations
@@ -217,6 +221,7 @@ class LeaseHeartbeat:
             addrs[0][0], addrs[0][1], op_timeout_s=op_timeout_s,
             reconnect_deadline_s=reconnect_deadline_s, role=self.role,
             addrs=list(addrs) if len(addrs) > 1 else None,
+            control_ops_are_fault_points=True,
         )
         try:
             self._client.lease_acquire(self.name, self.ttl_s)
@@ -257,6 +262,7 @@ class LeaseHeartbeat:
             reconnect_deadline_s=self._client._reconnect_deadline,
             role=self.role,
             addrs=list(addrs) if len(addrs) > 1 else None,
+            control_ops_are_fault_points=True,
         )
         try:
             new.lease_acquire(self.name, self.ttl_s)
@@ -362,6 +368,7 @@ class LeaseWatcher:
             reconnect_deadline_s=max(0.1, reconnect_deadline_s),
             role=self.role,
             addrs=list(addrs) if len(addrs) > 1 else None,
+            control_ops_are_fault_points=True,
         )
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="dtx-lease-watch"
@@ -390,6 +397,7 @@ class LeaseWatcher:
                 reconnect_deadline_s=self._reconnect_deadline_s,
                 role=self.role,
                 addrs=list(addrs) if len(addrs) > 1 else None,
+                control_ops_are_fault_points=True,
             )
         except (ps_service.PSError, OSError):
             return  # new coordinator not dialable yet: retry next poll

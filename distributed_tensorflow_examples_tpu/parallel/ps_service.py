@@ -366,6 +366,7 @@ class PSClient:
         expect_shard: tuple[int, int] | None = None,
         expect_layout: int = 0,
         addrs: list[tuple[str, int]] | None = None,
+        control_ops_are_fault_points: bool = False,
     ):
         if wire_dtype not in WIRE_DTYPES:
             raise ValueError(
@@ -397,6 +398,12 @@ class PSClient:
         # Per-REPLICA injectors (the backup leg is its own fault role,
         # ``<role>_b``, with its own logical-op counter) — created lazily
         # so single-address clients keep the zero-cost no-faults path.
+        # ``control_ops_are_fault_points``: a DEDICATED control client
+        # (the ``_lm`` membership legs) counts its lease/control ops in
+        # the fault op index — that stream IS its logical traffic; every
+        # other client skips control ops (faults.control_op_codes) so
+        # plan indices never drift with scrape/heartbeat/epoch cadence.
+        self._control_fault_points = control_ops_are_fault_points
         self._injectors: dict[int, faults.ClientFaultInjector | None] = {}
         self._injector = self._leg_injector(0)
         self._sock: socket.socket | None = None
@@ -440,7 +447,9 @@ class PSClient:
         failover leg without firing on the healthy one."""
         if idx not in self._injectors:
             leg_role = self.role if idx == 0 else f"{self.role}_b"
-            self._injectors[idx] = faults.client_injector(leg_role)
+            self._injectors[idx] = faults.client_injector(
+                leg_role, count_control_ops=self._control_fault_points,
+            )
         return self._injectors[idx]
 
     def _switch_replica(self, idx: int) -> None:
@@ -861,7 +870,10 @@ class PSClient:
         bounded wait is never mistaken for a dead peer.  ``fault_point``:
         whether this call advances the fault-injection op counter — the
         chunked re-issues of one logical blocking op pass False so plan
-        indices count LOGICAL ops, not timing-dependent chunks.  ``out``:
+        indices count LOGICAL ops, not timing-dependent chunks.
+        (Control-plane ops are additionally skipped INSIDE the injector,
+        from wire.CONTROL_OPS via faults.control_op_codes — no call site
+        restates that set.)  ``out``:
         optional preallocated response destination (see ``_attempt``).
         ``raw_payload``: the payload is an UN-encoded byte blob already
         framed as 4-byte units (the RESHARD_BEGIN record shape) — sent
@@ -1030,7 +1042,7 @@ class PSClient:
         record; refused for a version not above the committed one."""
         padded = blob + b" " * (-len(blob) % 4)
         status, _ = self.call(
-            _RESHARD_BEGIN, "", version, raw_payload=True, fault_point=False,
+            _RESHARD_BEGIN, "", version, raw_payload=True,
             payload=np.frombuffer(padded, np.uint8).view(np.float32),
         )
         if status < 0:
@@ -1044,7 +1056,7 @@ class PSClient:
         """Promote the matching PENDING record to COMMITTED — the epoch
         flip every polling client converges to.  Idempotent when already
         committed at ``version``."""
-        status, _ = self.call(_RESHARD_COMMIT, "", version, fault_point=False)
+        status, _ = self.call(_RESHARD_COMMIT, "", version)
         if status < 0:
             raise PSError(
                 f"reshard commit v{version} rejected ({status}): no "
@@ -1055,7 +1067,7 @@ class PSClient:
     def reshard_abort(self, version: int) -> bool:
         """Clear a matching PENDING record (the loud mid-transition
         bail-out); True when one was cleared."""
-        status, _ = self.call(_RESHARD_ABORT, "", version, fault_point=False)
+        status, _ = self.call(_RESHARD_ABORT, "", version)
         if status < 0:
             raise PSError(f"reshard abort v{version} rejected ({status})")
         return status == 1
@@ -1071,7 +1083,6 @@ class PSClient:
         topology silently (resharding simply never fires)."""
         status, blob = self.call(
             _RESHARD_GET, "", have_version, 1 if pending else 0, raw=True,
-            fault_point=False,
         )
         if status < 0:
             return 0, b""

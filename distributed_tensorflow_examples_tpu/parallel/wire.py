@@ -210,6 +210,99 @@ SRV_STATUS: dict[str, int] = {
     "NO_MODEL": -8,  # replica up but no published snapshot yet (warming)
 }
 
+#: Control-plane ops per service (r16): the ONE definition of which ops
+#: are excluded from (a) every server's request counter and (b) the
+#: client-side fault-injection op index.  The request counter is the
+#: fault layer's deterministic ``die:after_reqs`` trigger and an exported
+#: metric; the fault op index is how ``DTX_FAULT_PLAN`` ``op=N`` specs
+#: address logical client ops.  Control ops fire on CONNECTION and
+#: WALL-CLOCK cadence (handshakes, identity probes, scrapes, heartbeats,
+#: epoch polls) — counting them would make both notions drift with dial
+#: and poll frequency instead of tracking data-plane progress.  Exclusion
+#: sites derive from this dict and NOTHING else: the C++ server's
+#: ``kControlOps`` block mirrors CONTROL_OPS["ps"] (pinned both
+#: directions by ``tools/dtxlint``'s control pass), the dsvc/msrv counter
+#: branches and ``utils/faults``' op-index accounting read it directly.
+#: REPL_SYNC is deliberately NOT here: a state transfer is real traffic
+#: (one per restart/join), not poll cadence, and it has always counted.
+CONTROL_OPS: dict[str, frozenset[str]] = {
+    "ps": frozenset({
+        "HELLO", "INCARNATION", "REPL_TOKEN", "STATS",
+        "LEASE_ACQUIRE", "LEASE_RELEASE", "LEASE_LIST",
+        "RESHARD_BEGIN", "RESHARD_COMMIT", "RESHARD_GET", "RESHARD_ABORT",
+    }),
+    "dsvc": frozenset({"HELLO", "STATS"}),
+    "msrv": frozenset({"HELLO", "STATS"}),
+}
+
+#: Protocol state machines (r16): the legal op orderings each wire's
+#: conversation must respect, declared as pure DATA (dict/list/str
+#: literals only) so ``tools/dtxlint``'s protocol pass can both validate
+#: the machines (every op real, every state reachable, every transition
+#: exercised by some call-site) and lint client call-sites against them.
+#: ``aliases`` name the wrapper callables that stand for an op at a
+#: call-site (``client.reshard_commit(...)`` IS a RESHARD_COMMIT).
+WIRE_PROTOCOLS: dict[str, dict] = {
+    # Tagged services: HELLO is the FIRST op on every fresh connection —
+    # nothing the peer could misparse may precede the version/service
+    # negotiation.  (The native PS accepts HELLO-less f32 connections by
+    # design, so "ps" is exempt.)
+    "hello-first": {
+        "kind": "first_op",
+        "services": ["dsvc", "msrv"],
+        "op": "HELLO",
+    },
+    # A reshard transition BEGINs once and then COMMITs or ABORTs — no
+    # second BEGIN at the same version, no commit without a pending
+    # record.  "pending" self-loops are deliberately absent: a re-BEGIN
+    # inside one code block is the half-applied-transition bug class.
+    "reshard-transition": {
+        "kind": "session",
+        "service": "ps",
+        "init": "idle",
+        "transitions": {
+            "idle": {"RESHARD_BEGIN": "pending"},
+            "pending": {"RESHARD_COMMIT": "idle", "RESHARD_ABORT": "idle"},
+        },
+        "aliases": {
+            "RESHARD_BEGIN": ["reshard_announce"],
+            "RESHARD_COMMIT": ["reshard_commit"],
+            "RESHARD_ABORT": ["reshard_abort"],
+        },
+    },
+    # A lease is ACQUIRED (or renewed) before it can be RELEASED.
+    "lease-lifecycle": {
+        "kind": "session",
+        "service": "ps",
+        "init": "released",
+        "transitions": {
+            "released": {"LEASE_ACQUIRE": "held"},
+            "held": {"LEASE_ACQUIRE": "held", "LEASE_RELEASE": "released"},
+        },
+        "aliases": {
+            "LEASE_ACQUIRE": ["lease_acquire"],
+            "LEASE_RELEASE": ["lease_release"],
+        },
+    },
+    # A layout-epoch joiner assembles its slice from the old tier (ranged
+    # REPL_SYNC) BEFORE announcing the pending transition record: a
+    # record whose announcer has not synced could be committed against an
+    # unassembled shard.
+    "sync-before-announce": {
+        "kind": "order",
+        "service": "ps",
+        "first": "REPL_SYNC",
+        "then": "RESHARD_BEGIN",
+        "aliases": {
+            "REPL_SYNC": [
+                "ranged_sync", "assemble_slice", "assemble_for_shard",
+                "install_assembled", "join_new_shard",
+            ],
+            "RESHARD_BEGIN": ["reshard_announce"],
+        },
+    },
+}
+
 #: The shared HELLO op code (one code point for every service, so one
 #: negotiation routine serves all three wires).
 HELLO_OP = PS_OPS["HELLO"]

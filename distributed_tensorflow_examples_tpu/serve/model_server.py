@@ -68,6 +68,12 @@ SRV_PREDICT = wire.SRV_OPS["PREDICT"]
 SRV_STATS = wire.SRV_OPS["STATS"]
 SRV_SHUTDOWN = wire.SRV_OPS["SHUTDOWN"]
 
+#: Ops excluded from the request counter — derived from the one
+#: control-plane registry (wire.CONTROL_OPS; dtxlint pins this site).
+_SRV_CONTROL_OPS = frozenset(
+    wire.SRV_OPS[n] for n in wire.CONTROL_OPS["msrv"]
+)
+
 # Response statuses (wire.SRV_STATUS aliases).  PREDICT success answers the
 # served model_step (>= 0) as the status — the per-response staleness stamp
 # costs zero extra bytes.
@@ -458,11 +464,9 @@ class ModelReplicaServer:
                 if req is None:
                     return
                 op, name, a, b, plen = req
-                # Handshake/observability ops are excluded (r13):
-                # ``request_count`` is the die:after_reqs fault trigger,
-                # and a dtxtop poll loop (HELLO + STATS per refresh) must
-                # not perturb when a chaos run's injected kills fire.
-                if op not in (SRV_HELLO, SRV_STATS):
+                # Control-plane ops (wire.CONTROL_OPS) never count toward
+                # ``request_count``.
+                if op not in _SRV_CONTROL_OPS:
                     with self._lock:
                         self._requests += 1
                 if op == SRV_PREDICT:

@@ -101,6 +101,32 @@ _KINDS = _CLIENT_KINDS + ("die", "leave", "join")
 _role_lock = threading.Lock()
 _role: str | None = None
 
+_control_codes: frozenset | None = None
+
+
+def control_op_codes() -> frozenset:
+    """Wire op CODES of every control-plane op, all three services —
+    derived from the one registry (``wire.CONTROL_OPS``; codes are
+    disjoint across services except the shared HELLO point, so one flat
+    set serves every wire's injector).  The client op index SKIPS these:
+    ``op=N`` plan indices address logical data-plane ops, and heartbeat/
+    scrape/epoch-poll cadence must never shift them (the r15 fault-index
+    drift, generalized).  Lazy import: wire is JAX-free, but resolving it
+    at module load would order utils before parallel in every importer."""
+    global _control_codes
+    if _control_codes is None:
+        from ..parallel import wire
+
+        registries = {
+            "ps": wire.PS_OPS, "dsvc": wire.DSVC_OPS, "msrv": wire.SRV_OPS,
+        }
+        _control_codes = frozenset(
+            registries[svc][name]
+            for svc, names in wire.CONTROL_OPS.items()
+            for name in names
+        )
+    return _control_codes
+
 
 @dataclasses.dataclass
 class FaultSpec:
@@ -242,9 +268,19 @@ def log_event(event: str, **fields) -> None:
 class ClientFaultInjector:
     """Per-``PSClient`` hook: consults the plan before every client op.
     Deterministic — the op counter is per client, and the probabilistic RNG
-    is seeded from (seed, role, kind)."""
+    is seeded from (seed, role, kind).
 
-    def __init__(self, role: str | None = None, plan: str | None = None):
+    Control-plane ops (:func:`control_op_codes`) neither advance the
+    counter nor fire faults, so a client that interleaves scrapes or
+    epoch polls with its data ops keeps stable plan indices.
+    ``count_control_ops=True`` is the opt-in for DEDICATED control
+    clients (the ``_lm`` membership legs): their lease stream IS their
+    logical op stream, and excluding it would leave them untargetable."""
+
+    def __init__(
+        self, role: str | None = None, plan: str | None = None,
+        count_control_ops: bool = False,
+    ):
         self.role = role if role is not None else current_role()
         raw = plan if plan is not None else active_plan()
         # Only a partition spec's CLIENT shape (an explicit op index)
@@ -259,6 +295,12 @@ class ClientFaultInjector:
         ]
         self._op = 0
         self._rngs: dict[int, "_DetRng"] = {}
+        # Resolved only when a plan is live: the no-faults hot path must
+        # not import the wire registry.
+        self._control: frozenset = (
+            frozenset() if (count_control_ops or not self._specs)
+            else control_op_codes()
+        )
 
     def _fires(self, i: int, spec: FaultSpec) -> bool:
         if spec.kind == "partition":
@@ -277,7 +319,7 @@ class ClientFaultInjector:
         """Advance the op counter; sleep for matching delays.  Returns True
         when a drop_conn/partition fault fires (the caller must sever its
         socket)."""
-        if not self._specs:
+        if not self._specs or op_code in self._control:
             return False
         self._op += 1
         drop = False
@@ -325,11 +367,14 @@ class _DetRng:
         return ((x * 0x2545F4914F6CDD1D) & 0xFFFFFFFFFFFFFFFF) / 2**64
 
 
-def client_injector(role: str | None = None) -> ClientFaultInjector | None:
+def client_injector(
+    role: str | None = None, *, count_control_ops: bool = False,
+) -> ClientFaultInjector | None:
     """A ``ClientFaultInjector`` for this process, or None when the plan has
     no client faults for the role (keeps the no-faults hot path at zero
-    cost: one None check per op)."""
-    inj = ClientFaultInjector(role=role)
+    cost: one None check per op).  ``count_control_ops``: see
+    :class:`ClientFaultInjector` — dedicated control clients only."""
+    inj = ClientFaultInjector(role=role, count_control_ops=count_control_ops)
     return inj if inj._specs else None
 
 

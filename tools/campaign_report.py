@@ -80,6 +80,19 @@ def fmt_transport(rec: dict, ok: str) -> str:
     return "\n".join(lines)
 
 
+def _dtxlint_budget():
+    """The checked-in lint wall-time budget (perf_gate's bound), for the
+    report line — '?' when the baseline is unreadable."""
+    try:
+        with open(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "dtxlint_time_baseline.json",
+        )) as f:
+            return json.load(f).get("budget_s", "?")
+    except (OSError, json.JSONDecodeError):
+        return "?"
+
+
 def fmt_dtxlint(rec: dict, ok: str) -> str:
     """Static-analysis step (r11): clean/dirty verdict plus the offending
     finding keys — a drifted wire invariant must be readable from the
@@ -93,12 +106,42 @@ def fmt_dtxlint(rec: dict, ok: str) -> str:
         f"{counts.get('active', '?')} active, "
         f"{counts.get('suppressed', '?')} suppressed, "
         f"{counts.get('stale_suppressions', '?')} stale "
-        f"(schema v{j.get('schema_version')}; {rec['seconds']}s wall)"
+        f"(schema v{j.get('schema_version')}; lint {j.get('seconds', '?')}s "
+        f"of budget {_dtxlint_budget()}s; {rec['seconds']}s wall)"
     ]
     for f in j.get("findings", []):
         lines.append(f"    - {f.get('key')}: {f.get('message')}")
     for key in j.get("stale_suppressions", []):
         lines.append(f"    - stale suppression: {key}")
+    return "\n".join(lines)
+
+
+def fmt_tsan(rec: dict, ok: str) -> str:
+    """Native ThreadSanitizer gate (r16): races / clean / skipped, the
+    driver's throughput line, and the live suppression count — a growing
+    suppression pile must be visible in every report."""
+    j = rec.get("json") or {}
+    if not j:
+        return f"- `tsan_protocol` [{ok}]: NO JSON ({rec['seconds']}s)"
+    if j.get("skipped"):
+        return (
+            f"- `tsan_protocol` [{ok}]: SKIPPED — {j['skipped']} "
+            f"({rec['seconds']}s)"
+        )
+    if j.get("error"):
+        return (
+            f"- `tsan_protocol` [{ok}]: ERROR — {j['error']} "
+            f"({rec['seconds']}s)"
+        )
+    lines = [
+        f"- `tsan_protocol` [{ok}]: "
+        f"{'clean' if j.get('ok') else 'RACES'} — {j.get('warnings')} "
+        f"warning(s), {j.get('suppressions')} suppression(s), driver "
+        f"rc={j.get('driver_rc')} ({j.get('driver_line') or 'no driver line'}; "
+        f"{rec['seconds']}s wall)"
+    ]
+    for s in j.get("summaries", []):
+        lines.append(f"    - {s}")
     return "\n".join(lines)
 
 
@@ -170,6 +213,8 @@ def main():
             print(fmt_transport(rec, ok))
         elif name == "dtxlint":
             print(fmt_dtxlint(rec, ok))
+        elif name == "tsan_protocol":
+            print(fmt_tsan(rec, ok))
         elif name == "obs_snapshot":
             print(fmt_obs(rec, ok))
         elif name == "loadsim":

@@ -10,7 +10,7 @@ package machine-checks those invariants so the unified-runtime and
 replication refactors (ROADMAP items 1–2) can move fast without silently
 breaking the wire.
 
-Four passes (each a module exposing ``run(cfg) -> list[Finding]``):
+Seven passes (each a module exposing ``run(cfg) -> list[Finding]``):
 
 - ``wire_conformance`` — extracts the protocol registries from
   ``parallel/wire.py`` (Python AST) and the ``enum Op`` / ``constexpr`` /
@@ -19,10 +19,23 @@ Four passes (each a module exposing ``run(cfg) -> list[Finding]``):
   every client-sent op has a server dispatch case, every server status is
   handled (or allowlisted) client-side, and no service module restates a
   protocol number outside ``wire.py``.
+- ``control_plane`` (r16) — ``wire.CONTROL_OPS`` is the one definition of
+  which ops are control plane (excluded from request counters and fault
+  op indices); every exclusion site — the C++ ``kControlOps`` block, the
+  dsvc/msrv counter branches, the client fault-index accounting — is
+  pinned against it BOTH directions, and literal restatements are refused.
+- ``protocol`` (r16) — ``wire.WIRE_PROTOCOLS`` declares legal op orderings
+  (HELLO-first on tagged services, RESHARD BEGIN->{COMMIT|ABORT},
+  LEASE ACQUIRE-before-RELEASE, sync-before-announce) as data; the pass
+  validates the machines and lints client call-sites against them.
 - ``concurrency`` — AST lint over the ``serve/``, ``parallel/`` and
   ``data/`` packages: blocking calls made while lexically holding a lock,
   ``.acquire()`` outside ``with``/try-finally, and inconsistent pairwise
   lock-acquisition order.
+- ``lifecycle`` (r16) — constructed resources (clients, sockets, lease
+  heartbeats/watchers, threads) must reach close/release/stop/join on all
+  exit paths or visibly transfer ownership — the generalization of the
+  r14 leaked-heartbeat review fix.
 - ``fault_coverage`` — every client-role suffix constructed in source
   (``_pf``, ``_ds``, ``_sv``, ``_s<i>``) must appear in the
   ``tests/test_faults.py`` matrix, and every ``DTX_FAULT_PLAN`` spec kind
@@ -32,7 +45,8 @@ Four passes (each a module exposing ``run(cfg) -> list[Finding]``):
   referenced anywhere.
 
 CLI: ``python -m tools.dtxlint [--json] [--baseline FILE] [--root DIR]
-[--pass NAME]``.  Exit 0 iff no non-suppressed findings.  The baseline
+[--pass NAME] [--changed [--base REF]]``.  Exit 0 iff no non-suppressed
+findings.  The baseline
 file (``tools/dtxlint_baseline.json``) carries DELIBERATE suppressions,
 each keyed by the finding's stable key and carrying a justification —
 an empty/justified baseline is the acceptance bar, not a dumping ground.
@@ -48,7 +62,10 @@ from pathlib import Path
 #: --json schema version (tests pin it).
 JSON_SCHEMA_VERSION = 1
 
-PASS_NAMES = ("wire", "concurrency", "fault_coverage", "flag_drift")
+PASS_NAMES = (
+    "wire", "control", "protocol", "concurrency", "lifecycle",
+    "fault_coverage", "flag_drift",
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,6 +125,10 @@ class LintConfig:
     flags_py: Path
     runbook_md: Path
     flag_reference_dirs: list[Path]
+    # protocol + lifecycle (r16).  None -> resolved from the fields above
+    # in run_passes, so pre-r16 LintConfig call sites keep working.
+    protocol_dirs: list[Path] | None = None
+    lifecycle_dirs: list[Path] | None = None
 
     @classmethod
     def default(cls, root: str | os.PathLike) -> "LintConfig":
@@ -140,6 +161,10 @@ class LintConfig:
             flag_reference_dirs=[
                 pkg, root / "examples", root / "tools", root / "tests",
             ],
+            protocol_dirs=[
+                pkg / "parallel", pkg / "serve", pkg / "data", pkg / "train",
+            ],
+            lifecycle_dirs=[pkg / "serve", pkg / "parallel", pkg / "data"],
         )
 
     def rel(self, path: Path) -> str:
@@ -182,15 +207,102 @@ def load_baseline(path: str | os.PathLike | None) -> dict[str, str]:
     return out
 
 
-def run_passes(
-    cfg: LintConfig, only: str | None = None
-) -> dict[str, list[Finding]]:
-    """Run the requested passes; returns ``{pass name: findings}``."""
-    from . import concurrency, fault_coverage, flag_drift, wire_conformance
+def _resolve(cfg: LintConfig) -> LintConfig:
+    """Fill the r16 fields for pre-r16 call sites (test fixtures that
+    built a LintConfig before protocol/lifecycle existed)."""
+    if cfg.protocol_dirs is None:
+        seen: dict[Path, None] = {}
+        for p in (cfg.ps_service_py, cfg.dsvc_py, cfg.msrv_py,
+                  cfg.serve_client_py):
+            seen.setdefault(Path(p).parent)
+        cfg.protocol_dirs = list(seen)
+    if cfg.lifecycle_dirs is None:
+        cfg.lifecycle_dirs = list(cfg.concurrency_dirs)
+    return cfg
 
+
+#: Per-file passes: under ``--changed`` their corpus shrinks to the
+#: changed files; every other pass is cross-file and runs in full
+#: whenever any of its inputs changed.  Concurrency is NOT here despite
+#: being mostly per-file: its lock-order-inversion check compares
+#: acquisition orders ACROSS files, so a shrunk corpus would miss an
+#: inversion between a changed file and an unchanged one.
+PER_FILE_PASSES = ("lifecycle",)
+
+
+def pass_inputs(cfg: LintConfig) -> dict[str, list[Path]]:
+    """Each pass's input files/dirs — what ``--changed`` intersects the
+    git diff against to decide whether a cross-file pass must run."""
+    cfg = _resolve(cfg)
+    return {
+        "wire": [
+            cfg.wire_py, cfg.ps_server_cc, cfg.native_init_py,
+            cfg.ps_service_py, *cfg.service_files, cfg.dsvc_py, cfg.msrv_py,
+            cfg.serve_client_py,
+        ],
+        "control": [
+            cfg.wire_py, cfg.ps_server_cc, cfg.ps_service_py, cfg.dsvc_py,
+            cfg.msrv_py, cfg.faults_py, *cfg.service_files,
+        ],
+        "protocol": [
+            cfg.wire_py, cfg.dsvc_py, cfg.msrv_py, cfg.ps_service_py,
+            cfg.serve_client_py, *cfg.protocol_dirs,
+        ],
+        "concurrency": list(cfg.concurrency_dirs),
+        "lifecycle": list(cfg.lifecycle_dirs),
+        "fault_coverage": [
+            cfg.faults_py, *cfg.role_source_dirs, *cfg.fault_test_files,
+        ],
+        "flag_drift": [
+            cfg.flags_py, cfg.runbook_md, *cfg.flag_reference_dirs,
+        ],
+    }
+
+
+def _touches(changed: list[Path], inputs: list[Path]) -> list[Path]:
+    """The changed files that fall on any input file or under any input
+    dir."""
+    hits: list[Path] = []
+    for c in changed:
+        for inp in inputs:
+            if c == inp:
+                hits.append(c)
+                break
+            try:
+                c.relative_to(inp)
+            except ValueError:
+                continue
+            hits.append(c)
+            break
+    return hits
+
+
+def run_passes(
+    cfg: LintConfig, only: str | None = None,
+    changed: list[Path] | None = None,
+) -> dict[str, list[Finding]]:
+    """Run the requested passes; returns ``{pass name: findings}``.
+
+    ``changed`` (the ``--changed`` fast path) restricts the run to what a
+    diff could have broken: cross-file passes (concurrency included — its
+    lock-order check spans files) run in full iff any of their inputs is
+    in the changed set; per-file passes lint only the changed files.
+    Output parity: on files it does lint, a --changed run reports exactly
+    what the full run would (pinned by tests)."""
+    import dataclasses as _dc
+
+    from . import (  # noqa: F401
+        concurrency, control_plane, fault_coverage, flag_drift, lifecycle,
+        protocol, wire_conformance,
+    )
+
+    cfg = _resolve(cfg)
     passes = {
         "wire": wire_conformance.run,
+        "control": control_plane.run,
+        "protocol": protocol.run,
         "concurrency": concurrency.run,
+        "lifecycle": lifecycle.run,
         "fault_coverage": fault_coverage.run,
         "flag_drift": flag_drift.run,
     }
@@ -198,7 +310,22 @@ def run_passes(
         if only not in passes:
             raise ValueError(f"unknown pass {only!r} (have {sorted(passes)})")
         passes = {only: passes[only]}
-    return {name: fn(cfg) for name, fn in passes.items()}
+    if changed is None:
+        return {name: fn(cfg) for name, fn in passes.items()}
+    changed = [Path(c).resolve() for c in changed]
+    inputs = pass_inputs(cfg)
+    results: dict[str, list[Finding]] = {}
+    for name, fn in passes.items():
+        hits = _touches(changed, [Path(p).resolve() for p in inputs[name]])
+        if not hits:
+            continue  # nothing this pass reads changed
+        if name in PER_FILE_PASSES:
+            sub = _dc.replace(cfg)
+            sub.lifecycle_dirs = hits
+            results[name] = fn(sub)
+        else:
+            results[name] = fn(cfg)
+    return results
 
 
 def apply_baseline(

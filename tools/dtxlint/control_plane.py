@@ -1,0 +1,256 @@
+"""Pass: control-plane registry conformance (r16).
+
+``wire.CONTROL_OPS`` is the ONE definition of which ops are CONTROL
+PLANE: excluded from every server's request counter (the fault layer's
+deterministic ``die:after_reqs`` trigger and an exported metric) and from
+the client-side fault-injection op index (plan ``op=N`` indices must
+count LOGICAL data-plane ops, not poll/heartbeat cadence).  Before this
+registry the rule lived in four hand-maintained restatements — the C++
+counter-exclusion switch in ``native/ps_server.cc``, tuple literals in
+``data/data_service.py`` and ``serve/model_server.py``, and per-call-site
+``fault_point=False`` arguments — and each drifted at least once (the
+r14 leaked-heartbeat review, the r15 fault-index review).  This pass pins
+every exclusion site against the registry, BOTH directions:
+
+- ``control-registry-missing``  CONTROL_OPS absent from wire.py (or not a
+                                parseable dict of string-sets).
+- ``control-unknown-op``        CONTROL_OPS names an op its service's op
+                                registry does not define.
+- ``control-cpp-block-missing`` no parseable ``constexpr Op kControlOps[]``
+                                block in ps_server.cc (the pinned C++
+                                mirror the lint reads like the enum).
+- ``control-cpp-missing-op``    an op in CONTROL_OPS["ps"] absent from the
+                                C++ kControlOps block.
+- ``control-cpp-extra-op``      a kControlOps entry absent from
+                                CONTROL_OPS["ps"] (C++ excluding an op
+                                Python still counts).
+- ``control-cpp-unwired``       ``is_control_op`` defined but never used:
+                                the block is decorative, the counter
+                                branch re-states the list elsewhere.
+- ``control-site-unwired``      an exclusion-site module (dsvc server,
+                                msrv server, PS client, faults) never
+                                references CONTROL_OPS — its exclusion
+                                set cannot be derived from the registry.
+- ``control-restated``          an ``op [not] in (NAME, ...)`` literal
+                                membership test against protocol-op names
+                                — the hand-maintained restatement the
+                                registry replaces.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from . import Finding, LintConfig
+from .wire_conformance import _DSVC_NAME, _PS_NAME, _SRV_NAME, module_int_dicts
+
+PASS = "control"
+
+#: Service key -> the wire.py op-registry dict its CONTROL_OPS names must
+#: resolve in.
+_SERVICE_REGISTRY = {"ps": "PS_OPS", "dsvc": "DSVC_OPS", "msrv": "SRV_OPS"}
+
+_CC_BLOCK_RE = re.compile(
+    r"constexpr\s+Op\s+kControlOps\s*\[\s*\]\s*=\s*\{(.*?)\};", re.S
+)
+_CC_NAME_RE = re.compile(r"\b([A-Z][A-Z0-9_]*)\b")
+
+
+def _str_elems(node: ast.expr) -> list[str] | None:
+    """The string elements of a set/frozenset/tuple/list literal (also via
+    a ``frozenset({...})`` / ``set((...))`` wrapping call), else None."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) and \
+            node.func.id in ("frozenset", "set") and len(node.args) == 1:
+        node = node.args[0]
+    if not isinstance(node, (ast.Set, ast.Tuple, ast.List)):
+        return None
+    out: list[str] = []
+    for e in node.elts:
+        if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+            return None
+        out.append(e.value)
+    return out
+
+
+def control_ops_registry(wire_py: Path) -> dict[str, list[str]] | None:
+    """``{service: [op names]}`` parsed from wire.CONTROL_OPS, or None."""
+    tree = ast.parse(wire_py.read_text())
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            tgt, val = node.targets[0], node.value
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            tgt, val = node.target, node.value
+        else:
+            continue
+        if tgt.id != "CONTROL_OPS" or not isinstance(val, ast.Dict):
+            continue
+        out: dict[str, list[str]] = {}
+        for k, v in zip(val.keys, val.values):
+            if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                return None
+            elems = _str_elems(v)
+            if elems is None:
+                return None
+            out[k.value] = elems
+        return out
+    return None
+
+
+def cc_control_ops(ps_server_cc: Path) -> tuple[list[str] | None, int]:
+    """``(names in the kControlOps block or None, is_control_op use
+    count)`` from the C++ server."""
+    text = ps_server_cc.read_text()
+    uses = len(re.findall(r"\bis_control_op\b", text))
+    m = _CC_BLOCK_RE.search(text)
+    if not m:
+        return None, uses
+    return _CC_NAME_RE.findall(m.group(1)), uses
+
+
+def references_control_ops(path: Path) -> bool:
+    """Whether the module mentions CONTROL_OPS anywhere (Name or
+    attribute) — the derivation-site wiring check."""
+    tree = ast.parse(path.read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id == "CONTROL_OPS":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "CONTROL_OPS":
+            return True
+    return False
+
+
+def _is_proto_name(name: str) -> bool:
+    return bool(
+        _PS_NAME.match(name) or _DSVC_NAME.match(name) or _SRV_NAME.match(name)
+    )
+
+
+def restated_membership_tests(path: Path) -> list[tuple[str, int]]:
+    """``(spelled-out tuple, line)`` for every ``op [not] in (NAME, ...)``
+    membership test whose elements are protocol-op NAMES — the literal
+    exclusion-set restatement the registry replaces.  String-literal
+    membership (e.g. HLO op-name tests) never matches."""
+    tree = ast.parse(path.read_text())
+    bad: list[tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+            continue
+        if not isinstance(node.ops[0], (ast.In, ast.NotIn)):
+            continue
+        left = node.left
+        lname = left.id if isinstance(left, ast.Name) else (
+            left.attr if isinstance(left, ast.Attribute) else ""
+        )
+        if lname != "op":
+            continue
+        cmp = node.comparators[0]
+        if not isinstance(cmp, (ast.Tuple, ast.Set, ast.List)):
+            continue
+        names = [
+            (e.id if isinstance(e, ast.Name) else e.attr)
+            for e in cmp.elts
+            if isinstance(e, (ast.Name, ast.Attribute))
+        ]
+        if names and any(_is_proto_name(n.lstrip("_")) or _is_proto_name(n)
+                         for n in names):
+            bad.append(("(" + ", ".join(names) + ")", node.lineno))
+    return bad
+
+
+def run(cfg: LintConfig) -> list[Finding]:
+    findings: list[Finding] = []
+    wire_rel = cfg.rel(cfg.wire_py)
+    cc_rel = cfg.rel(cfg.ps_server_cc)
+
+    registry = control_ops_registry(cfg.wire_py)
+    if registry is None:
+        findings.append(Finding(
+            PASS, "control-registry-missing", wire_rel, "CONTROL_OPS",
+            "wire.CONTROL_OPS not found as a dict of per-service string "
+            "sets — the control-plane op registry is the one definition "
+            "site every exclusion branch derives from",
+        ))
+        return findings
+
+    # -- every named op must exist in its service's op registry -----------
+    dicts = module_int_dicts(cfg.wire_py)
+    for svc, names in sorted(registry.items()):
+        reg_name = _SERVICE_REGISTRY.get(svc)
+        ops = dicts.get(reg_name or "", {})
+        if reg_name is None:
+            findings.append(Finding(
+                PASS, "control-unknown-op", wire_rel, svc,
+                f"CONTROL_OPS has unknown service key {svc!r} "
+                f"(expected one of {sorted(_SERVICE_REGISTRY)})",
+            ))
+            continue
+        for name in names:
+            if name not in ops:
+                findings.append(Finding(
+                    PASS, "control-unknown-op", wire_rel, f"{svc}.{name}",
+                    f"CONTROL_OPS[{svc!r}] names {name}, which {reg_name} "
+                    "does not define — a phantom exclusion",
+                ))
+
+    # -- C++ mirror, both directions --------------------------------------
+    cc_names, cc_uses = cc_control_ops(cfg.ps_server_cc)
+    ps_control = set(registry.get("ps", []))
+    if cc_names is None:
+        findings.append(Finding(
+            PASS, "control-cpp-block-missing", cc_rel, "kControlOps",
+            "no parseable `constexpr Op kControlOps[] = {...};` block in "
+            f"{cc_rel} — the C++ request-counter exclusion cannot be "
+            "pinned against wire.CONTROL_OPS",
+        ))
+    else:
+        for name in sorted(ps_control - set(cc_names)):
+            findings.append(Finding(
+                PASS, "control-cpp-missing-op", cc_rel, name,
+                f"CONTROL_OPS['ps'] excludes {name} but the C++ "
+                "kControlOps block does not — the native counter would "
+                "count it, drifting every after_reqs trigger",
+            ))
+        for name in sorted(set(cc_names) - ps_control):
+            findings.append(Finding(
+                PASS, "control-cpp-extra-op", cc_rel, name,
+                f"C++ kControlOps excludes {name} but CONTROL_OPS['ps'] "
+                "does not — the two sides disagree about what counts as "
+                "a request",
+            ))
+        if cc_uses < 2:
+            findings.append(Finding(
+                PASS, "control-cpp-unwired", cc_rel, "is_control_op",
+                "is_control_op is never used outside its definition — the "
+                "kControlOps block is decorative and the real counter "
+                "branch restates the list somewhere else",
+            ))
+
+    # -- Python exclusion sites must derive from the registry --------------
+    for path, what in (
+        (cfg.dsvc_py, "dsvc request-counter exclusion"),
+        (cfg.msrv_py, "msrv request-counter exclusion"),
+        (cfg.faults_py, "fault-injection op-index accounting"),
+    ):
+        if not references_control_ops(path):
+            findings.append(Finding(
+                PASS, "control-site-unwired", cfg.rel(path), what,
+                f"{cfg.rel(path)} never references wire.CONTROL_OPS — the "
+                f"{what} cannot be derived from the registry and will "
+                "drift on the next op family",
+            ))
+
+    # -- no literal restatement anywhere ----------------------------------
+    for path in [*cfg.service_files, cfg.faults_py]:
+        for spelled, line in restated_membership_tests(path):
+            findings.append(Finding(
+                PASS, "control-restated", cfg.rel(path), spelled,
+                f"op-membership test against the literal tuple {spelled} — "
+                "exclusion sets derive from wire.CONTROL_OPS only (bind a "
+                "module-level frozenset from the registry)",
+                line=line,
+            ))
+    return findings

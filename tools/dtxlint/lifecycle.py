@@ -1,0 +1,309 @@
+"""Pass: resource lifecycle (r16) — constructed resources reach a release
+on every exit path.
+
+The r14 review found ``remote_worker_loop`` leaking its membership
+heartbeat on exception exits (a daemon thread advertising a dead worker
+forever); the fix was a try/finally.  This pass generalizes that review
+into a machine check over ``parallel/``, ``serve/`` and ``data/``: every
+construction of a connection-holding / thread-owning resource must reach
+its release verb (``close``/``stop``/``release``/``join``...) on ALL
+exits, or visibly hand ownership to someone who will.
+
+Intraprocedural dataflow, tuned to the repo's idioms:
+
+- A LOCAL ``x = Ctor(...)`` must be (a) used as a context manager, (b)
+  released under a ``finally:``, or (c) ESCAPE — returned/yielded, passed
+  as a call argument (``pool.append(c)``, ``closing(c)``), stored into an
+  attribute/subscript, or aliased — ownership visibly moves and the new
+  owner is linted at its own site.
+- ``self._x = Ctor(...)`` makes the CLASS the owner: some method of the
+  class must both reference the attribute and call a release verb (the
+  ``close()``/``stop()`` teardown convention every service class here
+  follows).
+- ``threading.Thread(..., daemon=True)`` is exempt: fire-and-forget
+  daemon watchers are a documented idiom (faults timers, lease loops);
+  non-daemon threads must be joined.
+
+Finding codes:
+
+- ``resource-leaked``             constructed, never escapes, no release
+                                  call at all in the function.
+- ``resource-release-unguarded``  released only on the straight-line path
+                                  — an exception between construction and
+                                  release leaks it (the exact r14 bug).
+- ``resource-attr-unreleased``    a class-owned resource no method of the
+                                  class ever releases.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from . import Finding, LintConfig
+
+PASS = "lifecycle"
+
+#: Tracked constructors -> accepted release verbs.  A name matches the
+#: LAST component of the call (``threading.Thread``, ``socket.socket``,
+#: ``ps_service.PSClient``...).
+RESOURCES: dict[str, tuple[str, ...]] = {
+    "PSClient": ("close",),
+    "ShardedPSClients": ("close",),
+    "DataServiceClient": ("close",),
+    "RemoteDatasetSource": ("close", "stop"),
+    "ServeClient": ("close",),
+    "ServePool": ("close",),
+    "LeaseHeartbeat": ("close",),
+    "LeaseWatcher": ("stop", "close"),
+    "ParamPrefetcher": ("stop", "close"),
+    "Thread": ("join",),
+    "socket": ("close", "detach"),
+    "create_connection": ("close", "detach"),
+}
+
+
+def _call_tail(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _is_daemon_thread(node: ast.Call) -> bool:
+    return _call_tail(node) == "Thread" and any(
+        kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+        and kw.value.value is True
+        for kw in node.keywords
+    )
+
+
+def _tracked_ctor(node: ast.Call) -> str | None:
+    name = _call_tail(node)
+    if name not in RESOURCES:
+        return None
+    if _is_daemon_thread(node):
+        return None
+    return name
+
+
+def _walk_skip_defs(node: ast.AST):
+    """Descendants of ``node``, not descending into nested def/class/
+    lambda bodies (their code runs on its own schedule)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        sub = stack.pop()
+        yield sub
+        if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(sub))
+
+
+def _finally_nodes(func: ast.AST) -> set[int]:
+    """ids of every node lexically inside a ``finally:`` suite of this
+    function — the release sites that hold on exception exits."""
+    out: set[int] = set()
+    for sub in _walk_skip_defs(func):
+        if isinstance(sub, ast.Try) and sub.finalbody:
+            for stmt in sub.finalbody:
+                out.add(id(stmt))
+                for inner in ast.walk(stmt):
+                    out.add(id(inner))
+    return out
+
+
+def _functions(tree: ast.Module):
+    """(func node, qualname, enclosing class name or '') triples."""
+    stack: list[tuple[ast.AST, str, str]] = [(tree, "", "")]
+    while stack:
+        node, prefix, cls = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                yield child, qual, cls
+                stack.append((child, qual, cls))
+            elif isinstance(child, ast.ClassDef):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                stack.append((child, qual, child.name))
+
+
+def _bare_names(expr: ast.expr | None) -> set[str]:
+    """Names handed over AS VALUES by an expression: the name itself, or
+    elements of a tuple/list/set/dict of them.  ``x.close()`` or
+    ``f(x.attr)`` does NOT hand ``x`` over."""
+    out: set[str] = set()
+    if isinstance(expr, ast.Name):
+        out.add(expr.id)
+    elif isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+        for e in expr.elts:
+            out |= _bare_names(e)
+    elif isinstance(expr, ast.Dict):
+        for e in expr.values:
+            out |= _bare_names(e)
+    return out
+
+
+def _lint_function(
+    func: ast.AST, qual: str, rel: str, findings: list[Finding],
+) -> None:
+    # Construction sites: local (x = Ctor()) tracked; anything else is an
+    # ownership transfer at birth (returned, passed, stored) and the new
+    # owner's site is linted instead.  nonlocal/global vars belong to the
+    # enclosing scope (the cached-client idiom) — not this function's to
+    # release.
+    locals_: dict[str, tuple[str, int]] = {}
+    with_targets: set[str] = set()
+    outer_vars: set[str] = set()
+    for sub in _walk_skip_defs(func):
+        if isinstance(sub, (ast.Nonlocal, ast.Global)):
+            outer_vars.update(sub.names)
+        if isinstance(sub, (ast.With, ast.AsyncWith)):
+            for item in sub.items:
+                if isinstance(item.optional_vars, ast.Name):
+                    with_targets.add(item.optional_vars.id)
+        if not isinstance(sub, ast.Assign) or len(sub.targets) != 1:
+            continue
+        tgt, val = sub.targets[0], sub.value
+        if isinstance(tgt, ast.Name) and isinstance(val, ast.Call):
+            ctor = _tracked_ctor(val)
+            if ctor is not None:
+                locals_[tgt.id] = (ctor, sub.lineno)
+    for var in outer_vars:
+        locals_.pop(var, None)
+    if not locals_:
+        return
+    # Closure capture is an ownership transfer too: a nested def that
+    # references the resource (the generator-with-finally idiom in
+    # data/streams.py) owns its release on its own schedule.
+    captured: set[str] = set()
+    for sub in _walk_skip_defs(func):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            for inner in ast.walk(sub):
+                if isinstance(inner, ast.Name):
+                    captured.add(inner.id)
+    fin = _finally_nodes(func)
+    for var, (ctor, line) in sorted(locals_.items()):
+        if var in with_targets or var in captured:
+            continue
+        escaped = False
+        released = guarded = False
+        verbs = RESOURCES[ctor]
+        for sub in _walk_skip_defs(func):
+            if isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)):
+                if var in _bare_names(sub.value):
+                    escaped = True
+            elif isinstance(sub, ast.Assign) and var in _bare_names(sub.value):
+                # Aliased or stored (self.x = c / pool[i] = c / y = c /
+                # old, self._c = self._c, c): ownership moved.
+                escaped = True
+            elif isinstance(sub, ast.Call):
+                if any(
+                    isinstance(a, ast.Name) and a.id == var
+                    for a in [*sub.args,
+                              *(kw.value for kw in sub.keywords)]
+                ):
+                    escaped = True  # handed to someone (pool, closing, ...)
+                fn = sub.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr in verbs
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == var
+                ):
+                    released = True
+                    if id(sub) in fin:
+                        guarded = True
+        if escaped:
+            continue
+        if not released:
+            findings.append(Finding(
+                PASS, "resource-leaked", rel, f"{qual}:{var}",
+                f"{qual} constructs a {ctor} in {var!r} that never reaches "
+                f"{'/'.join(verbs)} and never escapes — leaked on every "
+                "exit",
+                line=line,
+            ))
+        elif not guarded:
+            findings.append(Finding(
+                PASS, "resource-release-unguarded", rel, f"{qual}:{var}",
+                f"{qual} releases {var!r} ({ctor}) only on the "
+                "straight-line path — an exception before the release "
+                "leaks it; use try/finally or a context manager",
+                line=line,
+            ))
+
+
+def _lint_class_attrs(
+    tree: ast.Module, rel: str, findings: list[Finding],
+) -> None:
+    # class -> {attr: (ctor, line)}; class -> methods' (refs, has_release)
+    owned: dict[str, dict[str, tuple[str, int]]] = {}
+    released: dict[str, set[str]] = {}
+    for func, _qual, cls in _functions(tree):
+        if not cls:
+            continue
+        for sub in _walk_skip_defs(func):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                tgt, val = sub.targets[0], sub.value
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                    and isinstance(val, ast.Call)
+                ):
+                    ctor = _tracked_ctor(val)
+                    if ctor is not None:
+                        owned.setdefault(cls, {}).setdefault(
+                            tgt.attr, (ctor, sub.lineno)
+                        )
+        # A method that references self.<attr> AND calls a release verb
+        # counts as that attr's teardown (covers the swap-then-close and
+        # iterate-a-pool shapes without chasing aliases).
+        refs: set[str] = set()
+        release_verbs_called: set[str] = set()
+        for sub in _walk_skip_defs(func):
+            if isinstance(sub, ast.Attribute) and \
+                    isinstance(sub.value, ast.Name) and sub.value.id == "self":
+                refs.add(sub.attr)
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute):
+                release_verbs_called.add(sub.func.attr)
+        for attr in refs:
+            if release_verbs_called & {
+                v for verbs in RESOURCES.values() for v in verbs
+            }:
+                released.setdefault(cls, set()).add(attr)
+    for cls, attrs in sorted(owned.items()):
+        for attr, (ctor, line) in sorted(attrs.items()):
+            if attr in released.get(cls, set()):
+                continue
+            findings.append(Finding(
+                PASS, "resource-attr-unreleased", rel, f"{cls}.{attr}",
+                f"{cls}.{attr} holds a {ctor} but no method of {cls} both "
+                "references it and calls a release verb — the class has "
+                "no teardown path for it",
+                line=line,
+            ))
+
+
+def run(cfg: LintConfig) -> list[Finding]:
+    findings: list[Finding] = []
+    files: list[Path] = []
+    for d in cfg.lifecycle_dirs:
+        if d.is_file():
+            files.append(d)
+        elif d.is_dir():
+            files.extend(sorted(d.glob("*.py")))
+    for path in files:
+        rel = cfg.rel(path)
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue
+        for func, qual, _cls in _functions(tree):
+            _lint_function(func, qual, rel, findings)
+        _lint_class_attrs(tree, rel, findings)
+    return findings

@@ -1,0 +1,425 @@
+"""Pass: protocol state machines (r16) — legal op orderings as data.
+
+``wire.WIRE_PROTOCOLS`` declares the orderings each wire's conversation
+must respect (HELLO before anything on the tagged services, RESHARD
+BEGIN -> {COMMIT | ABORT} with no second BEGIN at the same version,
+LEASE_ACQUIRE before RELEASE, slice sync before the joiner announces its
+transition record).  The declarations are DATA — dict/list/str literals
+only — and this pass both validates the machines themselves and lints
+the client call-site corpus against them.
+
+Rule kinds:
+
+- ``first_op``     — on the named services, any client function that
+                     creates a fresh connection AND sends wire ops must
+                     send the named op FIRST (the handshake rule).
+- ``session``      — a state machine: ``init`` state + ``transitions``
+                     ``{state: {OP: next_state}}``.  Validated for
+                     well-formedness, pinned against the op registry,
+                     checked for call-site coverage (a declared
+                     transition nobody can send is an unreachable state),
+                     and enforced over consecutive op pairs inside one
+                     statement block (branch arms are separate blocks, so
+                     a try/except commit-or-abort never false-positives).
+- ``order``        — within one function containing sites for both, every
+                     ``first`` site must precede every ``then`` site
+                     (the joiner's sync-before-announce rule).
+
+Call-site detection: an op participates where (a) a call's argument
+spells it (``_RESHARD_BEGIN``, ``DSVC_HELLO``, ``wire.PS_OPS["X"]``), or
+(b) a call's function name (underscores stripped) is the op lowercased or
+one of the rule's declared ``aliases`` for it — the wrapper-method
+convention (``client.reshard_commit`` stands for RESHARD_COMMIT).
+
+Finding codes: ``proto-registry-missing``, ``proto-bad-rule``,
+``proto-unknown-op``, ``proto-state-unreachable``, ``proto-op-unsent``,
+``proto-hello-not-first``, ``proto-illegal-sequence``, ``proto-order``.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from . import Finding, LintConfig
+from .wire_conformance import module_int_dicts
+
+PASS = "protocol"
+
+_REGISTRY_OF = {"ps": "PS_OPS", "dsvc": "DSVC_OPS", "msrv": "SRV_OPS"}
+_PREFIX_OF = {"ps": "", "dsvc": "DSVC_", "msrv": "SRV_"}
+
+_TRANSPORT_CALLS = {"call", "_attempt", "timed_blocking"}
+
+
+def wire_protocols(wire_py: Path) -> dict | None:
+    """The WIRE_PROTOCOLS literal out of wire.py (None when absent or not
+    a pure literal)."""
+    tree = ast.parse(wire_py.read_text())
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            tgt, val = node.targets[0], node.value
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            tgt, val = node.target, node.value
+        else:
+            continue
+        if tgt.id != "WIRE_PROTOCOLS":
+            continue
+        try:
+            parsed = ast.literal_eval(val)
+        except (ValueError, SyntaxError):
+            return None
+        return parsed if isinstance(parsed, dict) else None
+    return None
+
+
+# ----------------------------------------------------------------------------
+# Call-site extraction
+# ----------------------------------------------------------------------------
+
+
+def _spelled_op(node: ast.expr) -> str | None:
+    """The protocol-op NAME an expression spells: a (possibly
+    ``_``-prefixed) Name/Attribute, or a registry subscript
+    ``PS_OPS["X"]`` / ``wire.DSVC_OPS["X"]``."""
+    if isinstance(node, ast.Name):
+        return node.id.lstrip("_")
+    if isinstance(node, ast.Attribute):
+        return node.attr.lstrip("_")
+    if isinstance(node, ast.Subscript):
+        base = node.value
+        bname = base.attr if isinstance(base, ast.Attribute) else (
+            base.id if isinstance(base, ast.Name) else ""
+        )
+        if bname.endswith("_OPS"):
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                return sl.value
+        return None
+    return None
+
+
+class _OpMatcher:
+    """Maps call nodes to the canonical op names of one rule."""
+
+    def __init__(self, service: str, ops: list[str], aliases: dict):
+        prefix = _PREFIX_OF.get(service, "")
+        self._by_spelling: dict[str, str] = {}
+        self._by_callname: dict[str, str] = {}
+        for op in ops:
+            for spelling in (op, prefix + op, "HELLO_OP" if op == "HELLO" else op):
+                self._by_spelling[spelling] = op
+            self._by_callname[op.lower()] = op
+            for alias in aliases.get(op, ()):
+                self._by_callname[alias.lstrip("_").lower()] = op
+
+    def ops_of_call(self, node: ast.Call) -> list[str]:
+        found: list[str] = []
+        fn = node.func
+        fname = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else ""
+        )
+        op = self._by_callname.get(fname.lstrip("_").lower())
+        if op is not None:
+            found.append(op)
+        for arg in [*node.args, *(kw.value for kw in node.keywords)]:
+            spelled = _spelled_op(arg)
+            if spelled is not None and spelled in self._by_spelling:
+                found.append(self._by_spelling[spelled])
+        # One call names one site even when wrapper AND argument match.
+        seen: list[str] = []
+        for op in found:
+            if op not in seen:
+                seen.append(op)
+        return seen
+
+
+def _calls_in_stmt_exprs(stmt: ast.stmt):
+    """Call nodes in a statement's OWN expressions, source order — nested
+    statement bodies (branch arms, loop bodies, nested defs) excluded;
+    they are their own blocks."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        return
+    roots: list[ast.expr] = []
+    if isinstance(stmt, (ast.If, ast.While)):
+        roots = [stmt.test]
+    elif isinstance(stmt, ast.For):
+        roots = [stmt.iter]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        roots = [i.context_expr for i in stmt.items]
+    elif isinstance(stmt, ast.Try):
+        roots = []
+    else:
+        roots = [
+            v for v in ast.iter_child_nodes(stmt) if isinstance(v, ast.expr)
+        ]
+    for root in roots:
+        for sub in ast.walk(root):
+            if isinstance(sub, ast.Call):
+                yield sub
+
+
+def _blocks_of(func: ast.AST):
+    """Every statement-list block of a function, outermost first."""
+    stack = [list(getattr(func, "body", []))]
+    while stack:
+        block = stack.pop()
+        yield block
+        for stmt in block:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub:
+                    stack.append(list(sub))
+            for h in getattr(stmt, "handlers", []) or []:
+                stack.append(list(h.body))
+
+
+def _functions(tree: ast.Module):
+    stack: list[tuple[ast.AST, str]] = [(tree, "")]
+    while stack:
+        node, prefix = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                yield child, qual
+                stack.append((child, qual))
+            elif isinstance(child, ast.ClassDef):
+                stack.append((child, f"{prefix}.{child.name}" if prefix
+                              else child.name))
+
+
+def _own_calls(func: ast.AST):
+    """Call nodes belonging to THIS function (nested def/lambda/class
+    bodies excluded — they run on their own schedule, not inline)."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _parse_corpus(cfg: LintConfig) -> list[tuple[str, ast.Module]]:
+    """The protocol corpus, read and AST-parsed ONCE per run — every rule
+    walks these shared trees (re-parsing per rule would multiply the
+    lint's wall time with each WIRE_PROTOCOLS entry, and the budget gate
+    runs inside tier-1)."""
+    files: list[Path] = []
+    for d in cfg.protocol_dirs:
+        if d.is_file():
+            files.append(d)
+        elif d.is_dir():
+            files.extend(sorted(d.glob("*.py")))
+    corpus: list[tuple[str, ast.Module]] = []
+    for path in files:
+        try:
+            corpus.append((cfg.rel(path), ast.parse(path.read_text())))
+        except SyntaxError:
+            continue
+    return corpus
+
+
+# ----------------------------------------------------------------------------
+# Rule enforcement
+# ----------------------------------------------------------------------------
+
+
+def _check_session(
+    name: str, rule: dict, corpus: list[tuple[str, ast.Module]],
+    registries: dict, findings: list[Finding], wire_rel: str,
+) -> None:
+    service = rule.get("service", "ps")
+    transitions = rule.get("transitions")
+    init = rule.get("init")
+    if not isinstance(transitions, dict) or not isinstance(init, str) or \
+            init not in transitions:
+        findings.append(Finding(
+            PASS, "proto-bad-rule", wire_rel, name,
+            f"session rule {name!r} needs an 'init' state present in its "
+            "'transitions' dict",
+        ))
+        return
+    ops = sorted({
+        op for moves in transitions.values() for op in (moves or {})
+    })
+    reg = registries.get(_REGISTRY_OF.get(service, ""), {})
+    for op in ops:
+        if op not in reg:
+            findings.append(Finding(
+                PASS, "proto-unknown-op", wire_rel, f"{name}.{op}",
+                f"protocol {name!r} names op {op}, which "
+                f"{_REGISTRY_OF.get(service)} does not define",
+            ))
+    # Reachability from init.
+    reached, frontier = {init}, [init]
+    while frontier:
+        for op, nxt in (transitions.get(frontier.pop(), {}) or {}).items():
+            if nxt not in reached:
+                reached.add(nxt)
+                frontier.append(nxt)
+    for state in sorted(set(transitions) - reached):
+        findings.append(Finding(
+            PASS, "proto-state-unreachable", wire_rel, f"{name}.{state}",
+            f"protocol {name!r} state {state!r} is unreachable from "
+            f"{init!r} — dead protocol surface, or a missing transition",
+        ))
+
+    matcher = _OpMatcher(service, ops, rule.get("aliases", {}))
+    sent: set[str] = set()
+    for rel, tree in corpus:
+        for func, qual in _functions(tree):
+            for block in _blocks_of(func):
+                seq: list[tuple[str, int]] = []
+                for stmt in block:
+                    for call in _calls_in_stmt_exprs(stmt):
+                        for op in matcher.ops_of_call(call):
+                            seq.append((op, call.lineno))
+                sent.update(op for op, _ in seq)
+                for (a, _la), (b, lb) in zip(seq, seq[1:]):
+                    legal = any(
+                        b in (transitions.get(
+                            (transitions.get(s) or {}).get(a, ""), {}) or {})
+                        for s in transitions
+                        if a in (transitions.get(s) or {})
+                    )
+                    if not legal:
+                        findings.append(Finding(
+                            PASS, "proto-illegal-sequence", rel,
+                            f"{qual}:{a}->{b}",
+                            f"{qual} sends {a} then {b} in one block, but "
+                            f"protocol {name!r} admits that pair from no "
+                            f"state (e.g. a second {a} before its resolver)",
+                            line=lb,
+                        ))
+    for op in ops:
+        if op not in sent:
+            findings.append(Finding(
+                PASS, "proto-op-unsent", wire_rel, f"{name}.{op}",
+                f"protocol {name!r} declares {op} but no client call-site "
+                "in the corpus ever sends it — the transitions through it "
+                "are states no code can reach",
+            ))
+
+
+def _check_first_op(
+    name: str, rule: dict, cfg: LintConfig, findings: list[Finding],
+    wire_rel: str,
+) -> None:
+    op = rule.get("op")
+    services = rule.get("services", [])
+    if not isinstance(op, str) or not services:
+        findings.append(Finding(
+            PASS, "proto-bad-rule", wire_rel, name,
+            f"first_op rule {name!r} needs 'op' and non-empty 'services'",
+        ))
+        return
+    client_files = {"dsvc": [cfg.dsvc_py], "msrv": [cfg.serve_client_py],
+                    "ps": [cfg.ps_service_py]}
+    for service in services:
+        for path in client_files.get(service, []):
+            rel = cfg.rel(path)
+            try:
+                tree = ast.parse(path.read_text())
+            except (OSError, SyntaxError):
+                continue
+            for func, qual in _functions(tree):
+                dials = False
+                first: tuple[str, int] | None = None
+                for sub in _own_calls(func):
+                    fn = sub.func
+                    fname = fn.attr if isinstance(fn, ast.Attribute) else (
+                        fn.id if isinstance(fn, ast.Name) else ""
+                    )
+                    if fname == "create_connection":
+                        dials = True
+                    if fname in _TRANSPORT_CALLS and sub.args:
+                        spelled = _spelled_op(sub.args[0])
+                        if spelled is not None and (
+                            first is None or sub.lineno < first[1]
+                        ):
+                            first = (spelled, sub.lineno)
+                if dials and first is not None and op not in first[0]:
+                    findings.append(Finding(
+                        PASS, "proto-hello-not-first", rel, qual,
+                        f"{qual} dials a fresh {service} connection but its "
+                        f"first wire op is {first[0]}, not {op} — the "
+                        "handshake must precede anything the peer could "
+                        "misparse",
+                        line=first[1],
+                    ))
+
+
+def _check_order(
+    name: str, rule: dict, corpus: list[tuple[str, ast.Module]],
+    findings: list[Finding], wire_rel: str,
+) -> None:
+    service = rule.get("service", "ps")
+    first_op, then_op = rule.get("first"), rule.get("then")
+    if not isinstance(first_op, str) or not isinstance(then_op, str):
+        findings.append(Finding(
+            PASS, "proto-bad-rule", wire_rel, name,
+            f"order rule {name!r} needs 'first' and 'then' op names",
+        ))
+        return
+    matcher = _OpMatcher(
+        service, [first_op, then_op], rule.get("aliases", {})
+    )
+    for rel, tree in corpus:
+        for func, qual in _functions(tree):
+            firsts: list[int] = []
+            thens: list[int] = []
+            for sub in _own_calls(func):
+                for op in matcher.ops_of_call(sub):
+                    (firsts if op == first_op else thens).append(sub.lineno)
+            if firsts and thens and min(thens) < max(firsts):
+                findings.append(Finding(
+                    PASS, "proto-order", rel, f"{qual}:{then_op}",
+                    f"{qual} reaches {then_op} (line {min(thens)}) before "
+                    f"{first_op} (line {max(firsts)}) — protocol {name!r} "
+                    f"requires {first_op} first",
+                    line=min(thens),
+                ))
+
+
+def run(cfg: LintConfig) -> list[Finding]:
+    findings: list[Finding] = []
+    wire_rel = cfg.rel(cfg.wire_py)
+    protocols = wire_protocols(cfg.wire_py)
+    if protocols is None:
+        findings.append(Finding(
+            PASS, "proto-registry-missing", wire_rel, "WIRE_PROTOCOLS",
+            "wire.WIRE_PROTOCOLS not found as a pure dict literal — the "
+            "protocol state machines must be declared as data",
+        ))
+        return findings
+    registries = module_int_dicts(cfg.wire_py)
+    corpus = _parse_corpus(cfg)
+    for name, rule in sorted(protocols.items()):
+        if not isinstance(rule, dict):
+            findings.append(Finding(
+                PASS, "proto-bad-rule", wire_rel, name,
+                f"protocol {name!r} must be a dict rule",
+            ))
+            continue
+        kind = rule.get("kind")
+        if kind == "session":
+            _check_session(name, rule, corpus, registries, findings, wire_rel)
+        elif kind == "first_op":
+            _check_first_op(name, rule, cfg, findings, wire_rel)
+        elif kind == "order":
+            _check_order(name, rule, corpus, findings, wire_rel)
+        else:
+            findings.append(Finding(
+                PASS, "proto-bad-rule", wire_rel, name,
+                f"protocol {name!r} has unknown kind {kind!r} "
+                "(session | first_op | order)",
+            ))
+    return findings

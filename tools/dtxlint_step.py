@@ -1,23 +1,54 @@
-"""Campaign entry point for dtxlint (r11).
+"""Campaign entry point for dtxlint (r11; wall-time metric r16).
 
 The campaign plan invokes steps as ``python <script path>`` (the plan
 smoke test asserts every target exists on disk), but dtxlint is a package
 with relative imports, so ``python tools/dtxlint/__main__.py`` would not
-import.  This shim bridges the two: it puts the repo root on sys.path and
-runs the package CLI in compact-JSON mode, whose single output line is
-what ``measure_campaign.last_json_line`` records for ``campaign_report``.
+import.  This shim bridges the two: it runs the passes through the
+library, emits the ``--json --compact`` document EXTENDED with ``metric:
+"dtxlint"`` and the run's ``seconds`` as its single output line (what
+``measure_campaign.last_json_line`` records for ``campaign_report``), and
+exits with the CLI's code.  ``tools/perf_gate.py`` gates ``seconds``
+against the checked-in budget (``tools/dtxlint_time_baseline.json``), so
+a new pass that silently blows up lint wall-time — and with it tier-1's
+repo-gate — fails the campaign loudly instead.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import sys
+import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if ROOT not in sys.path:
     sys.path.insert(0, ROOT)
 
-from tools.dtxlint.__main__ import main  # noqa: E402
+from tools.dtxlint import (  # noqa: E402
+    LintConfig, apply_baseline, load_baseline, run_passes,
+)
+from tools.dtxlint.__main__ import build_report  # noqa: E402
+
+
+def main() -> int:
+    t0 = time.time()
+    baseline_path = os.path.join(ROOT, "tools", "dtxlint_baseline.json")
+    try:
+        baseline = load_baseline(baseline_path)
+        results = run_passes(LintConfig.default(ROOT))
+    except (OSError, ValueError, SyntaxError) as e:
+        print(json.dumps({
+            "metric": "dtxlint", "ok": False, "error": str(e),
+            "seconds": round(time.time() - t0, 2),
+        }, separators=(",", ":")))
+        return 2
+    active, suppressed, stale = apply_baseline(results, baseline)
+    report = build_report(results, active, suppressed, stale, baseline_path)
+    report["metric"] = "dtxlint"
+    report["seconds"] = round(time.time() - t0, 2)
+    print(json.dumps(report, separators=(",", ":")))
+    return 0 if (not active and not stale) else 1
+
 
 if __name__ == "__main__":
-    sys.exit(main(["--json", "--compact"] + sys.argv[1:]))
+    sys.exit(main())

@@ -160,6 +160,17 @@ def steps_plan() -> list[dict]:
         dict(name="dtxlint",
              cmd=[PY, "tools/dtxlint_step.py"], timeout=600,
              cpu_ok=True),
+        # Native ThreadSanitizer gate (r16): build the TSAN .so and run
+        # the protocol driver (replicated pair + concurrent clients +
+        # kill/restart/partition chaos) under libtsan; any unsuppressed
+        # race fails the step, hosts without a TSAN toolchain record a
+        # loud 'skipped'.  Pure host-side C++/sockets, so cpu_ok.
+        # Timeout sits ABOVE the step's internal worst case (420s build +
+        # 420s sanitized driver + probes): the step must always get to
+        # emit its own JSON verdict before the campaign's SIGKILL.
+        dict(name="tsan_protocol",
+             cmd=[PY, "tools/tsan_step.py"], timeout=1100,
+             cpu_ok=True),
         # Observability plane (r13): boot a mini train-and-serve cluster
         # under load, scrape it once with dtxtop, fail on any missing
         # role/counter — the cluster must stay scrape-able, release over

@@ -72,6 +72,10 @@ BASELINES = {
     # every epoch visible to dtxtop) must hold, and a gate present in the
     # baseline must still be computed by the result.
     "loadsim_reshard_slo": "loadsim_reshard_baseline.json",
+    # r16 static-analysis wall-time budget (tools/dtxlint_step.py): the
+    # lint's repo gate runs inside tier-1, so a pass whose cost silently
+    # explodes taxes every future test run — the campaign fails first.
+    "dtxlint": "dtxlint_time_baseline.json",
 }
 
 
@@ -88,6 +92,27 @@ def gate(
     """Returns a list of human-readable regression lines (empty = pass)."""
     res, base = _detail(result), _detail(baseline)
     failures: list[str] = []
+    # The r16 dtxlint wall-time budget: a hard per-run bound from the
+    # checked-in baseline (generous cross-host headroom lives IN the
+    # budget — no tolerance multiplier on top), plus the verdict itself —
+    # a lint that stopped exiting clean is a campaign failure regardless
+    # of how fast it failed.
+    if "budget_s" in base:
+        secs = res.get("seconds")
+        if secs is None:
+            failures.append(
+                "dtxlint: result carries no 'seconds' — the wall-time "
+                "budget cannot be checked"
+            )
+        elif secs > base["budget_s"]:
+            failures.append(
+                f"dtxlint: {secs:.1f}s > budget {base['budget_s']:.1f}s — "
+                "a lint pass got structurally slower (this gate runs "
+                "inside tier-1 on every PR)"
+            )
+        if res.get("ok") is False:
+            failures.append("dtxlint: run not clean (ok=false)")
+        return failures  # budget baselines carry no bench rows below
     # The r14 elasticity acceptance (tools/loadsim.py verdicts): the SLO
     # verdict itself is binary — every gate (zero failed predicts, p99
     # under the checked-in bound, step monotone+advancing through the

@@ -1,0 +1,187 @@
+"""The protocol exercise ``tools/tsan_step.py`` runs under ThreadSanitizer.
+
+Drives the REAL client stack (``parallel/ps_service.py`` — HELLO, zero-copy
+framing, dedup tags, leases, reshard records, replication) against a
+TSAN-instrumented ``libdtx_native_tsan.so`` hosting a replicated PS pair,
+with concurrent client threads plus a mid-run backup kill/restart/resync
+and a partition/heal cycle — the mutex-heavy server paths the protocol
+tests cover, compressed into one sanitizer-friendly process.
+
+Run by tsan_step.py as::
+
+    LD_PRELOAD=libtsan.so.N DTX_NATIVE_LIB=.../libdtx_native_tsan.so \
+        python tools/tsan_driver.py --seconds 8
+
+JAX must never load here (a sanitized run of XLA is neither needed nor
+practical), so the package is entered through stub parents: the
+``distributed_tensorflow_examples_tpu`` root and its ``parallel``/``utils``
+``__init__``s import the model stack, but ``ps_service`` and everything it
+needs (wire, native, faults, telemetry, numpy) are JAX-free.  Stubbing the
+parents and importing only those leaf modules keeps the driver honest (the
+real client code) AND sanitizer-clean.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import os
+import sys
+import threading
+import time
+import types
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = "distributed_tensorflow_examples_tpu"
+
+
+def _stub_pkg(name: str, path: str) -> None:
+    mod = types.ModuleType(name)
+    mod.__path__ = [path]  # a package, but its __init__ never runs
+    sys.modules[name] = mod
+
+
+def load_ps_service():
+    """Import parallel.ps_service without executing the JAX-importing
+    package __init__s."""
+    pkg_dir = os.path.join(ROOT, PKG)
+    _stub_pkg(PKG, pkg_dir)
+    _stub_pkg(f"{PKG}.parallel", os.path.join(pkg_dir, "parallel"))
+    _stub_pkg(f"{PKG}.utils", os.path.join(pkg_dir, "utils"))
+    # native's real __init__ must run (the ctypes bindings live there);
+    # DTX_NATIVE_LIB (exported by tsan_step) points it at the sanitized
+    # build.
+    importlib.import_module(f"{PKG}.native")
+    return importlib.import_module(f"{PKG}.parallel.ps_service")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=8.0)
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--elems", type=int, default=4096)
+    args = ap.parse_args()
+
+    ps = load_ps_service()
+    t_end = time.monotonic() + args.seconds
+
+    # Replicated pair: A up first, B syncs from A at start, then A is
+    # wired back at B — the standard in-process pairing.
+    port_a = ps.start_server(0, shard_id=0, shard_count=1)
+    port_b = ps.start_server(0, shard_id=0, shard_count=1,
+                             peer=("127.0.0.1", port_a), sync_wait_s=2.0)
+    ps.set_server_peer(port_a, ("127.0.0.1", port_b))
+
+    n = args.elems
+    ops = [0]
+    errors: list[str] = []
+
+    def client(i: int) -> ps.PSClient:
+        return ps.PSClient(
+            "127.0.0.1", port_a, op_timeout_s=5.0,
+            reconnect_deadline_s=10.0, worker_tag=i, role=f"tsan{i}",
+            addrs=[("127.0.0.1", port_a), ("127.0.0.1", port_b)],
+        )
+
+    boot = client(99)
+    pstore = ps.RemoteParamStore(boot, "params", n)
+    pstore.set(0, np.zeros(n, np.float32))
+    acc = ps.RemoteAccumulator(boot, "acc", n)
+    gq = ps.RemoteGradientQueue(boot, "gq", n, capacity=64)
+    tq = ps.RemoteTokenQueue(boot, "tokens")
+
+    def worker(i: int) -> None:
+        try:
+            c = client(i)
+            w_pstore = ps.RemoteParamStore(c, "params", n)
+            w_acc = ps.RemoteAccumulator(c, "acc", n)
+            w_gq = ps.RemoteGradientQueue(c, "gq", n, capacity=64)
+            grad = np.full(n, float(i + 1), np.float32)
+            step = 0
+            while time.monotonic() < t_end:
+                step += 1
+                try:
+                    w_pstore.set(step, grad)
+                    w_pstore.get()
+                    w_acc.apply(step, grad)
+                    w_gq.push(step, grad)
+                    w_gq.pop(timeout_s=0.2)
+                    c.lease_acquire(f"tsan{i}|worker|", 2.0)
+                    c.stats()
+                    c.incarnation()
+                    if step % 7 == 0:
+                        c.lease_list()
+                        c.lease_release(f"tsan{i}|worker|")
+                    ops[0] += 1  # GIL-atomic enough for a progress count
+                except ps.PSError:
+                    # Divergence/deadline windows are INJECTED (partition,
+                    # backup kill): keep hammering — the load through the
+                    # refuse-and-heal paths is the point.
+                    time.sleep(0.02)
+            c.close()
+        except Exception as e:  # noqa: BLE001 — surfaced in the verdict
+            errors.append(f"worker{i}: {type(e).__name__}: {e}")
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), name=f"tsan-w{i}")
+        for i in range(args.threads)
+    ]
+    for t in threads:
+        t.start()
+
+    try:
+        # Control-plane churn + replication chaos under the client load:
+        # reshard records, accumulator drains, token traffic, a backup
+        # kill/restart (REPL_SYNC catch-up against live forwards), and a
+        # partition/heal cycle (divergence latch + resync).
+        version = 0
+        while time.monotonic() < t_end:
+            version += 1
+            blob = b'{"v": %d, "pad": "%s"}' % (version, b"x" * 64)
+            try:
+                boot.reshard_announce(version, blob)
+                boot.reshard_poll(0, pending=True)
+                if version % 2:
+                    boot.reshard_commit(version)
+                else:
+                    boot.reshard_abort(version + 1)  # no-op clear
+                tq.push(version, 2)
+                tq.pop(timeout_s=0.2)
+                acc.take(1, timeout_s=0.2)
+            except ps.PSError:
+                pass  # version raced a commit; the machine stays legal
+            if version == 3:
+                ps.stop_server(port_b)
+            elif version == 5:
+                port_b2 = ps.start_server(
+                    0, shard_id=0, shard_count=1,
+                    peer=("127.0.0.1", port_a), sync_wait_s=2.0,
+                )
+                ps.set_server_peer(port_a, ("127.0.0.1", port_b2))
+            elif version == 8:
+                ps.set_server_partitioned(port_a, True)
+                time.sleep(0.1)
+                ps.set_server_partitioned(port_a, False)
+                ps.resync_server(port_a, 2.0)
+            time.sleep(0.05)
+    finally:
+        for t in threads:
+            t.join(timeout=30.0)
+        try:
+            boot.close()
+        finally:
+            ps.stop_server()
+
+    for e in errors:
+        print(f"TSAN_DRIVER_ERROR {e}", file=sys.stderr)
+    print(f"TSAN_DRIVER_OK ops={ops[0]} errors={len(errors)}")
+    # Client-visible errors under chaos are tolerated (the pair is being
+    # killed/partitioned on purpose); only a wedged driver (zero progress)
+    # fails here.  Races are the STEP's verdict, parsed off stderr.
+    return 0 if ops[0] > 0 else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
