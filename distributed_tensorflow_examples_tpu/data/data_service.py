@@ -70,7 +70,7 @@ from typing import Callable, Iterator, Sequence
 
 import numpy as np
 
-from ..parallel import wire
+from ..parallel import server_core, wire
 from ..utils import faults, telemetry
 from . import filestream
 
@@ -122,6 +122,14 @@ class DSVCDeadlineError(DSVCError):
     ``reconnect_deadline_s``."""
 
 
+class DSVCRejectedError(DSVCError):
+    """The server ANSWERED and rejected the op (r17): the transport is
+    fine, and retrying the same request buys nothing.  ``ERR`` is the
+    server core's loud handler-failure status — a handler exception lands
+    here instead of a silent connection close the client would burn its
+    reconnect budget retrying."""
+
+
 def parse_spec(spec: str) -> tuple[str, int]:
     """``dsvc://host:port`` -> (host, port)."""
     if not spec.startswith("dsvc://"):
@@ -150,9 +158,11 @@ read_batch = wire.read_batch
 
 
 class DataServiceServer:
-    """Threaded TCP data server: one dispatcher state machine, one handler
-    thread per connection, batches decoded server-side (the disaggregation
-    point — preprocessing cost lives HERE, not on the training host).
+    """TCP data server on the unified server core (r17): one dispatcher
+    state machine registered as a handler on ``parallel/server_core.py``
+    (selector-driven I/O, bounded worker pool — idle connections cost no
+    threads), batches decoded server-side (the disaggregation point —
+    preprocessing cost lives HERE, not on the training host).
 
     ``splits``           shard file paths (``filestream`` formats) or in-RAM
                          ``{field: array}`` chunks; one split per entry.
@@ -189,6 +199,7 @@ class DataServiceServer:
         reassign_after_s: float = 60.0,
         cache_splits: int = 4,
         info_extra: dict | None = None,
+        handler_workers: int = 8,
     ):
         if not splits:
             raise ValueError("data service needs at least one split")
@@ -222,7 +233,6 @@ class DataServiceServer:
         # the duplicate delivery).
         self._stale_members: set[int] = set()
         self._stale_marked = 0
-        self._requests = 0
         self._batches_served = 0
         self._splits_completed = 0
         self._assigned_total = 0  # assignments handed out (r13 dtxobs)
@@ -233,29 +243,27 @@ class DataServiceServer:
         self._registered: set[int] = set()
         self._cache: OrderedDict[int, list] = OrderedDict()
         self._cache_cap = max(1, cache_splits)
-        self._stop = threading.Event()
         self.shutdown_requested = threading.Event()
-        self._conns: list[socket.socket] = []
-        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        bind_deadline = time.monotonic() + (5.0 if port else 0.0)
-        while True:
-            try:
-                self._listener.bind(("127.0.0.1" if loopback_only else "", port))
-                break
-            except OSError:
-                # A supervised restart rebinds the dead incarnation's FIXED
-                # port; lingering sockets can hold it briefly — retry within
-                # a short window instead of failing the healing restart.
-                if time.monotonic() >= bind_deadline:
-                    raise
-                time.sleep(0.2)
-        self._listener.listen(64)
-        self.port = self._listener.getsockname()[1]
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True, name="dsvc-accept"
+        # The shared server runtime (r17): selector-driven I/O, a bounded
+        # handler pool, per-connection write buffering and the request
+        # counter all live in parallel/server_core.py — this class is the
+        # dispatcher state machine plus one registered handler.
+        self._core = server_core.ServerCore(
+            port=port, loopback_only=loopback_only, name="dsvc",
+            workers=handler_workers,
         )
-        self._accept_thread.start()
+        self._core.add_service(server_core.Service(
+            "dsvc", self._handle,
+            control_ops=_DSVC_CONTROL_OPS,
+            counts_fn=self._counts_request,
+            error_status=ERR,
+            # No DSVC request carries a payload: a frame announcing more
+            # than this is a corrupt/hostile peer and drops at header
+            # time, before a byte of it would be buffered.
+            max_payload=1 << 20,
+        ))
+        self._core.start()
+        self.port = self._core.port
         log.info(
             "data service serving %d splits on port %d (incarnation %d)",
             len(self._splits), self.port, self._incarnation,
@@ -265,33 +273,23 @@ class DataServiceServer:
 
     def request_count(self) -> int:
         """Requests handled so far — the ``die:after_reqs`` fault trigger
-        for a data-service task (same contract as the PS server's)."""
-        return self._requests
+        for a data-service task (same contract as the PS server's).  The
+        counter lives in the server core, which excludes the control-plane
+        ops (wire.CONTROL_OPS) and the scraper's metadata-only probe."""
+        return self._core.request_count()
+
+    @staticmethod
+    def _counts_request(op: int, name: str, a: int, b: int) -> bool:
+        # The scraper's metadata-only REGISTER probe (negative worker id)
+        # is uncounted — an op-level rule cannot carry it, so it stays
+        # spelled out here as the core's per-service counts hook.
+        return not (op == DSVC_REGISTER and a < 0)
 
     def stop(self) -> None:
-        self._stop.set()
-        # shutdown() BEFORE close(): a close alone does not free the kernel
-        # socket while the accept thread is blocked in accept() on it (the
-        # syscall pins the open file description), which would leave the
-        # port in LISTEN and fail a same-port restart.  shutdown wakes the
-        # blocked accept; the join guarantees the port is released before
-        # stop() returns.
-        try:
-            self._listener.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._listener.close()
-        except OSError:
-            pass
-        self._accept_thread.join(timeout=5.0)
-        with self._lock:
-            conns, self._conns = self._conns[:], []
-        for c in conns:
-            try:
-                c.close()
-            except OSError:
-                pass
+        # The core drains (in-flight handlers finish, buffers flush) and
+        # releases the port before returning — the same contract the old
+        # hand-rolled accept loop kept for supervised same-port restarts.
+        self._core.stop()
 
     # -- split plumbing ------------------------------------------------------
 
@@ -479,8 +477,14 @@ class DataServiceServer:
                 "stale_marked": self._stale_marked,
                 "epochs_completed": self._epochs_completed,
                 "last_epoch_min_visits": self._last_epoch_min_visits,
-                "requests": self._requests,
             }
+        # The uniform runtime-accounting shape (r17): requests/live_conns
+        # come from the shared server core, so the counters mean the same
+        # thing on every service's STATS answer.
+        core = self._core.core_stats()
+        out["requests"] = core["requests"]
+        out["live_conns"] = core["live_conns"]
+        out["core"] = core
         # Process-wide registry + flight-recorder depth ride along (r13):
         # one STATS scrape reads the server's dispatcher counters AND the
         # host process's client-side instruments in one round trip.
@@ -488,92 +492,14 @@ class DataServiceServer:
         out["flight_events"] = len(telemetry.RECORDER)
         return out
 
-    # -- connection handling -------------------------------------------------
+    # -- the core handler ----------------------------------------------------
+    # One registered handler on the shared server core (r17): the core
+    # owns accept/read/write/HELLO/counting; this method is pure
+    # request->response.  HELLO never reaches it (answered in the core
+    # through the shared wire.hello_answer path), and a raised exception
+    # becomes a LOUD per-op ERR on the client (the core's posture).
 
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._listener.accept()
-            except OSError:
-                return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            with self._lock:
-                self._conns.append(conn)
-            threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True,
-                name="dsvc-conn",
-            ).start()
-
-    def _reply(self, conn, status: int, bufs: list | None) -> None:
-        bufs = bufs or []
-        hdr = wire.RESP_HDR.pack(status, encoded_nbytes(bufs))
-        wire.send_frames(conn, [hdr] + bufs)
-
-    def _serve_conn(self, conn: socket.socket) -> None:
-        hdr2 = bytearray(2)
-        try:
-            while not self._stop.is_set():
-                req = wire.read_request(conn, hdr2)
-                if req is None:
-                    return
-                op, name, a, b, plen = req
-                if plen:  # no DSVC op carries a request payload: drain it
-                    sink = bytearray(min(plen, 1 << 20))
-                    left = plen
-                    while left:
-                        view = memoryview(sink)[: min(left, len(sink))]
-                        wire.recv_exact(conn, view)
-                        left -= len(view)
-                # Control-plane ops (wire.CONTROL_OPS) never count toward
-                # ``request_count``; nor does the scraper's metadata-only
-                # REGISTER probe (negative worker id — an op-level rule
-                # cannot carry it, so it stays spelled out here).
-                counted = op not in _DSVC_CONTROL_OPS and not (
-                    op == DSVC_REGISTER and a < 0
-                )
-                if counted:
-                    with self._lock:
-                        # Under the lock like all dispatcher state: a lost
-                        # increment would make die:after_reqs fault
-                        # triggers load-dependent.
-                        self._requests += 1
-                try:
-                    self._handle(conn, op, name, a, b)
-                except (OSError, ConnectionError):
-                    raise
-                except Exception:
-                    # A handler bug (e.g. a decode_fn that chokes on the
-                    # data) must surface as a LOUD per-op error on the
-                    # client, not a silent connection close the client
-                    # burns its whole reconnect budget retrying.  Handlers
-                    # compute before replying, so the framing is intact.
-                    log.exception("dsvc op %d (%s) failed server-side", op, name)
-                    self._reply(conn, ERR, None)
-        except (OSError, ConnectionError):
-            pass
-        finally:
-            # Drop the tracking entry too: the fault-heal design makes
-            # reconnects ROUTINE, and a long-lived server must not keep one
-            # dead socket object per connection ever accepted.
-            with self._lock:
-                try:
-                    self._conns.remove(conn)
-                except ValueError:
-                    pass
-            try:
-                conn.close()
-            except OSError:
-                pass
-
-    def _handle(self, conn, op: int, name: str, a: int, b: int) -> None:
-        if op == DSVC_HELLO:
-            # a=version, b=dtype code + announced service (r10: the shared
-            # hello_answer helper refuses a wrong-service dial loudly).
-            # Batches carry mixed-dtype fields as raw bytes, so only the
-            # f32 (pass-through) code is sound here.
-            status, tag = wire.hello_answer(a, b, service="dsvc")
-            self._reply(conn, status, [tag] if tag else None)
-            return
+    def _handle(self, conn, op: int, name: str, a: int, b: int, payload):
         if op == DSVC_REGISTER:
             if a >= 0:
                 # Negative worker ids are metadata-only probes (source
@@ -590,8 +516,7 @@ class DataServiceServer:
                 "batch_size": self._batch,
                 **self._info_extra,
             }
-            self._reply(conn, OK, [json.dumps(info).encode()])
-            return
+            return OK, [json.dumps(info).encode()]
         if op == DSVC_GET_SPLIT:
             # name: "epoch=<n>[,strict]" — <n> is the epoch the CLIENT is
             # in (the epoch its ack's split was assigned in); ",strict"
@@ -605,51 +530,41 @@ class DataServiceServer:
             status, info = self._handle_get_split(a, b, client_epoch, strict)
             if status >= 0 and info.get("num_batches") is None:
                 info["num_batches"] = self._num_batches(status)
-            self._reply(conn, status, [json.dumps(info).encode()])
-            return
+            return status, [json.dumps(info).encode()]
         if op == DSVC_CLAIM_SPLIT:
             status, info = self._handle_claim(a, b)
             if status == OK and info.get("num_batches") is None:
                 info["num_batches"] = self._num_batches(b)
-            self._reply(conn, status, [json.dumps(info).encode()])
-            return
+            return status, [json.dumps(info).encode()]
         if op == DSVC_GET_BATCH:
             if not (0 <= a < len(self._splits)):
-                self._reply(conn, ERR, None)
-                return
+                return ERR, None
             if name:
                 with self._lock:
                     self._last_seen[int(name)] = time.monotonic()
                     self._stale_members.discard(int(name))
             batches = self._split_batches(a)
             if b >= len(batches) or b < 0:
-                self._reply(conn, END_OF_SPLIT, None)
-                return
+                return END_OF_SPLIT, None
             with self._lock:
                 self._batches_served += 1
-            self._reply(conn, OK, batches[b])
-            return
+            return OK, batches[b]
         if op == DSVC_HEARTBEAT:
             with self._lock:
                 self._last_seen[a] = time.monotonic()
                 self._stale_members.discard(a)
                 epoch = self._epoch
-            self._reply(conn, epoch, None)
-            return
+            return epoch, None
         if op == DSVC_STATS:
-            self._reply(conn, OK, [json.dumps(self.stats()).encode()])
-            return
+            return OK, [json.dumps(self.stats()).encode()]
         if op == DSVC_GET_EVAL:
             if self._eval_chunk is None:
-                self._reply(conn, END_OF_SPLIT, None)
-            else:
-                self._reply(conn, OK, encode_batch(self._eval_chunk))
-            return
+                return END_OF_SPLIT, None
+            return OK, encode_batch(self._eval_chunk)
         if op == DSVC_SHUTDOWN:
             self.shutdown_requested.set()
-            self._reply(conn, OK, None)
-            return
-        self._reply(conn, ERR, None)
+            return OK, None
+        return ERR, None
 
 
 # ----------------------------------------------------------------------------
@@ -730,7 +645,7 @@ class DataServiceClient:
         server incarnation and runs the reincarnation callbacks."""
         status, raw = self._attempt(DSVC_REGISTER, name=self.role, a=self.worker_id)
         if status != OK:
-            raise DSVCError(f"register rejected: {status}")
+            raise self.rejected_error("register", status)
         info = json.loads(raw)
         changed = (
             self.incarnation is not None
@@ -824,6 +739,14 @@ class DataServiceClient:
             except OSError:
                 self._sever()
                 continue
+            except DSVCRejectedError:
+                # The server ANSWERED and refused (a deterministic
+                # register rejection): the transport is healthy and
+                # every retry would be refused the same way — re-raise
+                # instead of burning the whole reconnect budget to
+                # report the service "unreachable" (the exact failure
+                # mode the typed rejection exists to prevent).
+                raise
             except DSVCError:
                 # A callback's single-attempt op hit a transport fault: same
                 # as a raw drop — sever, retry, same deadline.  (A HELLO
@@ -863,6 +786,20 @@ class DataServiceClient:
 
     # -- convenience ops -----------------------------------------------------
 
+    @staticmethod
+    def rejected_error(what: str, status: int) -> DSVCError:
+        """The ONE typed-error path for server-side rejections (r17):
+        every negative answer a caller cannot act on maps to
+        :class:`DSVCRejectedError`, with the core's generic handler-
+        failure band (``ERR``) named explicitly — the server logged the
+        traceback; the client's job is only to say WHERE to look."""
+        if status == ERR:
+            return DSVCRejectedError(
+                f"{what} failed server-side (ERR: handler error — see the "
+                "data server's log)"
+            )
+        return DSVCRejectedError(f"{what} rejected: status {status}")
+
     def heartbeat(self) -> int:
         status, _ = self.call(DSVC_HEARTBEAT, a=self.worker_id)
         return status
@@ -870,7 +807,7 @@ class DataServiceClient:
     def stats(self) -> dict:
         status, raw = self.call(DSVC_STATS)
         if status != OK:
-            raise DSVCError(f"stats rejected: {status}")
+            raise self.rejected_error("stats", status)
         return json.loads(raw)
 
     def shutdown_server(self) -> None:
@@ -988,7 +925,7 @@ class RemoteDatasetSource:
         if status == END_OF_SPLIT:
             return None
         if status != OK:
-            raise DSVCError(f"get_eval rejected: {status}")
+            raise DataServiceClient.rejected_error("get_eval", status)
         return payload
 
     def close(self) -> None:
@@ -1064,7 +1001,7 @@ class RemoteDatasetSource:
                     self._epoch = server_epoch
                     continue
                 return None, 0
-            raise DSVCError(f"get_split rejected: {status}")
+            raise DataServiceClient.rejected_error("get_split", status)
 
     def _iter_batches(self, repeat: bool) -> Iterator[dict[str, np.ndarray]]:
         while True:
@@ -1089,8 +1026,8 @@ class RemoteDatasetSource:
                     self._cur = None
                     break
                 if status != OK or payload is None:
-                    raise DSVCError(
-                        f"get_batch({cur[0]},{cur[2]}) rejected: {status}"
+                    raise DataServiceClient.rejected_error(
+                        f"get_batch({cur[0]},{cur[2]})", status
                     )
                 if self._cur is cur:
                     cur[2] += 1

@@ -325,8 +325,14 @@ struct Server {
   // connection (param-store sets with their payload; tagged apply/push as
   // payload-less dedup/staleness mirrors), and makes a (re)start pull the
   // peer's full state via REPL_SYNC before serving.
+  // Peer identity.  peer_host is written/read under fwd_mu (off-fwd_mu
+  // readers — the resync path — SNAPSHOT it under fwd_mu first);
+  // peer_port is atomic because the lock-free `peer_port > 0`
+  // replication checks on every connection thread and the STATS
+  // snapshot race the late ps_server_set_peer wiring, and a hot-path
+  // lock just for that boolean would convoy every request.
   std::string peer_host;
-  int peer_port = 0;
+  std::atomic<int> peer_port{0};
   // State token: the state-LINEAGE id.  Fresh-random on a cold (empty)
   // start, INHERITED from the peer on a successful REPL_SYNC — so "token
   // unchanged" tells a reconnecting client its shard's state survived
@@ -544,8 +550,13 @@ void sever_fwd_locked(Server* s) {
 
 // Dial the peer and complete a repl-flagged HELLO.  Returns the connected
 // fd (>= 0), or -(FwdResult) on failure.  Bounded: connect/IO time out so
-// a wedged peer can never strand a serving thread.
-int dial_peer(const Server* s, int timeout_ms) {
+// a wedged peer can never strand a serving thread.  The peer address is
+// an explicit SNAPSHOT parameter: callers off the fwd_mu path (resync)
+// must copy host+port under fwd_mu first, so a concurrent
+// ps_server_set_peer can neither race the std::string read nor hand a
+// torn host/port pair.
+int dial_peer(const Server* s, const std::string& peer_host, int peer_port,
+              int timeout_ms) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -FWD_PEER_DOWN;
   timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
@@ -555,8 +566,8 @@ int dial_peer(const Server* s, int timeout_ms) {
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_port = htons(static_cast<uint16_t>(s->peer_port));
-  if (inet_pton(AF_INET, s->peer_host.c_str(), &addr.sin_addr) != 1 ||
+  addr.sin_port = htons(static_cast<uint16_t>(peer_port));
+  if (inet_pton(AF_INET, peer_host.c_str(), &addr.sin_addr) != 1 ||
       ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
     ::close(fd);
     return -FWD_PEER_DOWN;
@@ -599,7 +610,7 @@ int ensure_fwd(Server* s) {
   const auto now = std::chrono::steady_clock::now();
   if (now < s->fwd_next_try)
     return s->fwd_last_fail ? s->fwd_last_fail : FWD_PEER_DOWN;
-  int r = dial_peer(s, 5000);
+  int r = dial_peer(s, s->peer_host, s->peer_port, 5000);
   if (r >= 0) {
     s->fwd_fd = r;
     s->fwd_last_fail = 0;
@@ -889,7 +900,18 @@ bool sync_from_peer(Server* s, int64_t budget_ms) {
   const auto t_end = std::chrono::steady_clock::now() +
                      std::chrono::milliseconds(budget_ms);
   for (;;) {
-    int fd = dial_peer(s, 5000);
+    // Snapshot the peer identity under fwd_mu each round: a concurrent
+    // ps_server_set_peer retarget must never be read as a torn
+    // host/port pair (or race the std::string mutation).
+    std::string peer_host;
+    int peer_port;
+    {
+      std::lock_guard<std::mutex> fl(s->fwd_mu);
+      peer_host = s->peer_host;
+      peer_port = s->peer_port;
+    }
+    if (peer_port <= 0) return false;
+    int fd = dial_peer(s, peer_host, peer_port, 5000);
     if (fd >= 0) {
       uint8_t req[2 + 8 + 8 + 4] = {};
       req[0] = REPL_SYNC;

@@ -1,0 +1,775 @@
+"""dtxcore — the unified async server runtime (r17 tentpole).
+
+Before this module every host service ran its own hand-rolled
+thread-per-connection server: the native PS (``native/ps_server.cc``), the
+data service (``data/data_service.py``) and the serving replicas
+(``serve/model_server.py``) each re-implemented accept loops, HELLO
+answer/reject paths, STATS plumbing, request-counter exclusion and
+graceful stop — and every idle connection pinned a handler thread.  The
+TensorFlow architecture paper (PAPERS.md, arxiv 1605.08695) runs every
+session type on ONE runtime; ``parallel/wire.py`` already unified the
+framing half of that story.  This module finishes the server half for the
+Python services:
+
+- **Readiness-driven I/O** — one selector thread (epoll/kqueue via
+  :mod:`selectors`) owns every socket: it accepts, reads request frames
+  incrementally (the shared ``wire.py`` frame layout, parsed by an
+  allocation-light state machine instead of blocking ``recv_exact``
+  calls), and flushes buffered responses.  256 idle connections cost 256
+  file descriptors and nothing else — no thread, no stack, no scheduler
+  pressure.
+- **Connection registry** — every live connection is a :class:`CoreConn`
+  with its own parse state and write buffer; ``live_conns`` is a real
+  count, not a best-effort list the handler threads race to maintain.
+- **Bounded handler pool** — complete frames dispatch to a fixed worker
+  pool (``workers=``).  Handlers return the reply (or go async via
+  :data:`ASYNC` + :meth:`CoreConn.reply` for work that completes on
+  another thread, e.g. the serve micro-batcher), so concurrency is
+  bounded by the pool, never by the connection count.
+- **Per-connection write buffering** — replies are queued on the
+  connection and flushed by the selector as the peer drains them.  A
+  slow or stalled reader accumulates bytes, it never wedges a handler
+  thread in ``sendall``; a peer holding more than
+  ``max_buffered_bytes`` that has also drained NOTHING for
+  ``slow_reader_grace_s`` is dropped (progress-gated, so one
+  legitimately large reply streaming to a healthy reader is never cut).
+- **Per-service handler table keyed off the HELLO service tag** — a core
+  hosts one or more services; the client's announced service identity
+  (``wire.pack_hello_b(service=...)``) routes the connection, and every
+  wrong-service dial is refused through the one shared
+  ``wire.hello_answer`` path, naming what was actually reached.
+- **Uniform accounting** — the request counter (the ``die:after_reqs``
+  fault trigger and an exported metric) lives HERE, excluding
+  control-plane ops from the one ``wire.CONTROL_OPS`` registry (each
+  service passes its derived frozenset), plus an optional per-service
+  ``counts_fn`` for rules an op code alone cannot carry (the dsvc
+  negative-id REGISTER probe).  One STATS shape: every service folds
+  :meth:`ServerCore.core_stats` into its scrape, so ``requests`` /
+  ``live_conns`` mean the same thing on every wire (the native PS keeps
+  its C++ loop but answers the same shape — asserted by test).
+- **Hardened accept path** — transient ``ECONNABORTED`` is skipped;
+  descriptor exhaustion (``EMFILE``/``ENFILE``) logs, backs off and
+  resumes — it never kills the listener.
+- **Graceful drain** — :meth:`drain` stops accepting, lets dispatched
+  handlers finish and write buffers flush, then :meth:`stop` closes;
+  zero in-flight requests are dropped on a clean shutdown.
+
+The native PS keeps its C++ thread-per-connection loop (its handlers are
+microseconds of mutex-guarded C++, not milliseconds of Python, so the
+thread count is a non-issue there); this module is the single Python
+definition of server behavior, and the cross-service tests pin the C++
+side to the same observable semantics.
+"""
+
+from __future__ import annotations
+
+import errno
+import logging
+import queue
+import selectors
+import socket
+import struct
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+from . import wire
+
+log = logging.getLogger("dtx.server_core")
+
+#: Sentinel a handler returns when it will reply later (from another
+#: thread) via :meth:`CoreConn.reply` — the batcher-callback shape.
+ASYNC = object()
+
+#: accept() errnos that are per-connection transients: the aborted peer is
+#: gone, the listener is fine — skip and keep accepting.
+_ACCEPT_TRANSIENT = {errno.ECONNABORTED, errno.EINTR, errno.EPROTO, errno.EPERM}
+
+#: Upper bound on one request frame (name + payload); a frame announcing
+#: more than this is a corrupt/malicious peer and the connection drops.
+MAX_FRAME_BYTES = 1 << 30
+
+
+class Service:
+    """One entry in the core's handler table.
+
+    ``handler(conn, op, name, a, b, payload) -> (status, bufs) | ASYNC``
+    runs on a pool worker; ``payload`` is the request's raw payload as a
+    bytes-like buffer (empty when none; treat it as read-only).
+    Returning :data:`ASYNC` means the handler handed the frame to
+    another thread which will call ``conn.reply`` exactly once.
+
+    ``control_ops``   op codes excluded from the request counter — derive
+                      it from ``wire.CONTROL_OPS`` (the one registry; the
+                      dtxlint control pass pins the derivation sites).
+    ``counts_fn``     optional extra exclusion an op code cannot express
+                      (``fn(op, name, a, b) -> bool``; False = uncounted).
+    ``error_status``  the status replied when a handler raises.
+    ``accept_dtypes`` HELLO dtype codes this service negotiates.
+    ``max_payload``   per-service request-payload bound, checked the
+                      moment a frame HEADER completes — an announced
+                      payload past it drops the connection BEFORE any
+                      byte of it is buffered, so a bogus length costs
+                      nothing (size it to the service's real needs:
+                      small for payload-less wires like dsvc, batch-
+                      sized for predict).
+    """
+
+    __slots__ = (
+        "name", "handler", "control_ops", "counts_fn", "error_status",
+        "accept_dtypes", "max_payload", "on_disconnect",
+    )
+
+    def __init__(
+        self, name: str, handler: Callable, *,
+        control_ops: frozenset[int] = frozenset(),
+        counts_fn: Callable | None = None, error_status: int = -2,
+        accept_dtypes: tuple[int, ...] = (0,),
+        max_payload: int = MAX_FRAME_BYTES,
+        on_disconnect: Callable | None = None,
+    ):
+        if name not in wire.SERVICE_IDS:
+            raise ValueError(
+                f"unknown service {name!r} (wire.SERVICE_IDS has "
+                f"{sorted(wire.SERVICE_IDS)})"
+            )
+        self.name = name
+        self.handler = handler
+        self.control_ops = frozenset(control_ops)
+        self.counts_fn = counts_fn
+        self.error_status = error_status
+        self.accept_dtypes = tuple(accept_dtypes)
+        self.max_payload = min(int(max_payload), MAX_FRAME_BYTES)
+        self.on_disconnect = on_disconnect
+
+
+class CoreConn:
+    """One live connection: parse state + write buffer + identity."""
+
+    __slots__ = (
+        "core", "sock", "fd", "service", "rbuf", "pending", "pbuf", "pfill",
+        "out", "out_bytes", "in_flight", "closed", "events", "peer",
+        "last_progress",
+    )
+
+    def __init__(self, core: "ServerCore", sock: socket.socket, service):
+        self.core = core
+        self.sock = sock
+        self.fd = sock.fileno()
+        self.service = service  # Service | None (resolved at HELLO)
+        self.rbuf = bytearray()
+        # Mid-payload parse state: once a frame HEADER completes, the
+        # payload fills a dedicated preallocated buffer — the bulk is
+        # recv_into'd straight into it (one copy, no rbuf growth, no
+        # re-copy on the selector thread).
+        self.pending = None  # (op, name, a, b) awaiting its payload
+        self.pbuf: bytearray | None = None
+        self.pfill = 0
+        self.out: deque = deque()  # memoryviews awaiting the selector flush
+        self.out_bytes = 0
+        self.in_flight = False  # a dispatched frame awaiting its reply
+        self.closed = False
+        self.events = 0  # selector interest currently registered
+        self.last_progress = time.monotonic()  # last byte the peer drained
+        try:
+            self.peer = sock.getpeername()
+        except OSError:
+            self.peer = ("?", 0)
+
+    def reply(self, status: int, bufs: list | None = None) -> None:
+        """Queue one response frame (thread-safe; callable from any
+        thread).  The selector thread flushes it as the peer drains —
+        the caller NEVER blocks on the peer's read speed."""
+        views = wire.frames_to_views([
+            wire.RESP_HDR.pack(status, wire.encoded_nbytes(bufs or [])),
+            *(bufs or []),
+        ])
+        total = sum(len(v) for v in views)
+        core = self.core
+        with core._lock:
+            if self.closed:
+                return
+            self.out.extend(views)
+            self.out_bytes += total
+            self.in_flight = False
+        core._dirty.put(self)
+        core._wake()
+
+
+class ServerCore:
+    """The selector-driven server runtime.  Construct, :meth:`add_service`,
+    :meth:`start`; tear down with :meth:`stop` (drains first)."""
+
+    def __init__(
+        self, *, port: int = 0, loopback_only: bool = True,
+        workers: int = 8, backlog: int = 128, name: str = "core",
+        accept_backoff_s: float = 0.2, max_buffered_bytes: int = 256 << 20,
+        slow_reader_grace_s: float = 30.0, bind_retry_s: float = 5.0,
+    ):
+        self.name = name
+        self._services: dict[str, Service] = {}
+        self._default: Service | None = None
+        self._n_workers = max(1, int(workers))
+        self._accept_backoff_s = accept_backoff_s
+        self._max_buffered = int(max_buffered_bytes)
+        self._slow_grace_s = float(slow_reader_grace_s)
+        self._next_slow_sweep = 0.0
+        self._lock = threading.Lock()
+        self._requests = 0
+        self._accepts = 0
+        self._accept_errors = 0
+        self._dispatched = 0
+        self._handler_errors = 0
+        self._dropped_slow = 0
+        self._conns: dict[int, CoreConn] = {}
+        self._dirty: queue.SimpleQueue = queue.SimpleQueue()
+        self._tasks: queue.SimpleQueue = queue.SimpleQueue()
+        self._stop_flag = False
+        self._draining = False
+        self._listener_retired = False
+        self._accept_paused_until: float | None = None
+        self._started = False
+        self._sel = selectors.DefaultSelector()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # A supervised restart rebinds the dead incarnation's FIXED port;
+        # lingering sockets can hold it briefly — retry within a short
+        # window instead of failing the healing restart (the same posture
+        # every pre-core server took).
+        bind_deadline = time.monotonic() + (bind_retry_s if port else 0.0)
+        while True:
+            try:
+                self._listener.bind(("127.0.0.1" if loopback_only else "", port))
+                break
+            except OSError:
+                if time.monotonic() >= bind_deadline:
+                    self._listener.close()
+                    self._wake_r.close()
+                    self._wake_w.close()
+                    raise
+                time.sleep(0.2)
+        self._listener.listen(backlog)
+        self._listener.setblocking(False)
+        self.port = self._listener.getsockname()[1]
+        self._threads: list[threading.Thread] = []
+
+    # -- wiring ---------------------------------------------------------------
+
+    def add_service(self, service: Service, *, default: bool = False) -> None:
+        if self._started:
+            raise RuntimeError("add_service before start()")
+        self._services[service.name] = service
+        if default or self._default is None:
+            self._default = service
+
+    def start(self) -> "ServerCore":
+        if not self._services:
+            raise RuntimeError("ServerCore needs at least one service")
+        self._started = True
+        self._sel.register(self._listener, selectors.EVENT_READ, "accept")
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        t = threading.Thread(
+            target=self._select_loop, daemon=True, name=f"dtx-{self.name}-io"
+        )
+        t.start()
+        self._threads.append(t)
+        for i in range(self._n_workers):
+            w = threading.Thread(
+                target=self._worker, daemon=True,
+                name=f"dtx-{self.name}-w{i}",
+            )
+            w.start()
+            self._threads.append(w)
+        log.info(
+            "%s core on port %d (%d services, %d workers)",
+            self.name, self.port, len(self._services), self._n_workers,
+        )
+        return self
+
+    # -- accounting -----------------------------------------------------------
+
+    def request_count(self) -> int:
+        """Counted (data-plane) requests so far — the ``die:after_reqs``
+        fault trigger, same contract as the native PS server's counter."""
+        with self._lock:
+            return self._requests
+
+    def live_conns(self) -> int:
+        with self._lock:
+            return len(self._conns)
+
+    def core_stats(self) -> dict:
+        """The uniform runtime-accounting shape every service's STATS
+        answer folds in (one definition of what the counters mean)."""
+        with self._lock:
+            return {
+                "requests": self._requests,
+                "live_conns": len(self._conns),
+                "accepts": self._accepts,
+                "accept_errors": self._accept_errors,
+                "dispatched": self._dispatched,
+                "handler_errors": self._handler_errors,
+                "dropped_slow_readers": self._dropped_slow,
+                "worker_threads": self._n_workers,
+                "dispatch_depth": self._tasks.qsize(),
+                "draining": 1 if self._draining else 0,
+            }
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"x")
+        except (BlockingIOError, OSError):
+            pass  # pipe already full: the selector is waking anyway
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """Stop accepting, let dispatched handlers finish and response
+        buffers flush.  True when everything in flight completed inside
+        the window — the zero-dropped-requests graceful half of stop."""
+        self._draining = True
+        self._wake()
+        t_end = time.monotonic() + timeout_s
+        while time.monotonic() < t_end:
+            with self._lock:
+                busy = any(
+                    c.in_flight or c.out for c in self._conns.values()
+                )
+            if (
+                not busy
+                and self._tasks.qsize() == 0
+                and (self._listener_retired or not self._started)
+            ):
+                return True
+            time.sleep(0.01)
+        return False
+
+    def stop(self, drain_s: float = 5.0) -> None:
+        """Drain (bounded), then tear the runtime down and release the
+        port before returning."""
+        if self._started:
+            self.drain(drain_s)
+        self._stop_flag = True
+        self._draining = True
+        self._wake()
+        io_thread = self._threads[0] if self._threads else None
+        if io_thread is not None:
+            io_thread.join(timeout=5.0)
+        for _ in range(self._n_workers):
+            self._tasks.put(None)
+        for t in self._threads[1:]:
+            t.join(timeout=5.0)
+        # Single-threaded from here: close every socket and the listener.
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            c.closed = True
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+        # shutdown() BEFORE close(): close alone does not free the kernel
+        # socket while another thread is mid-syscall on it, which would
+        # leave the port unavailable to a same-port supervised restart.
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        try:
+            self._sel.close()
+        except OSError:
+            pass
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    # -- the selector loop ----------------------------------------------------
+
+    def _select_loop(self) -> None:
+        while not self._stop_flag:
+            timeout = 0.5
+            if self._accept_paused_until is not None:
+                now = time.monotonic()
+                if now >= self._accept_paused_until:
+                    self._accept_paused_until = None
+                    if not self._draining:
+                        try:
+                            self._sel.register(
+                                self._listener, selectors.EVENT_READ, "accept"
+                            )
+                        except (KeyError, ValueError, OSError):
+                            pass
+                else:
+                    timeout = min(timeout, self._accept_paused_until - now)
+            try:
+                events = self._sel.select(timeout)
+            except OSError:
+                continue
+            for key, mask in events:
+                tag = key.data
+                if tag == "accept":
+                    if self._draining:
+                        self._retire_listener()
+                    else:
+                        self._do_accept()
+                elif tag == "wake":
+                    self._drain_wake()
+                else:
+                    conn: CoreConn = tag
+                    if mask & selectors.EVENT_READ:
+                        self._do_read(conn)
+                    if mask & selectors.EVENT_WRITE and not conn.closed:
+                        self._do_write(conn)
+            self._process_dirty()
+            self._sweep_slow_readers()
+            if self._draining:
+                self._retire_listener()
+
+    def _unregister_listener(self) -> None:
+        try:
+            self._sel.unregister(self._listener)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    def _retire_listener(self) -> None:
+        """Drain half of shutdown: actually CLOSE the listener (an
+        unregister alone leaves the kernel completing handshakes into the
+        backlog), so new connections are refused while in-flight work
+        finishes.  Idempotent; runs on the selector thread."""
+        if self._listener_retired:
+            return
+        self._listener_retired = True
+        self._unregister_listener()
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def _drain_wake(self) -> None:
+        try:
+            while self._wake_r.recv(4096):
+                pass
+        except (BlockingIOError, OSError):
+            pass
+
+    def _process_dirty(self) -> None:
+        """Connections whose reply() landed since the last pass: flush
+        eagerly, update interest, and parse any already-buffered next
+        frame (the peer may have pipelined)."""
+        while True:
+            try:
+                conn = self._dirty.get_nowait()
+            except queue.Empty:
+                return
+            if conn.closed:
+                continue
+            self._do_write(conn)
+            if not conn.closed:
+                self._pump(conn)
+
+    # -- accept ---------------------------------------------------------------
+
+    def _do_accept(self) -> None:
+        for _ in range(64):  # bounded per event: reads must not starve
+            try:
+                sock, _ = self._listener.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError as e:
+                if self._stop_flag or self._draining:
+                    return
+                with self._lock:
+                    self._accept_errors += 1
+                if e.errno in _ACCEPT_TRANSIENT:
+                    # The aborted peer is gone; the listener is fine.
+                    continue
+                # EMFILE/ENFILE/ENOBUFS/ENOMEM (or anything unexpected):
+                # resource exhaustion.  Back off and resume — the one
+                # thing the accept path must never do is die and leave a
+                # healthy service unreachable forever.
+                log.warning(
+                    "%s core: accept failed (%s) — backing off %.1fs, "
+                    "listener stays up",
+                    self.name, e, self._accept_backoff_s,
+                )
+                self._unregister_listener()
+                self._accept_paused_until = (
+                    time.monotonic() + self._accept_backoff_s
+                )
+                return
+            try:
+                sock.setblocking(False)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                sock.close()
+                continue
+            conn = CoreConn(
+                self, sock,
+                self._default if len(self._services) == 1 else None,
+            )
+            with self._lock:
+                self._conns[conn.fd] = conn
+                self._accepts += 1
+            self._sel.register(sock, selectors.EVENT_READ, conn)
+            conn.events = selectors.EVENT_READ
+
+    # -- read / parse / dispatch ----------------------------------------------
+
+    def _do_read(self, conn: CoreConn) -> None:
+        if conn.pbuf is not None and conn.pfill < len(conn.pbuf):
+            # Bulk payload path: straight into the frame's preallocated
+            # buffer — one kernel-to-user copy, nothing staged in rbuf,
+            # trailing pipelined bytes stay in the kernel for later.
+            try:
+                n = conn.sock.recv_into(memoryview(conn.pbuf)[conn.pfill :])
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self._close_conn(conn)
+                return
+            if not n:
+                self._close_conn(conn)
+                return
+            conn.pfill += n
+            self._pump(conn)
+            return
+        try:
+            data = conn.sock.recv(1 << 16)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._close_conn(conn)
+            return
+        if not data:
+            self._close_conn(conn)
+            return
+        conn.rbuf += data
+        self._pump(conn)
+
+    @staticmethod
+    def _parse_header(buf: bytearray, max_payload: int = MAX_FRAME_BYTES):
+        """One complete request HEADER from ``buf``, or None.  Returns
+        ``((op, name, a, b, plen), consumed)`` — the incremental twin of
+        ``wire.read_request``'s header half.  The payload bound is
+        enforced HERE, the moment the header completes, before any
+        payload byte would be buffered — an absurd announced length
+        never costs memory."""
+        if len(buf) < 2:
+            return None
+        nlen = buf[1]
+        hdr_end = 2 + nlen + wire.REQ_TAIL.size
+        if len(buf) < hdr_end:
+            return None
+        a, b, plen = wire.REQ_TAIL.unpack_from(buf, 2 + nlen)
+        if plen > max_payload:
+            raise ValueError(
+                f"frame announces {plen} payload bytes (bound {max_payload})"
+            )
+        name = bytes(buf[2 : 2 + nlen]).decode()
+        return (buf[0], name, a, b, plen), hdr_end
+
+    def _pump(self, conn: CoreConn) -> None:
+        """Parse + dispatch frames from the connection's read buffer —
+        at most ONE frame in flight per connection (responses stay in
+        request order; a peer that pipelines is back-pressured)."""
+        while not conn.in_flight and not conn.closed:
+            svc = conn.service or self._default
+            if conn.pending is None:
+                try:
+                    got = self._parse_header(conn.rbuf, svc.max_payload)
+                except (ValueError, struct.error, UnicodeDecodeError):
+                    self._close_conn(conn)
+                    return
+                if got is None:
+                    break
+                (op, name, a, b, plen), consumed = got
+                del conn.rbuf[:consumed]
+                conn.pending = (op, name, a, b)
+                conn.pbuf = bytearray(plen)
+                conn.pfill = 0
+            # Whatever payload prefix already sits in rbuf moves over;
+            # the rest arrives via the direct recv_into path above.
+            need = len(conn.pbuf) - conn.pfill
+            if need and conn.rbuf:
+                take = min(need, len(conn.rbuf))
+                conn.pbuf[conn.pfill : conn.pfill + take] = conn.rbuf[:take]
+                del conn.rbuf[:take]
+                conn.pfill += take
+            if conn.pfill < len(conn.pbuf):
+                break  # payload still in flight
+            op, name, a, b = conn.pending
+            payload = conn.pbuf
+            conn.pending, conn.pbuf, conn.pfill = None, None, 0
+            if op == wire.HELLO_OP:
+                self._handle_hello(conn, a, b)
+                continue
+            counted = op not in svc.control_ops and (
+                svc.counts_fn is None or svc.counts_fn(op, name, a, b)
+            )
+            with self._lock:
+                if counted:
+                    self._requests += 1
+                self._dispatched += 1
+                conn.in_flight = True
+            self._tasks.put((conn, svc, (op, name, a, b, payload)))
+        self._update_interest(conn)
+
+    def _handle_hello(self, conn: CoreConn, a: int, b: int) -> None:
+        """HELLO answered inline on the selector thread (no payload, no
+        handler work): the announced service identity routes the
+        connection through the handler table; every mismatch goes
+        through the one shared ``wire.hello_answer`` refusal."""
+        expected = wire.hello_expected_service(b)
+        svc = self._services.get(expected) or conn.service or self._default
+        status, tag = wire.hello_answer(
+            a, b, service=svc.name, accept_dtypes=svc.accept_dtypes
+        )
+        if status == wire.WIRE_VERSION:
+            conn.service = svc
+        conn.reply(status, [tag] if tag else None)
+
+    # -- write ----------------------------------------------------------------
+
+    def _do_write(self, conn: CoreConn) -> None:
+        while conn.out:
+            head = conn.out[0]
+            try:
+                n = conn.sock.send(head)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                self._close_conn(conn)
+                return
+            if n:
+                conn.last_progress = time.monotonic()
+            with self._lock:
+                conn.out_bytes -= n
+            if n < len(head):
+                conn.out[0] = head[n:]
+                break
+            conn.out.popleft()
+        self._update_interest(conn)
+
+    def _sweep_slow_readers(self) -> None:
+        """Drop peers that hold more than ``max_buffered_bytes`` of
+        undelivered response AND have drained nothing for
+        ``slow_reader_grace_s`` — a stalled scraper must not hold server
+        memory hostage (resilient clients reconnect).  The progress
+        condition is what distinguishes a stall from one legitimately
+        large reply streaming to a healthy reader: size alone must never
+        drop a connection the peer is actively draining."""
+        now = time.monotonic()
+        if now < self._next_slow_sweep:
+            return
+        self._next_slow_sweep = now + 1.0
+        with self._lock:
+            over = [
+                c for c in self._conns.values()
+                if c.out_bytes > self._max_buffered
+                and now - c.last_progress > self._slow_grace_s
+            ]
+        for conn in over:
+            log.warning(
+                "%s core: dropping %s — %d bytes buffered past the "
+                "%d-byte bound with no read progress for %.0fs",
+                self.name, conn.peer, conn.out_bytes, self._max_buffered,
+                now - conn.last_progress,
+            )
+            with self._lock:
+                self._dropped_slow += 1
+            self._close_conn(conn)
+
+    def _update_interest(self, conn: CoreConn) -> None:
+        if conn.closed:
+            return
+        want = 0
+        if not conn.in_flight:
+            want |= selectors.EVENT_READ
+        if conn.out:
+            want |= selectors.EVENT_WRITE
+        if want == conn.events:
+            return
+        try:
+            if conn.events == 0 and want:
+                self._sel.register(conn.sock, want, conn)
+            elif want == 0:
+                self._sel.unregister(conn.sock)
+            else:
+                self._sel.modify(conn.sock, want, conn)
+            conn.events = want
+        except (KeyError, ValueError, OSError):
+            self._close_conn(conn)
+
+    def _close_conn(self, conn: CoreConn) -> None:
+        if conn.closed:
+            return
+        conn.closed = True
+        with self._lock:
+            self._conns.pop(conn.fd, None)
+            conn.out.clear()
+            conn.out_bytes = 0
+        if conn.events:
+            try:
+                self._sel.unregister(conn.sock)
+            except (KeyError, ValueError, OSError):
+                pass
+            conn.events = 0
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        svc = conn.service or self._default
+        if svc is not None and svc.on_disconnect is not None:
+            try:
+                svc.on_disconnect(conn)
+            except Exception:  # noqa: BLE001 — a cleanup hook never kills I/O
+                log.exception("%s core: on_disconnect hook failed", self.name)
+
+    # -- the worker pool ------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            item = self._tasks.get()
+            if item is None:
+                return
+            conn, svc, (op, name, a, b, payload) = item
+            if conn.closed:
+                continue
+            try:
+                # The unpack and the reply encode stay INSIDE the guard:
+                # a malformed handler return (or a buffer reply() cannot
+                # encode) must answer the same loud per-op error — an
+                # escape here would kill the pool worker and wedge the
+                # connection in_flight forever.
+                out = svc.handler(conn, op, name, a, b, payload)
+                if out is ASYNC:
+                    continue
+                status, bufs = out
+                conn.reply(status, bufs)
+            except Exception:
+                # A handler bug must surface as a LOUD per-op error on
+                # the client, not a silent connection close the client
+                # burns its reconnect budget retrying (the shared posture
+                # all pre-core servers converged on).
+                log.exception(
+                    "%s core: %s op %d (%s) failed server-side",
+                    self.name, svc.name, op, name,
+                )
+                with self._lock:
+                    self._handler_errors += 1
+                conn.reply(svc.error_status, None)

@@ -512,10 +512,11 @@ def _byte_view(a: np.ndarray) -> np.ndarray:
     return a.reshape(-1).view(np.uint8)
 
 
-def send_frames(sock, bufs) -> None:
-    """Scatter/gather send of a buffer list via ``sendmsg`` — no buffer is
-    ever copied into a concatenated message.  Accepts ``bytes``,
-    ``memoryview`` and contiguous ndarrays (cast to byte views here)."""
+def frames_to_views(bufs) -> list:
+    """Normalize a mixed bytes/ndarray buffer list into non-empty byte
+    memoryviews — the ONE definition of the wire's outgoing buffer shape
+    (extension dtypes included, via :func:`_byte_view`), shared by
+    :func:`send_frames` and the server core's buffered reply path."""
     out = []
     for b in bufs:
         if isinstance(b, np.ndarray):
@@ -523,6 +524,14 @@ def send_frames(sock, bufs) -> None:
                 out.append(memoryview(_byte_view(b)))
         elif len(b):
             out.append(memoryview(b))
+    return out
+
+
+def send_frames(sock, bufs) -> None:
+    """Scatter/gather send of a buffer list via ``sendmsg`` — no buffer is
+    ever copied into a concatenated message.  Accepts ``bytes``,
+    ``memoryview`` and contiguous ndarrays (cast to byte views here)."""
+    out = frames_to_views(bufs)
     while out:
         sent = sock.sendmsg(out)
         while out and sent >= len(out[0]):
@@ -617,6 +626,37 @@ def _decode_dtype(spec: str) -> np.dtype:
         import ml_dtypes  # noqa: F401 — registers bfloat16/float8_* names
 
         return np.dtype(spec)
+
+
+def decode_batch_bytes(buf) -> dict[str, np.ndarray]:
+    """Inverse of :func:`encode_batch` over an in-memory buffer — the
+    server-core shape (r17): the readiness-driven runtime receives whole
+    request payloads off the selector, so handlers decode from bytes
+    instead of a socket.  Fields are zero-copy views into ``buf``
+    (read-only; callers that mutate copy their slice)."""
+    mv = memoryview(buf)
+    if len(mv) < 4:
+        raise ValueError("batch payload shorter than its schema header")
+    (mlen,) = struct.unpack("<I", mv[:4])
+    if 4 + mlen > len(mv):
+        raise ValueError("batch schema exceeds the framed payload")
+    consumed = 4 + mlen
+    out: dict[str, np.ndarray] = {}
+    for f in json.loads(bytes(mv[4:consumed])):
+        dt = _decode_dtype(f["dtype"])
+        count = int(np.prod(f["shape"], dtype=np.int64))
+        nbytes = count * dt.itemsize
+        if consumed + nbytes > len(mv):
+            raise ValueError("batch field exceeds the framed payload")
+        out[f["name"]] = np.frombuffer(
+            mv, dtype=dt, count=count, offset=consumed
+        ).reshape(f["shape"])
+        consumed += nbytes
+    if consumed != len(mv):
+        raise ValueError(
+            f"batch framing mismatch: {consumed} consumed != {len(mv)} framed"
+        )
+    return out
 
 
 def read_batch(sock, nbytes: int) -> dict[str, np.ndarray]:

@@ -23,11 +23,14 @@ server plugs in the padded jitted apply.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
 
 from ..utils import telemetry
+
+log = logging.getLogger("dtx.serve")
 
 
 class Overloaded(RuntimeError):
@@ -40,7 +43,10 @@ class Ticket:
     containing it was applied, then returns this request's slice (or
     re-raises the batch's error on the submitting side)."""
 
-    __slots__ = ("rows", "key", "_event", "_value", "_error")
+    __slots__ = (
+        "rows", "key", "_event", "_value", "_error", "_callback",
+        "_cb_lock", "_resolved",
+    )
 
     def __init__(self, rows: int, key=None):
         self.rows = rows
@@ -48,10 +54,51 @@ class Ticket:
         self._event = threading.Event()
         self._value = None
         self._error: BaseException | None = None
+        self._callback = None
+        self._cb_lock = threading.Lock()
+        self._resolved = False
 
     def _resolve(self, value=None, error: BaseException | None = None) -> None:
-        self._value, self._error = value, error
+        """First resolution wins; later calls are no-ops — that
+        idempotence is what makes an external timeout sweep (the model
+        server's wedged-apply backstop) safe against the genuine
+        resolution racing in late."""
+        with self._cb_lock:
+            if self._resolved:
+                return
+            self._resolved = True
+            self._value, self._error = value, error
+            cb, self._callback = self._callback, None
         self._event.set()
+        if cb is not None:
+            self._run_callback(cb)
+
+    def _run_callback(self, cb) -> None:
+        """A consumer callback must never kill the RESOLVING thread — an
+        exception out of it would take down the batch thread (every
+        later predict hangs) or, on the synchronous register path, make
+        the core's worker send a SECOND error frame after the callback
+        already replied.  Contain it here, loudly."""
+        try:
+            cb(self._value, self._error)
+        except Exception:
+            log.exception("ticket on_resolve callback failed")
+
+    def on_resolve(self, fn) -> None:
+        """Register ``fn(value, error)`` to run when the batch containing
+        this ticket resolves (on the resolving thread) — the async-reply
+        hook the server core's bounded worker pool uses instead of
+        parking a thread in :meth:`result`.  A ticket that already
+        resolved calls ``fn`` immediately.  The register/resolve handoff
+        is lock-guarded so ``fn`` runs EXACTLY once no matter how the
+        two threads interleave (a double invocation would queue two
+        response frames for one request and desynchronize the
+        connection)."""
+        with self._cb_lock:
+            if not self._resolved:
+                self._callback = fn
+                return
+        self._run_callback(fn)
 
     def result(self, timeout_s: float | None = None):
         if not self._event.wait(timeout_s):

@@ -31,7 +31,9 @@ import numpy as np
 
 from ..parallel import wire
 from ..utils import faults, telemetry
-from .model_server import NO_MODEL, OVERLOAD, SRV_PREDICT, SRV_SHUTDOWN, SRV_STATS
+from .model_server import (
+    ERR, NO_MODEL, OVERLOAD, SRV_PREDICT, SRV_SHUTDOWN, SRV_STATS,
+)
 
 
 class ServeError(RuntimeError):
@@ -224,12 +226,26 @@ class ServeClient:
             raise ServeUnavailableError(
                 f"replica {self._host}:{self._port} has no model yet"
             )
+        if status == ERR:
+            # The server core's loud handler-failure band (r17): the
+            # replica answered — an apply/handler exception server-side,
+            # not a transport fault — so the typed rejection names where
+            # the traceback lives instead of reading as "bad status -2".
+            raise ServeRejectedError(
+                "predict failed server-side (ERR: apply/handler error — "
+                "see the replica's log)"
+            )
         if status < 0 or out is None:
             raise ServeRejectedError(f"predict rejected: {status}")
         return status, out
 
     def stats(self) -> dict:
         status, raw = self.call(SRV_STATS)
+        if status == ERR:
+            raise ServeRejectedError(
+                "stats failed server-side (ERR: handler error — see the "
+                "replica's log)"
+            )
         if status != 0 or raw is None:
             raise ServeRejectedError(f"stats rejected: {status}")
         return json.loads(raw)

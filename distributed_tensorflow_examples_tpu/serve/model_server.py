@@ -44,13 +44,12 @@ from __future__ import annotations
 import json
 import logging
 import os
-import socket
 import threading
 import time
 
 import numpy as np
 
-from ..parallel import ps_shard, wire
+from ..parallel import ps_shard, server_core, wire
 from ..utils import faults, telemetry
 from ..utils.metrics import LatencyRecorder, MetricsWriter
 from . import batcher as batcher_lib
@@ -121,6 +120,7 @@ class ModelReplicaServer:
         membership: bool = True, lease_ttl_s: float = 10.0,
         advertise_addr: str | None = None, ps_replicas: int = 1,
         layout_version: int = 0, follow_reshard: bool = True,
+        handler_workers: int = 8,
     ):
         import jax
 
@@ -164,7 +164,16 @@ class ModelReplicaServer:
         self._model: tuple[int, object] | None = None
         self._incarnation = int.from_bytes(os.urandom(4), "little") | 1
         self._lock = threading.Lock()
-        self._requests = 0
+        # The wedged-apply backstop (the 120 s bound the old blocking
+        # path got from ticket.result): in-flight predict tickets are
+        # tracked with a deadline and the refresher thread sweeps
+        # overdue ones, resolving them with TimeoutError — the resolve
+        # callback then answers a loud ERR and frees the connection.
+        # Ticket resolution is idempotent, so a genuine late resolve
+        # racing the sweep is harmless.  No extra thread, no per-request
+        # timer: bounded threads stay bounded.
+        self._ticket_deadline_s = 120.0
+        self._pending_tickets: dict = {}  # ticket -> deadline (monotonic)
         self._predicts = 0
         self._refreshes = 0
         self._refresh_errors = 0
@@ -178,23 +187,26 @@ class ModelReplicaServer:
         )
         self._stop = threading.Event()
         self.shutdown_requested = threading.Event()
-        self._conns: list[socket.socket] = []
-        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        bind_deadline = time.monotonic() + (5.0 if port else 0.0)
-        while True:
-            try:
-                self._listener.bind(("127.0.0.1" if loopback_only else "", port))
-                break
-            except OSError:
-                # A supervised restart rebinds the dead incarnation's FIXED
-                # port; lingering sockets can hold it briefly — retry within
-                # a short window instead of failing the healing restart.
-                if time.monotonic() >= bind_deadline:
-                    raise
-                time.sleep(0.2)
-        self._listener.listen(64)
-        self.port = self._listener.getsockname()[1]
+        # The shared server runtime (r17): selector-driven I/O, bounded
+        # handler pool, per-connection write buffering, HELLO routing and
+        # the request counter live in parallel/server_core.py.  PREDICT
+        # goes ASYNC through the batcher's resolve callback, so the pool
+        # never parks a thread per in-flight predict — concurrency is
+        # bounded by the batcher's admission control, not by threads.
+        self._core = server_core.ServerCore(
+            port=port, loopback_only=loopback_only, name="msrv",
+            workers=handler_workers,
+        )
+        self._core.add_service(server_core.Service(
+            SERVICE, self._handle,
+            control_ops=_SRV_CONTROL_OPS,
+            error_status=ERR,
+            # PREDICT batches are the only request payloads; bound them
+            # at the write-buffer bound rather than the frame ceiling.
+            max_payload=256 << 20,
+        ))
+        self._core.start()
+        self.port = self._core.port
         # Membership (r14): announce this replica — WITH its dialable
         # address — in the coordinator's lease registry, so an elastic
         # serve pool (and dtxtop) discovers dynamically-started replicas
@@ -214,10 +226,6 @@ class ModelReplicaServer:
             target=self._refresh_loop, daemon=True, name="msrv-refresh"
         )
         self._refresher.start()
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True, name="msrv-accept"
-        )
-        self._accept_thread.start()
         log.info(
             "model replica %s serving on port %d (%d PS shard(s), "
             "max_batch=%d, incarnation %d)",
@@ -229,8 +237,10 @@ class ModelReplicaServer:
 
     def request_count(self) -> int:
         """Requests handled so far — the ``die:after_reqs`` fault trigger
-        for a serve task (same contract as the PS / data servers)."""
-        return self._requests
+        for a serve task (same contract as the PS / data servers).  The
+        counter lives in the server core, which excludes the control-plane
+        ops (wire.CONTROL_OPS)."""
+        return self._core.request_count()
 
     @property
     def model_step(self) -> int:
@@ -258,26 +268,11 @@ class ModelReplicaServer:
             self._heartbeat.close()
             self._heartbeat = None
         self._stop.set()
-        # shutdown() BEFORE close(): close alone does not free the port
-        # while the accept thread is blocked in accept() (same reasoning as
-        # DataServiceServer.stop).
-        try:
-            self._listener.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._listener.close()
-        except OSError:
-            pass
-        self._accept_thread.join(timeout=5.0)
+        # The core drains first (in-flight predicts resolve and their
+        # buffered responses flush) and releases the port before
+        # returning — the zero-dropped-requests half of a scale-down.
+        self._core.stop()
         self._refresher.join(timeout=5.0)
-        with self._lock:
-            conns, self._conns = self._conns[:], []
-        for c in conns:
-            try:
-                c.close()
-            except OSError:
-                pass
         self._batcher.stop()
         if self._writer is not None:
             self._writer.close()
@@ -327,10 +322,26 @@ class ModelReplicaServer:
             shards=layout.num_shards,
         )
 
+    def _sweep_stuck_tickets(self) -> None:
+        """Resolve predict tickets past their deadline with TimeoutError
+        (idempotent — a genuine resolve racing in later is a no-op): a
+        wedged batch thread must not pin connections in_flight forever,
+        which would leak them AND make every drain()/stop() burn its
+        full timeout."""
+        now = time.monotonic()
+        with self._lock:
+            stuck = [t for t, dl in self._pending_tickets.items() if now > dl]
+        for t in stuck:
+            t._resolve(error=TimeoutError(
+                "batched apply did not complete in "
+                f"{self._ticket_deadline_s:.0f}s (batch thread wedged?)"
+            ))
+
     def _refresh_loop(self) -> None:
         from ..parallel import ps_service
 
         while not self._stop.is_set():
+            self._sweep_stuck_tickets()
             if self._follower is not None:
                 rec = self._follower.poll()
                 if rec is not None:
@@ -408,13 +419,19 @@ class ModelReplicaServer:
 
     def stats(self) -> dict:
         b = self._batcher.stats()
+        core = self._core.core_stats()
         with self._lock:
             s = {
                 "service": SERVICE,
                 "role": self.role,
                 "incarnation": self._incarnation,
                 "model_step": self.model_step,
-                "requests": self._requests,
+                # The uniform runtime-accounting shape (r17): requests /
+                # live_conns come from the shared server core, same
+                # meaning on every service's STATS answer.
+                "requests": core["requests"],
+                "live_conns": core["live_conns"],
+                "core": core,
                 "predict_rows": self._predicts,
                 "overloads": self._overloads,
                 "refreshes": self._refreshes,
@@ -435,91 +452,40 @@ class ModelReplicaServer:
         s["flight_events"] = len(telemetry.RECORDER)
         return s
 
-    # -- connection handling -------------------------------------------------
+    # -- the core handler ----------------------------------------------------
+    # One registered handler on the shared server core (r17): the core
+    # owns accept/read/write/HELLO/counting.  PREDICT is ASYNC — the
+    # handler submits to the batcher and returns immediately; the
+    # ticket's resolve callback (batch thread) queues the reply on the
+    # connection, so a slow peer buffers bytes instead of wedging a
+    # worker, and the bounded pool never caps the coalesced batch size.
 
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
+    def _handle(self, conn, op: int, name: str, a: int, b: int, payload):
+        if op == SRV_PREDICT:
+            t0 = time.perf_counter()
             try:
-                conn, _ = self._listener.accept()
-            except OSError:
-                return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            with self._lock:
-                self._conns.append(conn)
-            threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True,
-                name="msrv-conn",
-            ).start()
+                inputs = wire.decode_batch_bytes(payload)
+            except (ValueError, TypeError, KeyError):
+                return ERR, None
+            return self._handle_predict(conn, inputs, t0)
+        if op == SRV_STATS:
+            return 0, [json.dumps(self.stats()).encode()]
+        if op == SRV_SHUTDOWN:
+            self.shutdown_requested.set()
+            return 0, None
+        return ERR, None
 
-    def _reply(self, conn, status: int, bufs: list | None) -> None:
-        bufs = bufs or []
-        hdr = wire.RESP_HDR.pack(status, wire.encoded_nbytes(bufs))
-        wire.send_frames(conn, [hdr] + bufs)
-
-    def _serve_conn(self, conn: socket.socket) -> None:
-        hdr2 = bytearray(2)
-        try:
-            while not self._stop.is_set():
-                req = wire.read_request(conn, hdr2)
-                if req is None:
-                    return
-                op, name, a, b, plen = req
-                # Control-plane ops (wire.CONTROL_OPS) never count toward
-                # ``request_count``.
-                if op not in _SRV_CONTROL_OPS:
-                    with self._lock:
-                        self._requests += 1
-                if op == SRV_PREDICT:
-                    t0 = time.perf_counter()
-                    # The payload must leave the socket even on the
-                    # overload path — the framing survives the refusal.
-                    inputs = wire.read_batch(conn, plen)
-                    self._handle_predict(conn, inputs, t0)
-                    continue
-                if plen:  # no other SRV op carries a request payload
-                    sink = bytearray(min(plen, 1 << 20))
-                    left = plen
-                    while left:
-                        view = memoryview(sink)[: min(left, len(sink))]
-                        wire.recv_exact(conn, view)
-                        left -= len(view)
-                if op == SRV_HELLO:
-                    status, tag = wire.hello_answer(a, b, service=SERVICE)
-                    self._reply(conn, status, [tag] if tag else None)
-                elif op == SRV_STATS:
-                    self._reply(conn, 0, [json.dumps(self.stats()).encode()])
-                elif op == SRV_SHUTDOWN:
-                    self.shutdown_requested.set()
-                    self._reply(conn, 0, None)
-                else:
-                    self._reply(conn, ERR, None)
-        except (OSError, ConnectionError):
-            pass
-        finally:
-            with self._lock:
-                try:
-                    self._conns.remove(conn)
-                except ValueError:
-                    pass
-            try:
-                conn.close()
-            except OSError:
-                pass
-
-    def _handle_predict(self, conn, inputs: dict, t0: float) -> None:
+    def _handle_predict(self, conn, inputs: dict, t0: float):
         if not inputs:
-            self._reply(conn, ERR, None)
-            return
+            return ERR, None
         lens = {len(np.asarray(v)) if np.asarray(v).ndim else -1
                 for v in inputs.values()}
         if len(lens) != 1 or -1 in lens:
             # Every field must share one leading dim — the row unit the
             # batcher budgets and the scatter slices by.
-            self._reply(conn, ERR, None)
-            return
+            return ERR, None
         if self._model is None:
-            self._reply(conn, NO_MODEL, None)
-            return
+            return NO_MODEL, None
         # Requests coalesce only with SCHEMA-IDENTICAL neighbours (same
         # field names, trailing shapes and dtypes): one client sending a
         # mismatched request must never poison a well-formed concurrent
@@ -533,34 +499,57 @@ class ModelReplicaServer:
         except batcher_lib.Overloaded:
             with self._lock:
                 self._overloads += 1
-            self._reply(conn, OVERLOAD, None)
-            return
-        try:
-            step, out = ticket.result(timeout_s=120.0)
-        except _NoModel:
-            self._reply(conn, NO_MODEL, None)
-            return
-        except Exception:
-            # An apply bug — or the ticket's own TimeoutError on a stuck
-            # batch thread (an OSError subclass, so no transport-error
-            # carve-out here: the try block does no socket I/O) — must
-            # surface as a LOUD per-op error on the client, not a silent
-            # connection close (same posture as the data service's
-            # handler guard).
-            log.exception("batched predict failed server-side")
-            self._reply(conn, ERR, None)
-            return
-        bufs = wire.encode_batch(out)
-        hdr = wire.RESP_HDR.pack(step, wire.encoded_nbytes(bufs))
-        wire.send_frames(conn, [hdr] + bufs)
-        self.latency.record(time.perf_counter() - t0)
-        if (
-            self._writer is not None
-            and self.latency.total % self._metrics_every == 0
-        ):
-            self._writer.scalars(
-                self.model_step, self.latency.percentile_scalars("serve")
+            return OVERLOAD, None
+
+        def _resolved(value, error) -> None:
+            with self._lock:
+                self._pending_tickets.pop(ticket, None)
+            if error is not None:
+                if isinstance(error, _NoModel):
+                    conn.reply(NO_MODEL, None)
+                    return
+                # An apply bug (or the batcher's stop-drain error, or
+                # the wedged-apply timeout sweep) must surface as a LOUD
+                # per-op error on the client, not a silent connection
+                # close — WITH the traceback, since the client's typed
+                # error message points operators at this log.
+                log.error(
+                    "batched predict failed server-side", exc_info=error
+                )
+                conn.reply(ERR, None)
+                return
+            step, out = value
+            try:
+                # Same invariant the core's worker guards on the sync
+                # path: an output the wire cannot encode must answer a
+                # loud ERR — an escape here would be swallowed by the
+                # ticket's callback container with NO reply sent,
+                # wedging the connection in_flight forever.  reply()
+                # normalizes its buffers before queuing anything, so
+                # the ERR after a failed attempt is the first frame.
+                conn.reply(step, wire.encode_batch(out))
+            except Exception:
+                log.error(
+                    "predict reply failed (unserializable output?)",
+                    exc_info=True,
+                )
+                conn.reply(ERR, None)
+                return
+            self.latency.record(time.perf_counter() - t0)
+            if (
+                self._writer is not None
+                and self.latency.total % self._metrics_every == 0
+            ):
+                self._writer.scalars(
+                    self.model_step, self.latency.percentile_scalars("serve")
+                )
+
+        with self._lock:
+            self._pending_tickets[ticket] = (
+                time.monotonic() + self._ticket_deadline_s
             )
+        ticket.on_resolve(_resolved)
+        return server_core.ASYNC
 
 
 class _NoModel(RuntimeError):

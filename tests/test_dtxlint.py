@@ -562,6 +562,50 @@ def test_wire_detects_dispatch_missing_in_python_server(tmp_path):
     assert [f.symbol for f in missing] == ["SRV_STATS"]
 
 
+def test_wire_hello_dispatch_satisfied_by_the_server_core(tmp_path):
+    """r17: a service hosted on the shared runtime has HELLO answered by
+    the core's handler table, so the service module dropping its own
+    ``op == DSVC_HELLO`` compare is correct — not dispatch-missing.  A
+    module NOT on the core still must compare (the drift the check
+    exists for)."""
+    # Drop the dsvc server's HELLO branch: dispatch-missing fires...
+    no_hello = _DSVC_PY.replace(
+        "        if op == DSVC_HELLO:\n            return OK\n", ""
+    )
+    assert no_hello != _DSVC_PY
+    findings = run_pass(
+        tmp_path, "wire", {"pkg/data/data_service.py": no_hello}
+    )
+    missing = [f for f in findings if f.code == "dispatch-missing"]
+    assert [f.symbol for f in missing] == ["DSVC_HELLO"]
+    # ...a PROSE mention of the core is not hosting on it — the
+    # exemption needs a real import, else a revert to a hand-rolled
+    # loop that keeps a doc reference would silently lose the check...
+    mentions = no_hello.replace(
+        "import socket",
+        "import socket\n\n# migrated off server_core pending perf work",
+    )
+    findings = run_pass(
+        tmp_path, "wire", {"pkg/data/data_service.py": mentions}
+    )
+    assert [f.symbol for f in findings if f.code == "dispatch-missing"] == [
+        "DSVC_HELLO"
+    ]
+    # ...unless the module actually hosts itself on the shared core —
+    # either import spelling.
+    for imp in (
+        "from . import server_core",
+        "from .server_core import ServerCore",
+    ):
+        on_core = no_hello.replace(
+            "import socket", f"import socket\n\n{imp}",
+        )
+        findings = run_pass(
+            tmp_path, "wire", {"pkg/data/data_service.py": on_core}
+        )
+        assert [f for f in findings if f.code == "dispatch-missing"] == []
+
+
 # ---------------------------------------------------------------------------
 # Pass 2: concurrency
 # ---------------------------------------------------------------------------
@@ -710,6 +754,52 @@ def test_concurrency_detects_lock_order_inversion(tmp_path):
     order = [f for f in findings if f.code == "lock-order"]
     assert len(order) == 1
     assert "_lock" in order[0].symbol and "_aux_lock" in order[0].symbol
+
+
+_RAW_ACCEPT_PY = textwrap.dedent(
+    """\
+    import socket
+
+
+    class HandRolledServer:
+        def loop(self):
+            while True:
+                conn, _ = self._listener.accept()
+                self.spawn(conn)
+    """
+)
+
+
+def test_concurrency_refuses_raw_accept_in_service_dirs(tmp_path):
+    """r17: a hand-rolled accept loop in data/ or serve/ re-introduces the
+    thread-per-connection server the shared core retired — refused."""
+    cfg = make_cfg(tmp_path, {"pkg/data/hand_server.py": _RAW_ACCEPT_PY})
+    cfg.concurrency_dirs = list(cfg.concurrency_dirs) + [
+        tmp_path / "pkg" / "data", tmp_path / "pkg" / "serve",
+    ]
+    findings = dtxlint.run_passes(cfg, only="concurrency")["concurrency"]
+    raw = [f for f in findings if f.code == "raw-accept"]
+    assert len(raw) == 1
+    assert raw[0].path.endswith("data/hand_server.py")
+    assert "HandRolledServer.loop" in raw[0].symbol
+    assert "server_core" in raw[0].message
+
+
+def test_concurrency_raw_accept_outside_service_dirs_is_clean(tmp_path):
+    """The core's own package (and any non-service dir) is where the one
+    accept loop legitimately lives — not flagged there."""
+    conc = _CONC_PY + textwrap.dedent(
+        """\
+
+
+        class CoreLoop:
+            def accept_once(self):
+                conn, _ = self._listener.accept()
+                return conn
+    """
+    )
+    findings = run_pass(tmp_path, "concurrency", {"pkg/conc/worker.py": conc})
+    assert "raw-accept" not in codes(findings)
 
 
 # ---------------------------------------------------------------------------

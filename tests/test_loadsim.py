@@ -44,6 +44,37 @@ def test_build_plan_scripts_one_full_cycle():
     assert min(s.after_s for s in specs if s.after_s) >= 10.0
 
 
+def test_default_scenario_runs_4x_clients():
+    """r17: the default closed-loop client count is 4x the r14 rig (16
+    generator connections; SLO gates unchanged) — the serve plane rides
+    the unified server core, so connection count is cheap.  Pinned here
+    so a refactor cannot silently shrink the standing acceptance load."""
+    import inspect
+
+    assert inspect.signature(
+        loadsim.LoadGenerator.__init__
+    ).parameters["threads"].default == 16
+    ns = _parse_loadsim_args([])
+    assert ns.gen_threads == 16 and ns.qps == 100.0
+
+
+def _parse_loadsim_args(argv):
+    """The loadsim arg surface, parsed without booting a cluster: main()
+    dispatches AFTER parse_args, so intercept at the scenario branch."""
+    import unittest.mock as mock
+
+    captured = {}
+
+    def grab(args):
+        captured["ns"] = args
+        raise SystemExit(0)
+
+    with mock.patch.object(loadsim, "run_reshard", side_effect=grab):
+        with pytest.raises(SystemExit):
+            loadsim.main(argv + ["--scenario", "reshard"])
+    return captured["ns"]
+
+
 def test_analyze_steps_verdicts():
     markers = {"kill_worker": 10.0, "leave_worker": 20.0}
     good = [(t, 100 + 10 * t) for t in range(0, 30, 2)]

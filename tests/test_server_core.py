@@ -1,0 +1,793 @@
+"""dtxcore — the unified async server runtime (r17).
+
+What is pinned here, per the acceptance criteria:
+
+- **Handler-table dispatch** — one core hosting BOTH Python services on
+  one port routes each connection by its HELLO service tag, and the full
+  wrong-service dial matrix fails loudly through the one shared
+  ``wire.hello_answer`` path, naming both ends.
+- **Bounded threads** — 256 idle connections to a core-hosted service
+  add ZERO threads to the process (the thread-per-connection cost the
+  core retires), and the service still answers promptly underneath them.
+  The native PS keeps its C++ loop but must pass the same
+  high-concurrency gate: 256 idle conns, still serving, all accounted.
+- **Slow-reader write buffering** — a peer that stops reading its
+  responses buffers bytes on its connection; it never wedges a handler
+  worker (other clients stay fast even with every-worker's-worth of
+  stalled peers).
+- **Drain-then-stop** — a request in flight when ``stop()`` is called is
+  answered, complete, before the listener dies: zero dropped in-flight
+  requests on a graceful stop.
+- **Accept-path hardening** — injected transient accept failures
+  (``ECONNABORTED``, ``EMFILE``) log + back off and the listener keeps
+  serving; they never kill the accept path.
+- **Uniform accounting** — one STATS shape (``requests`` /
+  ``live_conns``) and one observability-ops-don't-count rule across ALL
+  THREE services: dsvc, msrv and the native PS answer the same counters
+  with the same control-op exclusion semantics (wire.CONTROL_OPS).
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_examples_tpu.data import data_service as dsvc_lib
+from distributed_tensorflow_examples_tpu.parallel import (
+    ps_service,
+    server_core,
+    wire,
+)
+
+pytestmark = pytest.mark.usefixtures("no_fault_plan")
+
+
+@pytest.fixture
+def no_fault_plan(monkeypatch):
+    monkeypatch.delenv("DTX_FAULT_PLAN", raising=False)
+
+
+# ----------------------------------------------------------------------------
+# Raw-wire helpers (deliberately not the service clients: these tests pin
+# the frame-level behavior of the runtime itself)
+# ----------------------------------------------------------------------------
+
+
+def _dial(port: int, service: str = "", timeout: float = 10.0) -> socket.socket:
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    if service:
+        st, _ = _call(s, wire.HELLO_OP, a=wire.WIRE_VERSION,
+                      b=wire.pack_hello_b(0, service=service))
+        assert st == wire.WIRE_VERSION, f"HELLO refused: {st}"
+    return s
+
+
+def _send_req(s, op, name="", a=0, b=0, payload=b"") -> None:
+    s.sendall(wire.pack_request(op, name, a, b, len(payload)) + payload)
+
+
+def _read_resp(s) -> tuple[int, bytes]:
+    hdr = bytearray(wire.RESP_HDR.size)
+    wire.recv_exact(s, memoryview(hdr))
+    status, nbytes = wire.RESP_HDR.unpack(hdr)
+    buf = bytearray(nbytes)
+    if nbytes:
+        wire.recv_exact(s, memoryview(buf))
+    return status, bytes(buf)
+
+
+def _call(s, op, name="", a=0, b=0, payload=b"") -> tuple[int, bytes]:
+    _send_req(s, op, name, a, b, payload)
+    return _read_resp(s)
+
+
+# ----------------------------------------------------------------------------
+# Handler-table dispatch + the wrong-service HELLO matrix
+# ----------------------------------------------------------------------------
+
+
+def _echo_core(**kw) -> server_core.ServerCore:
+    """One core hosting BOTH Python services on ONE port: each handler
+    answers its service id so the test can see which table entry ran."""
+    core = server_core.ServerCore(name="test", workers=2, **kw)
+
+    def handler_for(svc):
+        def handle(conn, op, name, a, b, payload):
+            return wire.SERVICE_IDS[svc], [f"{svc}:{op}".encode()]
+        return handle
+
+    core.add_service(server_core.Service("dsvc", handler_for("dsvc")))
+    core.add_service(server_core.Service("msrv", handler_for("msrv")))
+    return core.start()
+
+
+def test_handler_table_routes_by_hello_service_tag():
+    core = _echo_core()
+    try:
+        for svc, op in (("dsvc", 64), ("msrv", 96)):
+            s = _dial(core.port, svc)
+            status, raw = _call(s, op, a=7)
+            assert status == wire.SERVICE_IDS[svc]
+            assert raw == f"{svc}:{op}".encode()
+            s.close()
+    finally:
+        core.stop()
+
+
+def test_hello_answers_the_routed_services_tag():
+    core = _echo_core()
+    try:
+        for svc in ("dsvc", "msrv"):
+            s = socket.create_connection(("127.0.0.1", core.port), timeout=5)
+            st, tag = _call(s, wire.HELLO_OP, a=wire.WIRE_VERSION,
+                            b=wire.pack_hello_b(0, service=svc))
+            assert st == wire.WIRE_VERSION
+            assert tag == wire.SERVICE_TAGS[svc]
+            s.close()
+    finally:
+        core.stop()
+
+
+def test_wrong_service_hello_matrix_fails_loudly():
+    """Every wrong pairing against single-service cores is refused with a
+    status naming the service actually reached — the shared
+    ``hello_answer`` refusal, now issued by the core."""
+    core = server_core.ServerCore(name="only-dsvc", workers=1)
+    core.add_service(server_core.Service(
+        "dsvc", lambda conn, op, name, a, b, p: (0, None)
+    ))
+    core.start()
+    try:
+        s = socket.create_connection(("127.0.0.1", core.port), timeout=5)
+        st, _ = _call(s, wire.HELLO_OP, a=wire.WIRE_VERSION,
+                      b=wire.pack_hello_b(0, service="msrv"))
+        assert wire.unpack_wrong_service(st) == "dsvc"
+        # The shared client-side verdict names both ends.
+        err = wire.hello_failure(
+            st, None, service="msrv", host="127.0.0.1", port=core.port
+        )
+        assert err is not None and "data service" in err and "msrv" in err
+        s.close()
+    finally:
+        core.stop()
+
+
+def test_version_mismatch_refused():
+    core = _echo_core()
+    try:
+        s = socket.create_connection(("127.0.0.1", core.port), timeout=5)
+        st, _ = _call(s, wire.HELLO_OP, a=wire.WIRE_VERSION + 1,
+                      b=wire.pack_hello_b(0, service="dsvc"))
+        assert st == -1
+        s.close()
+    finally:
+        core.stop()
+
+
+def test_async_handler_replies_from_another_thread():
+    """The ASYNC path: a handler that hands the reply to another thread
+    (the serve batcher shape) still answers, in order."""
+    done = threading.Event()
+    core = server_core.ServerCore(name="async", workers=1)
+
+    def handle(conn, op, name, a, b, payload):
+        def later():
+            done.wait(5.0)
+            conn.reply(a * 2, [b"later"])
+        threading.Thread(target=later, daemon=True).start()
+        return server_core.ASYNC
+
+    core.add_service(server_core.Service("dsvc", handle))
+    core.start()
+    try:
+        s = _dial(core.port, "dsvc")
+        _send_req(s, 64, a=21)
+        done.set()
+        status, raw = _read_resp(s)
+        assert status == 42 and raw == b"later"
+        s.close()
+    finally:
+        core.stop()
+
+
+def test_handler_exception_answers_error_status_not_close():
+    core = server_core.ServerCore(name="boom", workers=1)
+
+    def handle(conn, op, name, a, b, payload):
+        raise RuntimeError("handler bug")
+
+    core.add_service(server_core.Service("dsvc", handle, error_status=-2))
+    core.start()
+    try:
+        s = _dial(core.port, "dsvc")
+        status, _ = _call(s, 64)
+        assert status == -2  # loud per-op error, connection still alive
+        status, _ = _call(s, 64)
+        assert status == -2
+        assert core.core_stats()["handler_errors"] == 2
+        s.close()
+    finally:
+        core.stop()
+
+
+# ----------------------------------------------------------------------------
+# 256 idle connections: bounded threads, every service still serving
+# ----------------------------------------------------------------------------
+
+
+def test_256_idle_connections_hold_a_fixed_thread_count():
+    srv = dsvc_lib.DataServiceServer(
+        [{"x": np.arange(8, dtype=np.float32)}], batch_size=2, shuffle=False,
+    )
+    conns = []
+    try:
+        threads_before = threading.active_count()
+        for _ in range(256):
+            conns.append(_dial(srv.port, "dsvc"))
+        # The C10k claim: idle connections cost file descriptors, not
+        # threads.  (Thread-per-connection would have added 256 here.)
+        assert threading.active_count() == threads_before
+        assert srv._core.live_conns() == 256
+        # And the service still answers promptly underneath them.
+        probe = _dial(srv.port, "dsvc")
+        t0 = time.monotonic()
+        status, raw = _call(probe, dsvc_lib.DSVC_STATS)
+        assert status == dsvc_lib.OK
+        assert time.monotonic() - t0 < 2.0
+        stats = json.loads(raw)
+        assert stats["live_conns"] == 257
+        probe.close()
+    finally:
+        for c in conns:
+            c.close()
+        srv.stop()
+
+
+def test_native_ps_passes_the_same_high_concurrency_gate():
+    """The native PS keeps its C++ loop but must hold the same gate: 256
+    idle connections, still answering, all visible in its STATS."""
+    port = ps_service.start_server(0)
+    conns = []
+    try:
+        for _ in range(256):
+            conns.append(socket.create_connection(("127.0.0.1", port), 10.0))
+        client = ps_service.PSClient("127.0.0.1", port, timeout_s=10.0)
+        t0 = time.monotonic()
+        stats = client.stats()
+        assert time.monotonic() - t0 < 2.0
+        assert stats["live_conns"] >= 257
+        client.ping()
+        client.close()
+    finally:
+        for c in conns:
+            c.close()
+        ps_service.stop_server(port)
+
+
+# ----------------------------------------------------------------------------
+# Slow readers buffer, they do not wedge workers
+# ----------------------------------------------------------------------------
+
+
+def test_slow_reader_buffers_instead_of_wedging_a_worker():
+    """Stalled peers holding unread responses > the worker count must not
+    stop other clients from being served — the reply path buffers on the
+    connection (flushed by the selector), never blocks a worker in
+    sendall."""
+    payload = {"x": np.zeros(200_000, np.float32)}  # ~800 KB per answer
+    core = server_core.ServerCore(name="slow", workers=2)
+    core.add_service(server_core.Service(
+        "dsvc", lambda conn, op, name, a, b, p: (0, wire.encode_batch(payload))
+    ))
+    core.start()
+    stalled = []
+    try:
+        # MORE stalled peers than workers, each with several unread
+        # responses outstanding: under thread-per-connection-with-sendall
+        # (or worker-pool-with-sendall) this wedges the whole service.
+        for _ in range(4):
+            s = _dial(core.port, "dsvc")
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+            for _ in range(8):
+                _send_req(s, 64)
+            stalled.append(s)
+        time.sleep(0.3)  # let the workers chew through the stalled queue
+        live = _dial(core.port, "dsvc")
+        t0 = time.monotonic()
+        status, raw = _call(live, 64)
+        dt = time.monotonic() - t0
+        assert status == 0
+        assert dt < 2.0, f"live client stalled {dt:.1f}s behind slow readers"
+        live.close()
+        # The stalled peers' responses are all still delivered in full
+        # once they start reading (nothing dropped, framing intact).
+        for s in stalled:
+            got = 0
+            s.settimeout(30.0)
+            for _ in range(8):
+                status, raw = _read_resp(s)
+                assert status == 0
+                got += 1
+            assert got == 8
+    finally:
+        for s in stalled:
+            s.close()
+        core.stop()
+
+
+def test_slow_reader_past_the_buffer_bound_is_dropped_not_served():
+    core = server_core.ServerCore(
+        name="cap", workers=1, max_buffered_bytes=64 * 1024,
+        slow_reader_grace_s=0.3,
+    )
+    big = {"x": np.zeros(100_000, np.float32)}
+    core.add_service(server_core.Service(
+        "dsvc", lambda conn, op, name, a, b, p: (0, wire.encode_batch(big))
+    ))
+    core.start()
+    s = None
+    try:
+        s = _dial(core.port, "dsvc")
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        for _ in range(8):
+            _send_req(s, 64)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if core.core_stats()["dropped_slow_readers"]:
+                break
+            time.sleep(0.05)
+        assert core.core_stats()["dropped_slow_readers"] >= 1
+    finally:
+        if s is not None:
+            s.close()
+        core.stop()
+
+
+def test_one_reply_larger_than_the_bound_is_delivered_to_a_reading_peer():
+    """The drop is progress-gated: a single legitimate reply BIGGER than
+    ``max_buffered_bytes`` streams to a peer that is actually reading —
+    size alone never cuts the connection (the old send_frames path
+    delivered replies of any size; the buffered path must too)."""
+    core = server_core.ServerCore(
+        name="bigreply", workers=1, max_buffered_bytes=64 * 1024,
+        slow_reader_grace_s=30.0,
+    )
+    big = {"x": np.arange(1_000_000, dtype=np.float32)}  # ~4 MB >> 64 KB
+    core.add_service(server_core.Service(
+        "dsvc", lambda conn, op, name, a, b, p: (0, wire.encode_batch(big))
+    ))
+    core.start()
+    try:
+        s = _dial(core.port, "dsvc")
+        s.settimeout(30.0)
+        status, raw = _call(s, 64)
+        assert status == 0
+        got = wire.decode_batch_bytes(raw)
+        assert np.array_equal(got["x"], big["x"])
+        assert core.core_stats()["dropped_slow_readers"] == 0
+        s.close()
+    finally:
+        core.stop()
+
+
+# ----------------------------------------------------------------------------
+# Drain-then-stop: zero dropped in-flight requests
+# ----------------------------------------------------------------------------
+
+
+def test_drain_then_stop_answers_the_in_flight_request():
+    started = threading.Event()
+
+    def handle(conn, op, name, a, b, payload):
+        started.set()
+        time.sleep(0.5)  # a genuinely in-flight handler when stop() lands
+        return 123, [b"answered"]
+
+    core = server_core.ServerCore(name="drain", workers=1)
+    core.add_service(server_core.Service("dsvc", handle))
+    core.start()
+    s = _dial(core.port, "dsvc")
+    _send_req(s, 64)
+    assert started.wait(5.0)
+    stopper = threading.Thread(target=core.stop)
+    stopper.start()
+    # The already-dispatched request completes and its full response
+    # arrives even though stop() was called mid-handler.
+    s.settimeout(10.0)
+    status, raw = _read_resp(s)
+    assert status == 123 and raw == b"answered"
+    stopper.join(timeout=10.0)
+    assert not stopper.is_alive()
+    s.close()
+    # And the port is actually released (a fresh bind succeeds).
+    probe = socket.socket()
+    probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    probe.bind(("127.0.0.1", core.port))
+    probe.close()
+
+
+def test_drain_reports_clean_completion():
+    core = server_core.ServerCore(name="quiesce", workers=1)
+    core.add_service(server_core.Service(
+        "dsvc", lambda conn, op, name, a, b, p: (0, None)
+    ))
+    core.start()
+    try:
+        s = _dial(core.port, "dsvc")
+        assert _call(s, 64)[0] == 0
+        assert core.drain(timeout_s=5.0) is True
+        # Draining: new connections are refused (the listener is down)...
+        with pytest.raises(OSError):
+            socket.create_connection(("127.0.0.1", core.port), timeout=1.0)
+        s.close()
+    finally:
+        core.stop()
+
+
+# ----------------------------------------------------------------------------
+# Accept-path hardening: transient failures never kill the listener
+# ----------------------------------------------------------------------------
+
+
+class _FlakyListener:
+    """Listener proxy injecting accept() failures (socket methods are
+    read-only, so the core's listener handle is swapped for this)."""
+
+    def __init__(self, sock, failures: list[int]):
+        self._sock = sock
+        self.failures = failures
+
+    def accept(self):
+        if self.failures:
+            e = self.failures.pop(0)
+            raise OSError(e, errno.errorcode.get(e, "E?"))
+        return self._sock.accept()
+
+    def __getattr__(self, item):
+        return getattr(self._sock, item)
+
+
+def test_transient_accept_errors_do_not_kill_the_listener():
+    core = server_core.ServerCore(name="acc", workers=1, accept_backoff_s=0.1)
+    core.add_service(server_core.Service(
+        "dsvc", lambda conn, op, name, a, b, p: (0, None)
+    ))
+    failures = [errno.ECONNABORTED, errno.EMFILE]
+    core._listener = _FlakyListener(core._listener, failures)
+    core.start()
+    try:
+        # Both injected failures fire on the first connection attempts;
+        # the listener survives both (ECONNABORTED skipped, EMFILE backed
+        # off) and every client eventually connects and is served.
+        for _ in range(3):
+            s = _dial(core.port, "dsvc", timeout=15.0)
+            assert _call(s, 64)[0] == 0
+            s.close()
+        assert not failures, "injected accept failures never fired"
+        assert core.core_stats()["accept_errors"] == 2
+        assert core.core_stats()["accepts"] >= 3
+    finally:
+        core.stop()
+
+
+# ----------------------------------------------------------------------------
+# Uniform accounting: one STATS shape, one ops-don't-count rule, all three
+# services
+# ----------------------------------------------------------------------------
+
+
+def _scrape_twice_and_probe(make_scrape, read_requests):
+    """The parity harness: two complete fresh-dial scrapes of an idle
+    server must read the SAME request count (observation does not
+    perturb ``die:after_reqs`` triggers), and one counted data-plane op
+    must advance it by exactly 1."""
+    make_scrape()
+    before = read_requests()
+    make_scrape()
+    after = read_requests()
+    return before, after
+
+
+def test_control_op_exclusion_parity_across_all_three_services(tmp_path):
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_examples_tpu import serve
+
+    counts: dict[str, tuple[int, int, int]] = {}
+
+    # dsvc --------------------------------------------------------------
+    dsrv = dsvc_lib.DataServiceServer(
+        [{"x": np.arange(8, dtype=np.float32)}], batch_size=2, shuffle=False,
+    )
+    try:
+        def dsvc_scrape():
+            c = dsvc_lib.DataServiceClient(
+                "127.0.0.1", dsrv.port, worker_id=-1, reconnect_deadline_s=0.0,
+            )
+            st = c.stats()
+            assert st["service"] == "dsvc"
+            assert "requests" in st and "live_conns" in st  # one STATS shape
+            c.close()
+
+        b, a = _scrape_twice_and_probe(dsvc_scrape, dsrv.request_count)
+        c = dsvc_lib.DataServiceClient(
+            "127.0.0.1", dsrv.port, worker_id=3, reconnect_deadline_s=0.0,
+        )  # REGISTER with a real worker id: exactly one counted op
+        after_op = dsrv.request_count()
+        c.close()
+        counts["dsvc"] = (b, a, after_op)
+    finally:
+        dsrv.stop()
+
+    # msrv --------------------------------------------------------------
+    def init_fn(rng):
+        return {"w": jnp.zeros((4, 2), jnp.float32)}
+
+    def predict_fn(params, batch):
+        return batch["x"] @ params["w"]
+
+    port = ps_service.start_server(0)
+    try:
+        addrs = [("127.0.0.1", port)]
+        from distributed_tensorflow_examples_tpu.parallel import ps_shard
+
+        group = ps_shard.ShardedPSClients(addrs, role="t17_pub")
+        pstore = ps_shard.ShardedParamStore(
+            group, "params", ps_shard.ShardLayout(8, 1)
+        )
+        pstore.set(1, np.zeros(8, np.float32))
+        msrv = serve.ModelReplicaServer(
+            init_fn, predict_fn, addrs, membership=False, refresh_ms=20.0,
+        )
+        try:
+            assert msrv.wait_for_model(30.0)
+
+            def msrv_scrape():
+                c = serve.ServeClient(
+                    "127.0.0.1", msrv.port, reconnect_deadline_s=0.0,
+                )
+                st = c.stats()
+                assert st["service"] == "msrv"
+                assert "requests" in st and "live_conns" in st
+                c.close()
+
+            b, a = _scrape_twice_and_probe(msrv_scrape, msrv.request_count)
+            c = serve.ServeClient(
+                "127.0.0.1", msrv.port, reconnect_deadline_s=0.0,
+            )
+            c.predict({"x": np.zeros((1, 4), np.float32)})  # one counted op
+            after_op = msrv.request_count()
+            c.close()
+            counts["msrv"] = (b, a, after_op)
+        finally:
+            msrv.stop()
+            group.close()
+    finally:
+        ps_service.stop_server(port)
+
+    # native ps ---------------------------------------------------------
+    port = ps_service.start_server(0)
+    try:
+        def ps_scrape():
+            c = ps_service.PSClient("127.0.0.1", port, timeout_s=10.0)
+            st = c.stats()
+            assert "requests" in st and "live_conns" in st
+            c.close()
+
+        b, a = _scrape_twice_and_probe(
+            ps_scrape, lambda: ps_service.server_request_count(port)
+        )
+        c = ps_service.PSClient("127.0.0.1", port, timeout_s=10.0)
+        c.ping()  # one counted data-plane op
+        after_op = ps_service.server_request_count(port)
+        c.close()
+        counts["ps"] = (b, a, after_op)
+    finally:
+        ps_service.stop_server(port)
+
+    # THE parity assertion: on every service, a full fresh-dial scrape
+    # adds ZERO to the request counter, and one data-plane op adds
+    # exactly one — the single observability-ops-don't-count rule.
+    for svc, (before, after, after_op) in counts.items():
+        assert after == before, f"{svc}: a scrape perturbed the counter"
+        assert after_op == after + 1, (
+            f"{svc}: one data-plane op advanced the counter by "
+            f"{after_op - after}, not 1"
+        )
+
+
+def test_request_counter_is_the_core_counter():
+    srv = dsvc_lib.DataServiceServer(
+        [{"x": np.arange(8, dtype=np.float32)}], batch_size=2, shuffle=False,
+    )
+    try:
+        assert srv.request_count() == srv._core.request_count()
+        s = _dial(srv.port, "dsvc")
+        _call(s, dsvc_lib.DSVC_HEARTBEAT, a=0)
+        assert srv.request_count() == 1
+        _call(s, dsvc_lib.DSVC_STATS)  # control op: uncounted
+        assert srv.request_count() == 1
+        s.close()
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------------------------------
+# Frame parsing details the blocking reader used to get for free
+# ----------------------------------------------------------------------------
+
+
+def test_fragmented_frames_parse_and_pipelined_frames_answer_in_order():
+    core = server_core.ServerCore(name="frag", workers=1)
+    core.add_service(server_core.Service(
+        "dsvc", lambda conn, op, name, a, b, p: (a, [p] if p else None)
+    ))
+    core.start()
+    try:
+        s = _dial(core.port, "dsvc")
+        # One request dribbled a byte at a time...
+        req = wire.pack_request(64, "nm", 5, 0, 3) + b"xyz"
+        for i in range(len(req)):
+            s.sendall(req[i : i + 1])
+            time.sleep(0.001)
+        status, raw = _read_resp(s)
+        assert status == 5 and raw == b"xyz"
+        # ...and three pipelined in one write answer in order.
+        s.sendall(b"".join(
+            wire.pack_request(64, "", i, 0, 0) for i in (1, 2, 3)
+        ))
+        assert [_read_resp(s)[0] for _ in range(3)] == [1, 2, 3]
+        s.close()
+    finally:
+        core.stop()
+
+
+def test_per_service_payload_bound_drops_before_buffering():
+    """A frame announcing a payload past the SERVICE's bound (dsvc: no
+    request carries one) drops at header time — the payload is never
+    buffered, so a bogus length costs no memory."""
+    srv = dsvc_lib.DataServiceServer(
+        [{"x": np.arange(8, dtype=np.float32)}], batch_size=2, shuffle=False,
+    )
+    try:
+        s = _dial(srv.port, "dsvc")
+        s.sendall(struct.pack("<BB", dsvc_lib.DSVC_REGISTER, 0)
+                  + wire.REQ_TAIL.pack(0, 0, 2 << 20))  # > the 1 MB bound
+        s.settimeout(5.0)
+        with pytest.raises((ConnectionError, socket.timeout, OSError)):
+            _read_resp(s)
+        s.close()
+        # The service itself is untouched: a well-formed dial still works.
+        probe = _dial(srv.port, "dsvc")
+        assert _call(probe, dsvc_lib.DSVC_STATS)[0] == dsvc_lib.OK
+        probe.close()
+    finally:
+        srv.stop()
+
+
+def test_wedged_batch_thread_answers_timeout_err_and_frees_the_conn():
+    """The r17 async-predict backstop: a wedged batch thread must not pin
+    the connection in_flight forever — the refresher's ticket sweep
+    resolves it with TimeoutError, the client reads a loud ERR, and the
+    server still drains/stops promptly."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_examples_tpu import serve
+    from distributed_tensorflow_examples_tpu.parallel import ps_shard
+
+    def init_fn(rng):
+        return {"w": jnp.zeros((4, 2), jnp.float32)}
+
+    def predict_fn(params, batch):
+        return batch["x"] @ params["w"]
+
+    port = ps_service.start_server(0)
+    try:
+        addrs = [("127.0.0.1", port)]
+        group = ps_shard.ShardedPSClients(addrs, role="t17_wedge")
+        pstore = ps_shard.ShardedParamStore(
+            group, "params", ps_shard.ShardLayout(8, 1)
+        )
+        pstore.set(1, np.zeros(8, np.float32))
+        srv = serve.ModelReplicaServer(
+            init_fn, predict_fn, addrs, membership=False, refresh_ms=50.0,
+            max_wait_ms=1.0,
+        )
+        try:
+            assert srv.wait_for_model(30.0)
+            srv._ticket_deadline_s = 0.5
+            srv._batcher._run = lambda items: time.sleep(3.0) or []  # wedge
+            c = serve.ServeClient(
+                "127.0.0.1", srv.port, reconnect_deadline_s=0.0,
+            )
+            t0 = time.monotonic()
+            with pytest.raises(serve.ServeRejectedError):
+                c.predict({"x": np.zeros((1, 4), np.float32)})
+            # Answered by the sweep, long before the wedge clears.
+            assert time.monotonic() - t0 < 2.5
+            c.close()
+            # And the connection was freed: the core drains promptly.
+            assert srv._core.drain(timeout_s=2.0) is True
+        finally:
+            srv.stop()
+            group.close()
+    finally:
+        ps_service.stop_server(port)
+
+
+def test_unserializable_predict_output_answers_err_not_a_wedged_conn():
+    """The async-reply twin of the worker guard: an output the wire
+    cannot encode answers a loud ERR — the connection stays usable and
+    the server still drains (a swallowed encode failure used to leave
+    the conn in_flight forever with no reply)."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_examples_tpu import serve
+    from distributed_tensorflow_examples_tpu.parallel import ps_shard
+
+    def init_fn(rng):
+        return {"w": jnp.zeros((4, 2), jnp.float32)}
+
+    def predict_fn(params, batch):
+        return batch["x"] @ params["w"]
+
+    port = ps_service.start_server(0)
+    try:
+        addrs = [("127.0.0.1", port)]
+        group = ps_shard.ShardedPSClients(addrs, role="t17_enc")
+        pstore = ps_shard.ShardedParamStore(
+            group, "params", ps_shard.ShardLayout(8, 1)
+        )
+        pstore.set(1, np.zeros(8, np.float32))
+        srv = serve.ModelReplicaServer(
+            init_fn, predict_fn, addrs, membership=False, refresh_ms=50.0,
+            max_wait_ms=1.0,
+        )
+        try:
+            assert srv.wait_for_model(30.0)
+            # The apply "succeeds" but yields an output the wire codec
+            # cannot move (object dtype has no byte view).
+            srv._batcher._run = lambda items: [
+                (5, {"y": np.empty(1, dtype=object)}) for _ in items
+            ]
+            c = serve.ServeClient(
+                "127.0.0.1", srv.port, reconnect_deadline_s=0.0,
+            )
+            with pytest.raises(serve.ServeRejectedError):
+                c.predict({"x": np.zeros((1, 4), np.float32)})
+            # The SAME connection still answers — nothing wedged.
+            assert c.stats()["service"] == "msrv"
+            c.close()
+            assert srv._core.drain(timeout_s=2.0) is True
+        finally:
+            srv.stop()
+            group.close()
+    finally:
+        ps_service.stop_server(port)
+
+
+def test_oversize_frame_announcement_drops_the_connection():
+    core = server_core.ServerCore(name="huge", workers=1)
+    core.add_service(server_core.Service(
+        "dsvc", lambda conn, op, name, a, b, p: (0, None)
+    ))
+    core.start()
+    try:
+        s = _dial(core.port, "dsvc")
+        s.sendall(struct.pack("<BB", 64, 0) + wire.REQ_TAIL.pack(
+            0, 0, server_core.MAX_FRAME_BYTES + 1
+        ))
+        s.settimeout(5.0)
+        with pytest.raises((ConnectionError, socket.timeout, OSError)):
+            _read_resp(s)
+        s.close()
+    finally:
+        core.stop()

@@ -73,6 +73,48 @@ def _params_of(flat):
 # ----------------------------------------------------------------------------
 
 
+def test_ticket_on_resolve_runs_exactly_once_and_resolve_is_idempotent():
+    """r17 async-reply contract: the register/resolve handoff is
+    lock-guarded (a double callback would queue two response frames for
+    one request), and a SECOND resolve — the wedged-apply timeout sweep
+    racing a genuine late resolution — is a no-op (first wins)."""
+    from distributed_tensorflow_examples_tpu.serve import batcher as b
+
+    # Register-then-resolve: exactly one invocation, with the value.
+    t = b.Ticket(1)
+    calls = []
+    t.on_resolve(lambda v, e: calls.append((v, e)))
+    t._resolve(value="first")
+    t._resolve(error=TimeoutError("sweep raced in late"))  # discarded
+    assert calls == [("first", None)]
+    assert t.result(timeout_s=1.0) == "first"
+    # Resolve-then-register: the callback fires immediately, once.
+    t2 = b.Ticket(1)
+    t2._resolve(error=RuntimeError("boom"))
+    calls2 = []
+    t2.on_resolve(lambda v, e: calls2.append((v, e)))
+    assert len(calls2) == 1 and isinstance(calls2[0][1], RuntimeError)
+    # Hammer the handoff from two threads: never zero, never double.
+    import threading as th
+
+    for _ in range(200):
+        tk = b.Ticket(1)
+        got = []
+        barrier = th.Barrier(2)
+
+        def registrar():
+            barrier.wait()
+            tk.on_resolve(lambda v, e: got.append(v))
+
+        def resolver():
+            barrier.wait()
+            tk._resolve(value=42)
+
+        a, c = th.Thread(target=registrar), th.Thread(target=resolver)
+        a.start(); c.start(); a.join(); c.join()
+        assert got == [42]
+
+
 def test_batcher_coalesces_concurrent_requests_into_one_apply():
     applies: list[list] = []
 
@@ -712,3 +754,67 @@ def test_perf_gate_serving_registration_and_speedup_bound():
     }
     fails = pg.gate(slow, good, **kw)
     assert any("batched.stream_mbs_frac_memcpy" in f for f in fails), fails
+
+
+def test_perf_gate_concurrent_p99_ratio_rule():
+    """The r17 server-core bound: p99 at the widest paced connection
+    count <= 3x the narrowest, from the result alone; and a result that
+    silently dropped the concurrency axis fails against a baseline that
+    carries it."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate",
+        os.path.join(os.path.dirname(__file__), "..", "tools", "perf_gate.py"),
+    )
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+    kw = dict(tolerance=0.25, if_newer_ratio=20.0)
+
+    def result(p99_64, p99_256):
+        return {
+            "metric": "serving_qps",
+            "detail": {
+                "concurrency": {
+                    "rate_per_client": 2.0,
+                    "clients": {
+                        "64": {"clients": 64, "p99_ms": p99_64},
+                        "256": {"clients": 256, "p99_ms": p99_256},
+                    },
+                    "p99_ratio": p99_256 / p99_64,
+                },
+            },
+        }
+
+    good = result(20.0, 45.0)  # ratio 2.25: bounded
+    assert pg.gate(good, good, **kw) == []
+    bad = result(20.0, 90.0)  # ratio 4.5: per-connection cost blew up
+    fails = pg.gate(bad, good, **kw)
+    assert any("concurrency.p99_ratio" in f for f in fails), fails
+    # A custom bound threads through.
+    assert pg.gate(bad, good, **kw, concurrent_p99_ratio=5.0) == []
+    # Dropping the axis against a baseline that has it fails loudly.
+    dropped = {"metric": "serving_qps", "detail": {}}
+    fails = pg.gate(dropped, good, **kw)
+    assert any("concurrency" in f and "row" in f for f in fails), fails
+    # And so does a PARTIAL result — a concurrency dict that kept its
+    # key but lost a usable client row (the silent-skip hole: the ratio
+    # check needs two rows to run at all).
+    partial = {
+        "metric": "serving_qps",
+        "detail": {"concurrency": {
+            "clients": {"64": {"clients": 64, "p99_ms": 20.0}},
+        }},
+    }
+    fails = pg.gate(partial, good, **kw)
+    assert any("1 gated client row" in f for f in fails), fails
+    # The checked-in dev-box baseline passes its own gate.
+    with open(os.path.join(
+        os.path.dirname(__file__), "..", "tools", "serving_baseline.json"
+    )) as f:
+        import json
+
+        baseline = json.load(f)
+    assert baseline["detail"]["concurrency"]["p99_ratio"] is not None
+    assert pg.gate(baseline, baseline, **kw) == []

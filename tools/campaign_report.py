@@ -56,6 +56,8 @@ def fmt_transport(rec: dict, ok: str) -> str:
         f"(memcpy {d.get('memcpy_mbs')} MB/s; {rec['seconds']}s wall)"
     ]
     for row_name, row in d.items():
+        if row_name == "concurrency":
+            continue  # rendered as the dedicated ratio line below
         if isinstance(row, dict):
             kv = " ".join(f"{k}={v}" for k, v in row.items())
             lines.append(f"    - {row_name}: {kv}")
@@ -68,6 +70,19 @@ def fmt_transport(rec: dict, ok: str) -> str:
         lines.append(
             f"    - batched_speedup={d['batched_speedup']} "
             "(micro-batching bound: >= 3.0 at max_batch=32)"
+        )
+    conc = d.get("concurrency")
+    if isinstance(conc, dict):
+        per = " ".join(
+            f"{n}c:p99={row.get('p99_ms')}ms"
+            for n, row in sorted(
+                (conc.get("clients") or {}).items(), key=lambda kv: int(kv[0])
+            )
+            if isinstance(row, dict)
+        )
+        lines.append(
+            f"    - concurrent_p99_ratio={conc.get('p99_ratio')} "
+            f"({per}; server-core bound: <= 3.0 at 4x connections)"
         )
     repl = d.get("replicas")
     if isinstance(repl, dict) and isinstance(repl.get("2"), dict):

@@ -18,6 +18,13 @@ through a helper method is the helper's finding, at its own site):
   function nests ``with A: with B:`` and another nests ``with B: with
   A:``, the two can deadlock; every observed ordered pair is collected
   across all scanned files and inversions are reported (both sites named).
+- ``raw-accept`` (r17) — a ``.accept()`` call in a ``data/`` or ``serve/``
+  service module: those services run on the shared readiness-driven
+  runtime (``parallel/server_core.py``), and a hand-rolled accept loop
+  outside it re-introduces the thread-per-connection server the core
+  retired (one wedged peer = one wedged thread; 256 idle conns = 256
+  stacks).  The core itself (under ``parallel/``) is the one place an
+  accept loop belongs.
 
 A lock is any ``with`` context expression whose final name contains
 ``lock`` (``self._lock``, ``self._run_lock``, module ``_role_lock``...) —
@@ -151,14 +158,29 @@ class _FuncVisitor(ast.NodeVisitor):
                     "the full wait",
                     line=node.lineno,
                 ))
+        if self.linter.no_raw_accept and _call_name(node) == "accept":
+            self.linter.findings.append(Finding(
+                PASS, "raw-accept", self.linter.relpath,
+                f"{self.qualname}:accept",
+                f"{self.qualname} calls accept() — data/ and serve/ "
+                "services run on the shared runtime "
+                "(parallel/server_core.py); a hand-rolled accept loop "
+                "here re-introduces the thread-per-connection server the "
+                "core retired",
+                line=node.lineno,
+            ))
         self.generic_visit(node)
 
 
 class _FileLinter:
-    def __init__(self, path: Path, relpath: str, order_pairs: dict):
+    def __init__(
+        self, path: Path, relpath: str, order_pairs: dict,
+        no_raw_accept: bool = False,
+    ):
         self.path, self.relpath = path, relpath
         self.findings: list[Finding] = []
         self.order_pairs = order_pairs  # (outer, inner) -> [(qualname, line)]
+        self.no_raw_accept = no_raw_accept
         self._class_stack: list[str] = []
 
     def lock_id(self, expr: ast.expr) -> str:
@@ -252,16 +274,26 @@ class _FileLinter:
 def run(cfg: LintConfig) -> list[Finding]:
     findings: list[Finding] = []
     order_pairs: dict[tuple[str, str], list[tuple[str, int]]] = {}
-    files: list[Path] = []
+    # Service packages (data/, serve/) must not hand-roll accept loops —
+    # they run on the shared server core (r17); the core's own package
+    # (parallel/) is where the one accept loop lives.  The rule keys on
+    # the CONFIGURED corpus entry a file came from, not on its parent
+    # directory's basename, so the enforced boundary is exactly the
+    # service packages the config names.
+    files: list[tuple[Path, bool]] = []
     for d in cfg.concurrency_dirs:
         if d.is_file():
-            files.append(d)
+            # A single-file corpus entry belongs to its parent package.
+            files.append((d, d.parent.name in ("data", "serve")))
         else:
-            files.extend(sorted(d.glob("*.py")))
+            service_dir = d.name in ("data", "serve")
+            files.extend((p, service_dir) for p in sorted(d.glob("*.py")))
     rels: dict[tuple[str, str], str] = {}
-    for path in files:
+    for path, service_dir in files:
         rel = cfg.rel(path)
-        linter = _FileLinter(path, rel, order_pairs)
+        linter = _FileLinter(
+            path, rel, order_pairs, no_raw_accept=service_dir,
+        )
         findings.extend(linter.lint())
         for pair in order_pairs:
             rels.setdefault(pair, rel)

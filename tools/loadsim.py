@@ -39,7 +39,11 @@ items 1–4 gate on, runnable on any CPU dev box (``cpu_ok`` in
 
 Usage::
 
-    python tools/loadsim.py --qps=25 --duration_s=30 --p99_bound_ms=250
+    python tools/loadsim.py --qps=100 --duration_s=30 --p99_bound_ms=250
+
+r17: the default scenario drives 4x the original closed-loop client count
+(16 generator connections at qps 100) with the SLO gates unchanged — the
+serve plane now rides the unified server core (parallel/server_core.py).
 """
 
 from __future__ import annotations
@@ -124,7 +128,7 @@ class LoadGenerator:
     replica discovery following the LEASE registry (the elastic pool)."""
 
     def __init__(
-        self, ps_addrs, serve_addrs, *, qps: float, threads: int = 4,
+        self, ps_addrs, serve_addrs, *, qps: float, threads: int = 16,
         deadline_s: float = 60.0,
     ):
         from distributed_tensorflow_examples_tpu import serve
@@ -371,7 +375,7 @@ def run_reshard(args) -> int:
 
         gen = LoadGenerator(
             topo_addrs[1], serve_addrs, qps=args.qps,
-            deadline_s=max(30.0, args.duration_s),
+            threads=args.gen_threads, deadline_s=max(30.0, args.duration_s),
         )
         gen.start()
         t0 = time.monotonic()
@@ -548,8 +552,14 @@ def _fired_in(p, needle: str) -> bool:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--qps", type=float, default=25.0)
+    ap.add_argument("--qps", type=float, default=100.0)
     ap.add_argument("--duration_s", type=float, default=30.0)
+    ap.add_argument(
+        "--gen_threads", type=int, default=16,
+        help="closed-loop generator clients (r17: 4x the original 4 — "
+        "the default scenario now drives the serve pool with 16 "
+        "concurrent connections; SLO gates unchanged)",
+    )
     ap.add_argument("--p99_bound_ms", type=float, default=250.0)
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--serve_replicas", type=int, default=2)
@@ -640,6 +650,7 @@ def main(argv=None) -> int:
         "schema_version": VERDICT_SCHEMA_VERSION,
         "metric": "loadsim_slo",  # perf_gate baseline auto-select key
         "qps_target": args.qps,
+        "gen_threads": args.gen_threads,
         "duration_s": args.duration_s,
         "p99_bound_ms": args.p99_bound_ms,
         "logdir": logdir,
@@ -666,7 +677,7 @@ def main(argv=None) -> int:
 
         gen = LoadGenerator(
             ps_addrs, serve_addrs, qps=args.qps,
-            deadline_s=max(30.0, args.duration_s),
+            threads=args.gen_threads, deadline_s=max(30.0, args.duration_s),
         )
         gen.start()
         t0 = time.monotonic()

@@ -184,8 +184,11 @@ def steps_plan() -> list[dict]:
         # bound, step monotone through the chaos).  The standing
         # acceptance ROADMAP items 1-4 gate on; JAX-on-CPU, so cpu_ok.
         # Verdict gated against tools/loadsim_baseline.json by perf_gate.
+        # r17: 4x the original closed-loop client count (16 generator
+        # connections, qps 100) with the SLO gates unchanged — the serve
+        # plane rides the unified server core now.
         dict(name="loadsim",
-             cmd=[PY, "tools/loadsim.py", "--qps", "25", "--duration_s",
+             cmd=[PY, "tools/loadsim.py", "--qps", "100", "--duration_s",
                   "30", "--p99_bound_ms", "400"],
              timeout=900, cpu_ok=True),
         # Live PS resharding acceptance (r15): resize the PS tier 2→3→2

@@ -40,6 +40,14 @@ Three kinds of checks, all deliberately host-portable:
    single-client one-at-a-time throughput at ``max_batch`` >= 32, from the
    result file alone: one jitted apply per coalesced batch, not one per
    request.
+6. **concurrent p99 ratio** (r17, the unified server core) — on the
+   serving bench's paced concurrency axis (``--clients=64,256``, each
+   client at a fixed request rate), p99 at 256 connections must stay
+   within ``--concurrent-p99-ratio`` (default 3.0) x p99 at 64, from the
+   result file alone.  Per-client load is held constant, so the ratio
+   prices the PER-CONNECTION cost of the runtime: bounded under the
+   selector core, blown up by a regression toward thread-per-connection
+   scheduling or any O(conns) pass on the hot path.
 
 The default tolerance is generous (0.25: flag only when a normalized row
 drops below a QUARTER of baseline) — this is a tripwire for structural
@@ -87,7 +95,7 @@ def gate(
     result: dict, baseline: dict, *, tolerance: float, if_newer_ratio: float,
     remote_local_ratio: float = 0.5, sharded_speedup: float = 1.3,
     serving_speedup: float = 3.0, replicated_overhead: float = 1.6,
-    loadsim_p99_ratio: float = 20.0,
+    loadsim_p99_ratio: float = 20.0, concurrent_p99_ratio: float = 3.0,
 ) -> list[str]:
     """Returns a list of human-readable regression lines (empty = pass)."""
     res, base = _detail(result), _detail(baseline)
@@ -169,6 +177,47 @@ def gate(
         and base.get("batched_speedup") is not None
     ):
         failures.append("batched: row missing from result")
+    # The r17 server-core concurrency bound, from the result alone: with
+    # each client issuing requests at a fixed rate, p99 at the widest
+    # connection count (256) must stay within ``concurrent_p99_ratio`` x
+    # p99 at the narrowest (64).  Per-client load is constant, so the
+    # ratio isolates the PER-CONNECTION cost of the runtime — a
+    # regression back to thread-per-connection scheduling (or an
+    # O(conns) pass anywhere on the hot path) blows it up no matter the
+    # host.
+    def _conc_rows(detail: dict) -> dict:
+        conc_d = detail.get("concurrency")
+        if not (isinstance(conc_d, dict)
+                and isinstance(conc_d.get("clients"), dict)):
+            return {}
+        return {
+            int(k): v
+            for k, v in conc_d["clients"].items()
+            if isinstance(v, dict) and v.get("p99_ms")
+        }
+
+    rows = _conc_rows(res)
+    if len(rows) >= 2:
+        lo, hi = min(rows), max(rows)
+        ratio = rows[hi]["p99_ms"] / rows[lo]["p99_ms"]
+        if ratio > concurrent_p99_ratio:
+            failures.append(
+                f"concurrency.p99_ratio: {ratio:.2f} > "
+                f"{concurrent_p99_ratio} (p99 {rows[hi]['p99_ms']:.1f} "
+                f"ms at {hi} clients vs {rows[lo]['p99_ms']:.1f} ms at "
+                f"{lo}) — per-connection cost no longer bounded "
+                "(server core regressed toward thread-per-connection?)"
+            )
+    # The backstop keys on USABLE rows, not the key's mere presence: a
+    # result that kept a "concurrency" dict but lost a client row (or
+    # its p99) would otherwise skip the headline gate while reporting
+    # PASS.
+    if len(_conc_rows(base)) >= 2 and len(rows) < 2:
+        failures.append(
+            f"concurrency: only {len(rows)} gated client row(s) in the "
+            "result (baseline gates 2) — the p99-ratio check silently "
+            "stopped running"
+        )
     # The r9 shard-scaling acceptance bound, from the result alone: the
     # sharded cold pull must genuinely parallelize.  Gated only at the
     # full 64 MB payload (the acceptance size); hosts too small to express
@@ -296,6 +345,11 @@ def main():
                     help="max replicated-push latency multiplier over the "
                     "unreplicated push (r12: the dedup mirror is "
                     "header-only, so ~1 extra small round trip)")
+    ap.add_argument("--concurrent-p99-ratio", type=float, default=3.0,
+                    help="r17 server-core bound: max p99 multiplier from "
+                    "the narrowest to the widest connection count on the "
+                    "serving bench's paced concurrency axis (64 -> 256 "
+                    "clients at fixed per-client rate)")
     ap.add_argument("--loadsim-p99-ratio", type=float, default=20.0,
                     help="loose cross-host tripwire for loadsim verdicts: "
                     "max p99_ms multiplier over the checked-in baseline "
@@ -328,6 +382,7 @@ def main():
         serving_speedup=args.serving_speedup,
         replicated_overhead=args.replicated_overhead,
         loadsim_p99_ratio=args.loadsim_p99_ratio,
+        concurrent_p99_ratio=args.concurrent_p99_ratio,
     )
     if failures:
         print("PERF_GATE FAIL")

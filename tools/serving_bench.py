@@ -13,6 +13,16 @@ apply, per-request scatter.  Two regimes per row set:
   arriving while an apply runs coalesce into the next batch, so the apply
   cost amortizes over up to ``max_batch`` requests.
 
+A third regime since r17 (the unified server core):
+
+- **concurrency** — ``--clients=64,256`` connections, each issuing
+  requests at a FIXED per-client rate (paced, open-loop per client).
+  Load scales with the connection count, so the p99 ratio between the
+  widest and narrowest counts prices the PER-CONNECTION cost of the
+  server runtime — the C10k claim the selector core makes.  Gated by
+  ``perf_gate``'s ``concurrent_p99_ratio`` rule (p99 at 256 <= 3x p99
+  at 64, from the result alone).
+
 Acceptance contract (ISSUE 5): ``batched_speedup = batched.qps /
 single.qps >= 3.0`` at ``max_batch=32`` — enforced by ``tools/perf_gate.py``
 from the result file alone, plus the usual memcpy-normalized throughput
@@ -153,6 +163,82 @@ def drive(
     }
 
 
+def drive_paced(
+    addr, *, clients: int, rate_per_client: float, duration_s: float,
+    rows: int,
+) -> dict:
+    """The r17 concurrency axis: ``clients`` connections each issuing
+    requests at a FIXED per-client rate (a paced, open-loop-per-client
+    load), latency measured per request.  Holding per-client behavior
+    constant while the connection count scales 4x is what isolates the
+    per-connection cost of the server runtime: under the selector core,
+    p99 stays bounded as connections multiply; a regression back to
+    per-connection threads/convoys (or an O(conns) selector pass) shows
+    up directly as the p99 ratio blowing past the gate."""
+    per = max(1, int(rate_per_client * duration_s))
+    lat: list[list[float]] = [[] for _ in range(clients)]
+    errors: list = []
+    x = np.random.default_rng(7).normal(size=(rows, D_IN)).astype(np.float32)
+    start = threading.Barrier(clients + 1)
+    period = 1.0 / rate_per_client
+
+    def body(ci: int) -> None:
+        try:
+            c = serve.ServeClient(*addr, role=f"bench{ci}_sv")
+            c.predict({"x": x})  # warm (connect + jit outside the window)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            # ABORT the barrier rather than wait it with a timeout: a
+            # timed compensation can itself break the barrier when 255
+            # peers warm slowly, surfacing BrokenBarrierError instead of
+            # the real failure.  Aborting releases everyone immediately
+            # and the main thread re-raises errors[0].
+            errors.append(e)
+            start.abort()
+            return
+        try:
+            start.wait()
+        except threading.BrokenBarrierError:
+            c.close()
+            return
+        try:
+            # Deterministic per-client phase spreads arrivals uniformly.
+            next_t = time.perf_counter() + (ci % 16) * period / 16
+            for _ in range(per):
+                now = time.perf_counter()
+                if now < next_t:
+                    time.sleep(next_t - now)
+                next_t += period
+                t0 = time.perf_counter()
+                c.predict({"x": x})
+                lat[ci].append(time.perf_counter() - t0)
+            c.close()
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=body, args=(i,)) for i in range(clients)]
+    for t in threads:
+        t.start()
+    try:
+        start.wait()
+    except threading.BrokenBarrierError:
+        pass  # a warm-up failed; errors[0] carries the cause
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    all_lat = np.concatenate([np.asarray(l) for l in lat if l])
+    return {
+        "clients": clients,
+        "rate_per_client": rate_per_client,
+        "requests": int(all_lat.size),
+        "qps": all_lat.size / dt,
+        "p50_ms": float(np.percentile(all_lat, 50) * 1e3),
+        "p99_ms": float(np.percentile(all_lat, 99) * 1e3),
+    }
+
+
 def best_of(trials: int, fn) -> dict:
     rows = [fn() for _ in range(trials)]
     return max(rows, key=lambda r: r["qps"])
@@ -207,6 +293,31 @@ def run(args) -> dict:
         # The headline batched row: the sweep's widest client count (the
         # regime that can actually fill max_batch).
         detail["batched"] = sweep[str(max(args.client_sweep))]
+        # The r17 concurrency axis (--clients=64,256): paced per-client
+        # load, p99 vs connection count.  The perf_gate rule
+        # ``concurrent_p99_ratio`` bounds p99 at the widest count to 3x
+        # the narrowest — the "bounded p99 under C10k-style connection
+        # scaling" acceptance of the unified server core.
+        if args.clients:
+            conc_rows = {}
+            for nc in args.clients:
+                conc_rows[str(nc)] = drive_paced(
+                    addr, clients=nc,
+                    rate_per_client=args.concurrency_rate,
+                    duration_s=args.concurrency_secs, rows=args.rows,
+                )
+            ratio = None
+            lo, hi = min(args.clients), max(args.clients)
+            if lo != hi and conc_rows[str(lo)]["p99_ms"] > 0:
+                ratio = (
+                    conc_rows[str(hi)]["p99_ms"] / conc_rows[str(lo)]["p99_ms"]
+                )
+            detail["concurrency"] = {
+                "rate_per_client": args.concurrency_rate,
+                "duration_s": args.concurrency_secs,
+                "clients": conc_rows,
+                "p99_ratio": ratio,
+            }
         for row in ("single", "batched"):
             detail[row]["stream_mbs"] = (
                 detail[row]["qps"] * payload_bytes / 1e6
@@ -248,6 +359,18 @@ def main():
     ap.add_argument("--client-sweep", type=int, nargs="+",
                     default=[4, 16, 32],
                     help="concurrent-client counts for the batched rows")
+    ap.add_argument("--clients", type=int, nargs="+", default=[64, 256],
+                    help="connection counts for the r17 concurrency axis "
+                    "(paced per-client load; p99 at max(clients) is gated "
+                    "to <= 3x p99 at min(clients)).  Empty list skips the "
+                    "axis")
+    ap.add_argument("--concurrency-rate", type=float, default=2.0,
+                    help="per-client request rate (req/s) on the "
+                    "concurrency axis — load scales WITH the connection "
+                    "count, so the ratio isolates per-connection runtime "
+                    "cost, not saturation queueing")
+    ap.add_argument("--concurrency-secs", type=float, default=10.0,
+                    help="per-row wall time on the concurrency axis")
     ap.add_argument("--n-single", type=int, default=300,
                     help="single-client measured requests")
     ap.add_argument("--n-batched", type=int, default=2000,
@@ -266,6 +389,7 @@ def main():
         args.n_batched = min(args.n_batched, 600)
         args.trials = 1
         args.seconds_cap = min(args.seconds_cap, 10.0)
+        args.concurrency_secs = min(args.concurrency_secs, 5.0)
 
     detail = run(args)
 
