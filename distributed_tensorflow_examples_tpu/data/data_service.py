@@ -70,7 +70,7 @@ from typing import Callable, Iterator, Sequence
 
 import numpy as np
 
-from ..parallel import server_core, wire
+from ..parallel import retry, server_core, wire
 from ..utils import faults, telemetry
 from . import filestream
 
@@ -480,10 +480,15 @@ class DataServiceServer:
             }
         # The uniform runtime-accounting shape (r17): requests/live_conns
         # come from the shared server core, so the counters mean the same
-        # thing on every service's STATS answer.
+        # thing on every service's STATS answer.  The admission-control
+        # shed counters (r18) surface top-level too, so dtxtop and the
+        # loadsim overload verdict read one shape across all three
+        # services (the native PS exports the same two keys).
         core = self._core.core_stats()
         out["requests"] = core["requests"]
         out["live_conns"] = core["live_conns"]
+        out["shed_total"] = core["shed_total"]
+        out["queue_deadline_drops"] = core["queue_deadline_drops"]
         out["core"] = core
         # Process-wide registry + flight-recorder depth ride along (r13):
         # one STATS scrape reads the server's dispatcher counters AND the
@@ -606,6 +611,10 @@ class DataServiceClient:
             (faults.current_role() or "client") + "_ds"
         )
         self._injector = faults.client_injector(self.role)
+        # Shared retry discipline (r18): replays and shed retries spend
+        # this budget; exhaustion surfaces as DSVCDeadlineError plus a
+        # flight-recorder event (parallel/retry.py).
+        self._budget = retry.RetryBudget()
         self._lock = threading.RLock()
         self._in_recovery = False
         self._callbacks: list = []
@@ -698,10 +707,25 @@ class DataServiceClient:
         if self._sock is None:
             raise ConnectionError("not connected")
         try:
-            self._sock.settimeout(
+            eff_deadline = (
                 deadline_s if deadline_s is not None else self._op_timeout
             )
-            self._sock.sendall(wire.pack_request(op, name, a, b, 0))
+            self._sock.settimeout(eff_deadline)
+            # Deadline propagation (r18): the remaining per-op budget rides
+            # in the frame header, so the server core sheds this request —
+            # instead of dispatching it to a worker — once this client has
+            # already abandoned it.  NEVER on HELLO itself: the stamp is a
+            # v4 construct and HELLO is the frame that DISCOVERS the
+            # peer's version — a stamped HELLO against a pre-v4 server
+            # would misparse instead of answering the loud version
+            # mismatch (every later op follows a v4-confirmed HELLO).
+            self._sock.sendall(wire.pack_request(
+                op, name, a, b, 0,
+                deadline_ms=(
+                    0 if eff_deadline is None or op == DSVC_HELLO
+                    else max(1, int(eff_deadline * 1000))
+                ),
+            ))
             hdr = memoryview(self._hdr)
             wire.recv_exact(self._sock, hdr)
             status, nbytes = wire.RESP_HDR.unpack(self._hdr)
@@ -718,10 +742,14 @@ class DataServiceClient:
 
     def _recover(self, t_end: float) -> None:
         attempt = 0
+        immediate = False
         while True:
-            if attempt:
-                delay = min(self._backoff * (2 ** min(attempt - 1, 6)), 2.0)
+            if attempt and not immediate:
+                # Jittered backoff (r18): recovering peers decorrelate
+                # their re-dials instead of re-arriving in lockstep.
+                delay = retry.jittered(self._backoff, attempt - 1, cap_s=2.0)
                 time.sleep(min(delay, max(0.0, t_end - time.monotonic())))
+            immediate = False
             if time.monotonic() >= t_end:
                 faults.log_event(
                     "reconnect_gave_up", role=self.role, host=self._host,
@@ -733,10 +761,20 @@ class DataServiceClient:
                     f"for {self._reconnect_deadline:.0f}s ({attempt} attempts)"
                 )
             attempt += 1
+            # Per-address circuit breaker (r18): a freshly-proven-dead
+            # address fails fast for its open window instead of burning
+            # another connect timeout (shared process-wide, so every
+            # client of this server pays ONE discovery).
+            breaker = retry.breaker_for((self._host, self._port))
+            if not breaker.allow():
+                breaker.wait_for_probe(t_end)
+                immediate = True  # the wait was this attempt's pacing
+                continue
             try:
                 self._connect()
                 self._register()
             except OSError:
+                breaker.on_failure()
                 self._sever()
                 continue
             except DSVCRejectedError:
@@ -746,6 +784,7 @@ class DataServiceClient:
                 # instead of burning the whole reconnect budget to
                 # report the service "unreachable" (the exact failure
                 # mode the typed rejection exists to prevent).
+                breaker.on_success()  # the address answered: not dead
                 raise
             except DSVCError:
                 # A callback's single-attempt op hit a transport fault: same
@@ -754,6 +793,7 @@ class DataServiceClient:
                 # harmless and bounded by the deadline.)
                 self._sever()
                 continue
+            breaker.on_success()
             faults.log_event("reconnected", role=self.role, attempts=attempt)
             return
 
@@ -762,15 +802,21 @@ class DataServiceClient:
         batch: bool = False,
     ):
         """One request/response; recovers + replays on transport failure
-        (every DSVC op is replay-safe — see class docstring)."""
+        (every DSVC op is replay-safe — see class docstring).  A server
+        SHED (the RETRY_LATER band, r18 admission control) is retried
+        with jittered backoff through the shared retry budget, bounded
+        by the op deadline — never at line rate."""
         with self._lock:
             if self._injector is not None and self._injector.before_op(op):
                 self._sever()  # injected drop_conn
             t_end = None
+            shed = retry.ShedRetry(self._budget, self._op_timeout)
             while True:
                 if self._sock is not None:
                     try:
-                        return self._attempt(op, name, a, b, batch=batch)
+                        status, payload = self._attempt(
+                            op, name, a, b, batch=batch
+                        )
                     except OSError as e:
                         if self._in_recovery or self._reconnect_deadline <= 0:
                             raise DSVCError(f"dsvc op {op} failed: {e!r}") from e
@@ -778,10 +824,32 @@ class DataServiceClient:
                             "conn_lost", role=self.role, op_code=op,
                             error=type(e).__name__,
                         )
+                    else:
+                        hint = wire.retry_after_ms(status)
+                        if hint is None:
+                            self._budget.on_success()
+                            return status, payload
+                        # One spelling of the shed-retry discipline
+                        # (retry.ShedRetry): jittered off the server's
+                        # hint, through the budget, deadline-bounded.
+                        if not shed.backoff(hint):
+                            raise DSVCDeadlineError(
+                                f"data service at {self._host}:{self._port} "
+                                f"kept shedding op {op} (RETRY_LATER) past "
+                                "the op deadline / retry budget"
+                            )
+                        continue
                 elif self._in_recovery or self._reconnect_deadline <= 0:
                     raise DSVCError(f"dsvc op {op} failed: not connected")
                 if t_end is None:
                     t_end = time.monotonic() + self._reconnect_deadline
+                # A transport replay spends the shared retry budget: a
+                # storm of failing ops cannot replay unboundedly.
+                if not self._budget.try_spend():
+                    raise DSVCDeadlineError(
+                        f"data service at {self._host}:{self._port} retry "
+                        f"budget exhausted replaying op {op}"
+                    )
                 self._recover(t_end)
 
     # -- convenience ops -----------------------------------------------------

@@ -227,7 +227,29 @@ constexpr bool is_control_op(uint8_t op) {
 }
 
 // v3 (r12): HELLO b-word field relayout — see wire.py WIRE_VERSION.
-constexpr int64_t kWireVersion = 3;
+// v4 (r18): optional per-frame deadline stamp + the RETRY_LATER shed band.
+constexpr int64_t kWireVersion = 4;
+
+// Graceful load shedding (r18, wire.py parity).  A request whose caller
+// stamped a deadline (op-byte bit kDeadlineFlag + one trailing u32
+// deadline_ms after the standard tail) tells the server how long the
+// caller will still wait: blocking-op waits are CLAMPED to it, and a
+// blocking op whose remaining budget is below kMinBlockBudgetMs is SHED
+// up front with `kRetryLaterBase - retry_after_ms` — the typed
+// retry-later answer (hint packed into the status, like the HELLO
+// shard-mismatch echo) — instead of parking a serving thread on work the
+// caller will abandon.  Control-plane ops are never shed.
+constexpr int64_t kDeadlineFlag = 0x80;
+constexpr int64_t kRetryLaterBase = -1000;
+constexpr int64_t kRetryLaterSpan = 600000;
+constexpr int64_t kMinBlockBudgetMs = 10;
+constexpr int64_t kShedRetryAfterMs = 50;
+
+inline int64_t retry_later_status(int64_t retry_after_ms) {
+  if (retry_after_ms < 0) retry_after_ms = 0;
+  if (retry_after_ms > kRetryLaterSpan) retry_after_ms = kRetryLaterSpan;
+  return kRetryLaterBase - retry_after_ms;
+}
 
 // Sharded PS (r9, field layout revised r12): HELLO's b operand
 // additionally carries the SHARD IDENTITY the client expects of this
@@ -376,6 +398,14 @@ struct Server {
   std::atomic<int64_t> fwd_refused{0};
   std::atomic<int64_t> repl_syncs_served{0};
   std::atomic<int64_t> mirror_applies{0};
+  // Admission control (r18): requests answered RETRY_LATER instead of
+  // served.  queue_deadline_drops counts the subset shed because the
+  // caller's stamped deadline left no budget for the blocking wait —
+  // work the caller had already abandoned, dropped before a queue was
+  // touched.  Exported by STATS next to the request counter so dtxtop
+  // (and the loadsim overload verdict) can see shedding per shard.
+  std::atomic<int64_t> shed_total{0};
+  std::atomic<int64_t> queue_deadline_drops{0};
   // Membership lease registry (r14): live members keyed by their packed
   // member string.  Own mutex — heartbeats must never contend with the
   // object table's hot path.  ``leases_expired`` counts every lease that
@@ -1038,7 +1068,7 @@ std::string build_stats_json(Server* s) {
     rs_pending = s->reshard_pending_version;
     rs_committed = s->reshard_version;
   }
-  char buf[1280];
+  char buf[1536];
   int n = std::snprintf(
       buf, sizeof(buf),
       "{\"service\":\"ps\",\"shard_id\":%d,\"shard_count\":%d,"
@@ -1050,6 +1080,7 @@ std::string build_stats_json(Server* s) {
       "\"leases\":%lld,\"leases_expired\":%lld,"
       "\"reshard_syncs\":%lld,\"draining\":%d,"
       "\"reshard_pending\":%lld,\"reshard_committed\":%lld,"
+      "\"shed_total\":%lld,\"queue_deadline_drops\":%lld,"
       "\"acc_deduped\":%lld,\"acc_dropped\":%lld,"
       "\"gq_deduped\":%lld,\"gq_dropped\":%lld}",
       s->shard_id, s->shard_count,
@@ -1075,6 +1106,9 @@ std::string build_stats_json(Server* s) {
           s->reshard_syncs.load(std::memory_order_relaxed)),
       s->draining.load() ? 1 : 0, static_cast<long long>(rs_pending),
       static_cast<long long>(rs_committed),
+      static_cast<long long>(s->shed_total.load(std::memory_order_relaxed)),
+      static_cast<long long>(
+          s->queue_deadline_drops.load(std::memory_order_relaxed)),
       static_cast<long long>(acc_ded), static_cast<long long>(acc_drop),
       static_cast<long long>(gq_ded), static_cast<long long>(gq_drop));
   if (n < 0 || n >= static_cast<int>(sizeof(buf))) return "{}";
@@ -1112,12 +1146,34 @@ void serve_conn_impl(Server* s, int fd) {
   for (;;) {
     uint8_t op = 0, name_len = 0;
     if (!read_n(fd, &op, 1) || !read_n(fd, &name_len, 1)) break;
+    // Deadline stamp (r18): bit 7 of the op byte flags one trailing u32
+    // deadline_ms after the standard tail — the caller's remaining
+    // per-op budget.  0 = un-stamped (the v3-identical framing).
+    const bool stamped = (op & kDeadlineFlag) != 0;
+    op = static_cast<uint8_t>(op & ~kDeadlineFlag);
     std::string name(name_len, '\0');
     if (name_len && !read_n(fd, name.data(), name_len)) break;
     int64_t a = 0, b = 0;
     uint32_t plen = 0;
     if (!read_n(fd, &a, 8) || !read_n(fd, &b, 8) || !read_n(fd, &plen, 4))
       break;
+    uint32_t deadline_ms = 0;
+    if (stamped && !read_n(fd, &deadline_ms, 4)) break;
+    // A stamped blocking-op wait is clamped to the caller's remaining
+    // budget: 0 in the operand means "block forever" (pre-r6 wire), which
+    // a stamp bounds too — a dead/abandoning caller must never strand
+    // this connection's thread past its own deadline.
+    const auto clamp_wait = [&](int64_t requested_ms) -> int64_t {
+      if (!stamped) return requested_ms;
+      const int64_t budget = static_cast<int64_t>(deadline_ms);
+      if (requested_ms <= 0) return budget;
+      return requested_ms < budget ? requested_ms : budget;
+    };
+    // Shed gate for the blocking-op queues: a caller whose stamped budget
+    // is already below the minimum useful wait gets the typed
+    // RETRY_LATER answer (with hint) instead of a futile bounded wait.
+    const bool shed_blocking =
+        stamped && static_cast<int64_t>(deadline_ms) < kMinBlockBudgetMs;
     if (plen > kMaxPayload) break;
     const size_t esize = wire_dtype == 1 ? 2 : 4;
     // Allocation is sized from SERVER-side state only: the expected element
@@ -1558,9 +1614,19 @@ void serve_conn_impl(Server* s, int fd) {
         break;
       case ACC_TAKE:
         if ((o = find(s, name, 'a'))) {
+          if (shed_blocking) {
+            // r18 admission: the caller's stamped budget cannot cover a
+            // blocking wait — answer RETRY_LATER before touching the
+            // accumulator (the abandoned-work drop).
+            status = retry_later_status(kShedRetryAfterMs);
+            s->shed_total.fetch_add(1, std::memory_order_relaxed);
+            s->queue_deadline_drops.fetch_add(1, std::memory_order_relaxed);
+            break;
+          }
           // b = client deadline in ms (0 = block forever, pre-r6 wire).
           status = acc_take_timed(
-              o->handle, a, b, ensure_out((size_t)acc_num_elems(o->handle)));
+              o->handle, a, clamp_wait(b),
+              ensure_out((size_t)acc_num_elems(o->handle)));
           if (status < 0) out_len = 0;
         }
         break;
@@ -1590,7 +1656,15 @@ void serve_conn_impl(Server* s, int fd) {
         break;
       case TQ_POP:
         // a = client deadline in ms (0 = block forever, pre-r6 wire).
-        if ((o = find(s, name, 't'))) status = tq_pop_timed(o->handle, a);
+        if ((o = find(s, name, 't'))) {
+          if (shed_blocking) {
+            status = retry_later_status(kShedRetryAfterMs);
+            s->shed_total.fetch_add(1, std::memory_order_relaxed);
+            s->queue_deadline_drops.fetch_add(1, std::memory_order_relaxed);
+            break;
+          }
+          status = tq_pop_timed(o->handle, clamp_wait(a));
+        }
         break;
       case GQ_PUSH:
         // Size validated against the QUEUE's element count in the
@@ -1599,18 +1673,36 @@ void serve_conn_impl(Server* s, int fd) {
         if ((o = payload_obj)) status = gq_push(o->handle, a, payload.data());
         break;
       case GQ_PUSH_TAGGED:
-        if ((o = payload_obj))
+        if ((o = payload_obj)) {
+          if (shed_blocking) {
+            // The blocking-op-queue shed: a full queue's space wait would
+            // outlive the caller's budget — RETRY_LATER instead of
+            // parking this thread (and re-reading the payload) for it.
+            status = retry_later_status(kShedRetryAfterMs);
+            s->shed_total.fetch_add(1, std::memory_order_relaxed);
+            s->queue_deadline_drops.fetch_add(1, std::memory_order_relaxed);
+            break;
+          }
           status = gq_push_tagged(o->handle, a, b >> kTagWorkerShift,
-                                  b & kTagSeqMask, kPushSpaceWaitMs,
+                                  b & kTagSeqMask,
+                                  clamp_wait(kPushSpaceWaitMs),
                                   payload.data());
+        }
         break;
       case GQ_POP:
         if ((o = find(s, name, 'g'))) {
+          if (shed_blocking) {
+            status = retry_later_status(kShedRetryAfterMs);
+            s->shed_total.fetch_add(1, std::memory_order_relaxed);
+            s->queue_deadline_drops.fetch_add(1, std::memory_order_relaxed);
+            break;
+          }
           // Output sized from the server-side queue, NEVER from client
           // input (a client-controlled size here was a heap overflow).
           // b = client deadline in ms (0 = block forever, pre-r6 wire).
           status = gq_pop_timed(
-              o->handle, b, ensure_out((size_t)gq_num_elems(o->handle)));
+              o->handle, clamp_wait(b),
+              ensure_out((size_t)gq_num_elems(o->handle)));
           if (status < 0) out_len = 0;
         }
         break;

@@ -80,7 +80,7 @@ import numpy as np
 
 from .. import native
 from ..utils import faults, telemetry
-from . import wire
+from . import retry, wire
 
 # Op codes — aliases into the ONE registry (wire.PS_OPS, the single Python
 # definition site; tools/dtxlint pins it against native/ps_server.cc's
@@ -406,7 +406,14 @@ class PSClient:
         self._control_fault_points = control_ops_are_fault_points
         self._injectors: dict[int, faults.ClientFaultInjector | None] = {}
         self._injector = self._leg_injector(0)
+        # Shared retry discipline (r18, parallel/retry.py): replays and
+        # shed retries spend this token-bucket budget (refilled by
+        # successes), so N clients recovering from one blip can never
+        # tighten into a retry storm; exhaustion surfaces as the typed
+        # PSDeadlineError plus a flight-recorder event.
+        self._budget = retry.RetryBudget()
         self._sock: socket.socket | None = None
+        self._negotiated = False  # peer confirmed v4: deadline stamps OK
         self._hdr = bytearray(12)  # reusable response-header buffer
         # Per-replica incarnations + the shard's state-lineage token (r12):
         # a reconnect that finds the SAME token — on any replica — proves
@@ -488,6 +495,11 @@ class PSClient:
             # framing is byte-identical to v1, so nothing can misparse and
             # the connect stays one round trip cheaper.
             self._negotiate()
+            # The peer answered a v4 HELLO: deadline stamps (r18) are
+            # safe on this connection.  An UN-negotiated plain-f32
+            # connection stays v1-byte-identical — it may be talking to
+            # a pre-v4 peer that would misparse the stamp.
+            self._negotiated = True
 
     def _negotiate(self) -> None:
         """HELLO on the fresh socket.  Transport failures raise OSError
@@ -551,6 +563,7 @@ class PSClient:
 
     def _sever(self) -> None:
         sock, self._sock = self._sock, None
+        self._negotiated = False
         if sock is not None:
             try:
                 sock.close()
@@ -628,8 +641,18 @@ class PSClient:
         shape) — returned as ``bytes``, never dtype-decoded."""
         if self._sock is None:
             raise ConnectionError("not connected")
+        # Deadline propagation (r18): the caller's remaining per-op budget
+        # rides in the frame header, so the server clamps blocking waits
+        # to it and sheds work this client has already abandoned instead
+        # of burning a thread on a dead request.  ONLY on a negotiated
+        # (HELLO'd v4) connection — an un-negotiated plain-f32 socket may
+        # be talking to a v1-framing peer that would misparse the stamp.
         header = wire.pack_request(
-            op, name, a, b, 0 if payload is None else payload.size
+            op, name, a, b, 0 if payload is None else payload.size,
+            deadline_ms=(
+                0 if deadline_s is None or not self._negotiated
+                else max(1, int(deadline_s * 1000))
+            ),
         )
         try:
             self._sock.settimeout(deadline_s)
@@ -738,8 +761,10 @@ class PSClient:
         while True:
             if attempt and not immediate:
                 # first attempt is immediate — the common drop is transient
-                # with a healthy server; backoff paces retries.
-                delay = min(self._backoff * (2 ** min(attempt - 1, 6)), 2.0)
+                # with a healthy server; JITTERED backoff paces retries so
+                # N clients recovering from one blip spread their
+                # re-arrival instead of re-dialing in lockstep (r18).
+                delay = retry.jittered(self._backoff, attempt - 1, cap_s=2.0)
                 time.sleep(min(delay, max(0.0, t_end - time.monotonic())))
             immediate = False
             if time.monotonic() >= t_end:
@@ -755,12 +780,27 @@ class PSClient:
                     f"{self._reconnect_deadline:.0f}s ({attempt} attempts)"
                 )
             attempt += 1
+            # Per-address circuit breaker (r18, process-wide): an address
+            # that just failed ``threshold`` consecutive dials is OPEN —
+            # skip the dial (fail over to the other replica, which has
+            # its own breaker, or wait out part of the window) instead of
+            # burning another connect timeout against a dead peer.
+            breaker = retry.breaker_for((self._host, self._port))
+            if not breaker.allow():
+                if len(self._addrs) > 1:
+                    self._switch_replica((self._cur + 1) % len(self._addrs))
+                else:
+                    breaker.wait_for_probe(t_end)
+                    immediate = True  # the wait was this attempt's pacing
+                continue
             try:
                 self._connect()
             except OSError:
+                breaker.on_failure()
                 if len(self._addrs) > 1:
                     self._switch_replica((self._cur + 1) % len(self._addrs))
                 continue
+            breaker.on_success()
             try:
                 # After several rounds stuck on state-lost replicas (the
                 # OTHER replica stayed unreachable throughout), stop
@@ -897,10 +937,11 @@ class PSClient:
             ):
                 self._sever()  # injected drop_conn: fail this op's transport
             t_end = None
+            shed = retry.ShedRetry(self._budget, self._op_timeout)
             while True:
                 if self._sock is not None:
                     try:
-                        return self._attempt(
+                        status, data = self._attempt(
                             op, name, a, b, wire_payload, deadline_s=deadline,
                             out=out, raw=raw,
                         )
@@ -917,10 +958,41 @@ class PSClient:
                             "conn_lost", role=self.role, op_code=op,
                             error=type(e).__name__,
                         )
+                    else:
+                        hint = wire.retry_after_ms(status)
+                        if hint is None:
+                            # Every success funds future retries (the
+                            # token-bucket budget, r18).
+                            self._budget.on_success()
+                            return status, data
+                        # The server SHED this request (RETRY_LATER,
+                        # r18 admission control): retry with jittered
+                        # backoff THROUGH the budget, bounded by the op
+                        # deadline — never at line rate
+                        # (retry.ShedRetry, the one spelling).
+                        if not shed.backoff(hint):
+                            raise PSDeadlineError(
+                                f"PS at {self._host}:{self._port} kept "
+                                f"shedding op {op} (RETRY_LATER) past the "
+                                "op deadline / retry budget — the server "
+                                "is overloaded; back off and retry later"
+                            )
+                        continue
                 elif self._in_recovery or self._reconnect_deadline <= 0:
                     raise PSError(f"PS op {op} failed: not connected")
                 if t_end is None:
                     t_end = time.monotonic() + self._reconnect_deadline
+                # A transport replay is a RETRY: it spends the shared
+                # budget, so a storm of failing ops cannot re-dial and
+                # replay unboundedly (budget exhaustion = the typed
+                # deadline error, with the flight-recorder event the
+                # budget logs).
+                if not self._budget.try_spend():
+                    raise PSDeadlineError(
+                        f"PS at {self._host}:{self._port} retry budget "
+                        f"exhausted replaying op {op} — refusing to feed "
+                        "the retry storm"
+                    )
                 self._recover(t_end)
 
     def block_wait_s(self, t_end: float | None = None) -> float:

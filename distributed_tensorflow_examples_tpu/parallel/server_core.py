@@ -53,6 +53,29 @@ Python services:
 - **Graceful drain** — :meth:`drain` stops accepting, lets dispatched
   handlers finish and write buffers flush, then :meth:`stop` closes;
   zero in-flight requests are dropped on a clean shutdown.
+- **Admission control** (r18) — the request plane degrades GRACEFULLY
+  instead of collapsing.  The dispatch queue is BOUNDED
+  (``max_dispatch_depth``): past it, new data-plane frames are answered
+  the typed ``wire.RETRY_LATER_BASE`` shed status (backoff hint packed
+  into the status) instead of queueing unboundedly.  Each request
+  carries a QUEUE DEADLINE — the smaller of the service's
+  ``queue_deadline_s`` policy and the deadline the CALLER stamped into
+  the frame (``wire.DEADLINE_FLAG``, the r18 deadline-propagation wire) —
+  and a request that waited past it is shed before a worker touches it
+  (checked at dequeue AND swept ~1/s by the selector loop, so wedged
+  workers cannot strand queued requests unanswered).  Each connection
+  holds at most ``max_inflight_per_conn`` dispatched-unanswered
+  requests; pipelined excess is shed, with per-connection response
+  ORDER preserved by sequence-parked replies.  PRIORITY CLASSES:
+  control/observability ops (the service's ``control_ops``, derived
+  from ``wire.CONTROL_OPS`` — HELLO, STATS, LEASE_*, ...) are NEVER
+  shed: they bypass every admission bound, ride a priority queue the
+  workers prefer, and one DEDICATED control worker serves them even
+  when every regular worker is wedged — under saturation the cluster
+  stays observable and leases keep renewing, so overload cannot cascade
+  into false member expiry.  Shed counters (``shed_total``,
+  ``queue_deadline_drops``, ``shed_dispatch_full``,
+  ``shed_inflight_cap``) fold into :meth:`core_stats`.
 
 The native PS keeps its C++ thread-per-connection loop (its handlers are
 microseconds of mutex-guarded C++, not milliseconds of Python, so the
@@ -114,11 +137,26 @@ class Service:
                       nothing (size it to the service's real needs:
                       small for payload-less wires like dsvc, batch-
                       sized for predict).
+
+    Admission policy (r18; control ops are exempt from all three):
+
+    ``queue_deadline_s``      how long a dispatched request may WAIT for
+                              a worker before it is shed with
+                              RETRY_LATER (None = only the caller's
+                              stamped deadline applies; the effective
+                              budget is the min of the two).
+    ``max_inflight_per_conn`` dispatched-unanswered requests one
+                              connection may hold; pipelined excess is
+                              shed (order-preserving), so one aggressive
+                              peer cannot monopolize the dispatch queue.
+    ``retry_after_ms``        the backoff hint shed answers carry
+                              (``wire.retry_later_status``).
     """
 
     __slots__ = (
         "name", "handler", "control_ops", "counts_fn", "error_status",
         "accept_dtypes", "max_payload", "on_disconnect",
+        "queue_deadline_s", "max_inflight_per_conn", "retry_after_ms",
     )
 
     def __init__(
@@ -128,6 +166,9 @@ class Service:
         accept_dtypes: tuple[int, ...] = (0,),
         max_payload: int = MAX_FRAME_BYTES,
         on_disconnect: Callable | None = None,
+        queue_deadline_s: float | None = None,
+        max_inflight_per_conn: int = 16,
+        retry_after_ms: int = 50,
     ):
         if name not in wire.SERVICE_IDS:
             raise ValueError(
@@ -142,15 +183,27 @@ class Service:
         self.accept_dtypes = tuple(accept_dtypes)
         self.max_payload = min(int(max_payload), MAX_FRAME_BYTES)
         self.on_disconnect = on_disconnect
+        self.queue_deadline_s = (
+            None if queue_deadline_s is None else float(queue_deadline_s)
+        )
+        self.max_inflight_per_conn = max(1, int(max_inflight_per_conn))
+        self.retry_after_ms = max(0, int(retry_after_ms))
 
 
 class CoreConn:
-    """One live connection: parse state + write buffer + identity."""
+    """One live connection: parse state + write buffer + identity.
+
+    Responses are SEQUENCE-ORDERED (r18): every parsed frame gets the
+    connection's next sequence number, replies park in ``parked`` until
+    every earlier sequence has answered, and only then flush into the
+    write buffer — so concurrent handlers (up to the per-connection
+    in-flight cap) and immediate shed answers can never reorder the
+    response stream of a pipelining peer."""
 
     __slots__ = (
         "core", "sock", "fd", "service", "rbuf", "pending", "pbuf", "pfill",
-        "out", "out_bytes", "in_flight", "closed", "events", "peer",
-        "last_progress",
+        "out", "out_bytes", "inflight", "next_seq", "next_out", "parked",
+        "closed", "events", "peer", "last_progress",
     )
 
     def __init__(self, core: "ServerCore", sock: socket.socket, service):
@@ -163,12 +216,15 @@ class CoreConn:
         # payload fills a dedicated preallocated buffer — the bulk is
         # recv_into'd straight into it (one copy, no rbuf growth, no
         # re-copy on the selector thread).
-        self.pending = None  # (op, name, a, b) awaiting its payload
+        self.pending = None  # (op, name, a, b, deadline_ms) awaiting payload
         self.pbuf: bytearray | None = None
         self.pfill = 0
         self.out: deque = deque()  # memoryviews awaiting the selector flush
         self.out_bytes = 0
-        self.in_flight = False  # a dispatched frame awaiting its reply
+        self.inflight = 0  # dispatched frames awaiting their replies
+        self.next_seq = 0  # sequence assigned to the next parsed frame
+        self.next_out = 0  # next sequence allowed onto the wire
+        self.parked: dict[int, list] = {}  # seq -> encoded reply views
         self.closed = False
         self.events = 0  # selector interest currently registered
         self.last_progress = time.monotonic()  # last byte the peer drained
@@ -177,24 +233,31 @@ class CoreConn:
         except OSError:
             self.peer = ("?", 0)
 
+
+class _ReplyHandle:
+    """The per-request ``conn`` a handler receives: :meth:`reply` is bound
+    to that request's response SLOT in the connection's ordered stream
+    (thread-safe, callable from any thread — the async batcher-callback
+    shape), and everything else delegates to the underlying
+    :class:`CoreConn`.  A second reply to the same slot is a no-op, so a
+    timeout sweep racing the genuine resolution stays safe."""
+
+    __slots__ = ("_conn", "_seq")
+
+    def __init__(self, conn: CoreConn, seq: int):
+        self._conn = conn
+        self._seq = seq
+
     def reply(self, status: int, bufs: list | None = None) -> None:
-        """Queue one response frame (thread-safe; callable from any
-        thread).  The selector thread flushes it as the peer drains —
-        the caller NEVER blocks on the peer's read speed."""
-        views = wire.frames_to_views([
-            wire.RESP_HDR.pack(status, wire.encoded_nbytes(bufs or [])),
-            *(bufs or []),
-        ])
-        total = sum(len(v) for v in views)
-        core = self.core
-        with core._lock:
-            if self.closed:
-                return
-            self.out.extend(views)
-            self.out_bytes += total
-            self.in_flight = False
-        core._dirty.put(self)
-        core._wake()
+        """Queue this request's response frame.  The selector thread
+        flushes it (in sequence order) as the peer drains — the caller
+        NEVER blocks on the peer's read speed."""
+        self._conn.core._queue_reply(
+            self._conn, self._seq, status, bufs, dispatched=True
+        )
+
+    def __getattr__(self, item):
+        return getattr(self._conn, item)
 
 
 class ServerCore:
@@ -206,6 +269,7 @@ class ServerCore:
         workers: int = 8, backlog: int = 128, name: str = "core",
         accept_backoff_s: float = 0.2, max_buffered_bytes: int = 256 << 20,
         slow_reader_grace_s: float = 30.0, bind_retry_s: float = 5.0,
+        max_dispatch_depth: int = 512,
     ):
         self.name = name
         self._services: dict[str, Service] = {}
@@ -214,7 +278,9 @@ class ServerCore:
         self._accept_backoff_s = accept_backoff_s
         self._max_buffered = int(max_buffered_bytes)
         self._slow_grace_s = float(slow_reader_grace_s)
+        self._max_dispatch_depth = max(1, int(max_dispatch_depth))
         self._next_slow_sweep = 0.0
+        self._next_deadline_sweep = 0.0
         self._lock = threading.Lock()
         self._requests = 0
         self._accepts = 0
@@ -222,9 +288,21 @@ class ServerCore:
         self._dispatched = 0
         self._handler_errors = 0
         self._dropped_slow = 0
+        # Shed accounting (r18): every admission refusal, by cause.
+        self._shed_total = 0
+        self._shed_dispatch_full = 0
+        self._shed_inflight_cap = 0
+        self._queue_deadline_drops = 0
         self._conns: dict[int, CoreConn] = {}
         self._dirty: queue.SimpleQueue = queue.SimpleQueue()
-        self._tasks: queue.SimpleQueue = queue.SimpleQueue()
+        # Two dispatch lanes under one condition: control-plane frames ride
+        # the PRIORITY deque (never shed, preferred by every worker, owned
+        # outright by the dedicated control worker), data-plane frames the
+        # bounded regular one.
+        self._tasks_cond = threading.Condition()
+        self._tasks: deque = deque()
+        self._ptasks: deque = deque()
+        self._workers_stop = False
         self._stop_flag = False
         self._draining = False
         self._listener_retired = False
@@ -284,6 +362,16 @@ class ServerCore:
             )
             w.start()
             self._threads.append(w)
+        # The dedicated control worker (r18): serves ONLY the priority
+        # lane, so control/observability ops are answered even when every
+        # regular worker is wedged inside a slow handler — the cluster
+        # stays observable at exactly the moment that matters.
+        ctl = threading.Thread(
+            target=self._worker, kwargs={"control_only": True}, daemon=True,
+            name=f"dtx-{self.name}-ctl",
+        )
+        ctl.start()
+        self._threads.append(ctl)
         log.info(
             "%s core on port %d (%d services, %d workers)",
             self.name, self.port, len(self._services), self._n_workers,
@@ -315,7 +403,15 @@ class ServerCore:
                 "handler_errors": self._handler_errors,
                 "dropped_slow_readers": self._dropped_slow,
                 "worker_threads": self._n_workers,
-                "dispatch_depth": self._tasks.qsize(),
+                "dispatch_depth": len(self._tasks) + len(self._ptasks),
+                "max_dispatch_depth": self._max_dispatch_depth,
+                # Admission-control sheds (r18), by cause; shed_total is
+                # their sum — the externally gated "requests answered
+                # RETRY_LATER instead of served" counter.
+                "shed_total": self._shed_total,
+                "shed_dispatch_full": self._shed_dispatch_full,
+                "shed_inflight_cap": self._shed_inflight_cap,
+                "queue_deadline_drops": self._queue_deadline_drops,
                 "draining": 1 if self._draining else 0,
             }
 
@@ -337,11 +433,13 @@ class ServerCore:
         while time.monotonic() < t_end:
             with self._lock:
                 busy = any(
-                    c.in_flight or c.out for c in self._conns.values()
+                    c.inflight or c.out or c.parked
+                    for c in self._conns.values()
                 )
             if (
                 not busy
-                and self._tasks.qsize() == 0
+                and not self._tasks
+                and not self._ptasks
                 and (self._listener_retired or not self._started)
             ):
                 return True
@@ -359,8 +457,9 @@ class ServerCore:
         io_thread = self._threads[0] if self._threads else None
         if io_thread is not None:
             io_thread.join(timeout=5.0)
-        for _ in range(self._n_workers):
-            self._tasks.put(None)
+        with self._tasks_cond:
+            self._workers_stop = True
+            self._tasks_cond.notify_all()
         for t in self._threads[1:]:
             t.join(timeout=5.0)
         # Single-threaded from here: close every socket and the listener.
@@ -433,6 +532,7 @@ class ServerCore:
                         self._do_write(conn)
             self._process_dirty()
             self._sweep_slow_readers()
+            self._sweep_queue_deadlines()
             if self._draining:
                 self._retire_listener()
 
@@ -564,32 +664,50 @@ class ServerCore:
     @staticmethod
     def _parse_header(buf: bytearray, max_payload: int = MAX_FRAME_BYTES):
         """One complete request HEADER from ``buf``, or None.  Returns
-        ``((op, name, a, b, plen), consumed)`` — the incremental twin of
-        ``wire.read_request``'s header half.  The payload bound is
-        enforced HERE, the moment the header completes, before any
-        payload byte would be buffered — an absurd announced length
-        never costs memory."""
+        ``((op, name, a, b, plen, deadline_ms), consumed)`` — the
+        incremental twin of ``wire.read_request``'s header half (r18:
+        a ``wire.DEADLINE_FLAG``-stamped frame carries the caller's
+        remaining per-op deadline after the standard tail; 0 = none).
+        The payload bound is enforced HERE, the moment the header
+        completes, before any payload byte would be buffered — an absurd
+        announced length never costs memory."""
         if len(buf) < 2:
             return None
         nlen = buf[1]
+        stamped = bool(buf[0] & wire.DEADLINE_FLAG)
         hdr_end = 2 + nlen + wire.REQ_TAIL.size
+        if stamped:
+            hdr_end += wire.DEADLINE_TAIL.size
         if len(buf) < hdr_end:
             return None
         a, b, plen = wire.REQ_TAIL.unpack_from(buf, 2 + nlen)
+        deadline_ms = 0
+        if stamped:
+            (deadline_ms,) = wire.DEADLINE_TAIL.unpack_from(
+                buf, 2 + nlen + wire.REQ_TAIL.size
+            )
         if plen > max_payload:
             raise ValueError(
                 f"frame announces {plen} payload bytes (bound {max_payload})"
             )
         name = bytes(buf[2 : 2 + nlen]).decode()
-        return (buf[0], name, a, b, plen), hdr_end
+        return (
+            (buf[0] & ~wire.DEADLINE_FLAG, name, a, b, plen, deadline_ms),
+            hdr_end,
+        )
 
     def _pump(self, conn: CoreConn) -> None:
-        """Parse + dispatch frames from the connection's read buffer —
-        at most ONE frame in flight per connection (responses stay in
-        request order; a peer that pipelines is back-pressured)."""
-        while not conn.in_flight and not conn.closed:
+        """Parse + ADMIT frames from the connection's read buffer (r18).
+        Every parsed frame gets the connection's next response sequence;
+        admission then either dispatches it (within the per-connection
+        in-flight cap and the core-wide dispatch bound) or sheds it with
+        the typed RETRY_LATER answer — which parks in sequence order, so
+        a pipelining peer's response stream never reorders."""
+        while not conn.closed:
             svc = conn.service or self._default
             if conn.pending is None:
+                if self._parse_paused(conn):
+                    break  # flood guard: stop parsing until replies flush
                 try:
                     got = self._parse_header(conn.rbuf, svc.max_payload)
                 except (ValueError, struct.error, UnicodeDecodeError):
@@ -597,9 +715,9 @@ class ServerCore:
                     return
                 if got is None:
                     break
-                (op, name, a, b, plen), consumed = got
+                (op, name, a, b, plen, deadline_ms), consumed = got
                 del conn.rbuf[:consumed]
-                conn.pending = (op, name, a, b)
+                conn.pending = (op, name, a, b, deadline_ms)
                 conn.pbuf = bytearray(plen)
                 conn.pfill = 0
             # Whatever payload prefix already sits in rbuf moves over;
@@ -612,24 +730,63 @@ class ServerCore:
                 conn.pfill += take
             if conn.pfill < len(conn.pbuf):
                 break  # payload still in flight
-            op, name, a, b = conn.pending
+            op, name, a, b, deadline_ms = conn.pending
             payload = conn.pbuf
             conn.pending, conn.pbuf, conn.pfill = None, None, 0
+            seq = conn.next_seq
+            conn.next_seq += 1
             if op == wire.HELLO_OP:
-                self._handle_hello(conn, a, b)
+                self._handle_hello(conn, seq, a, b)
                 continue
-            counted = op not in svc.control_ops and (
+            control = op in svc.control_ops
+            counted = not control and (
                 svc.counts_fn is None or svc.counts_fn(op, name, a, b)
             )
+            shed = None
             with self._lock:
                 if counted:
                     self._requests += 1
-                self._dispatched += 1
-                conn.in_flight = True
-            self._tasks.put((conn, svc, (op, name, a, b, payload)))
+                if not control:
+                    # Admission: control ops bypass every bound (priority
+                    # class — never shed), data-plane frames must fit the
+                    # per-connection in-flight cap and the core-wide
+                    # dispatch bound.
+                    if conn.inflight >= svc.max_inflight_per_conn:
+                        self._shed_inflight_cap += 1
+                        self._shed_total += 1
+                        shed = svc.retry_after_ms
+                    elif len(self._tasks) >= self._max_dispatch_depth:
+                        self._shed_dispatch_full += 1
+                        self._shed_total += 1
+                        shed = svc.retry_after_ms
+                if shed is None:
+                    self._dispatched += 1
+                    conn.inflight += 1
+            if shed is not None:
+                self._queue_reply(
+                    conn, seq, wire.retry_later_status(shed), None,
+                    dispatched=False,
+                )
+                continue
+            # The queue-deadline budget: the smaller of the service's
+            # policy and the deadline the caller stamped on the wire —
+            # a request that waits past it is shed before a worker
+            # touches it (dequeue check + the selector's ~1/s sweep).
+            budget = svc.queue_deadline_s
+            if deadline_ms:
+                stamped_s = deadline_ms / 1e3
+                budget = stamped_s if budget is None else min(budget, stamped_s)
+            t_shed = None if budget is None else time.monotonic() + budget
+            task = (conn, svc, seq, t_shed, (op, name, a, b, payload))
+            with self._tasks_cond:
+                (self._ptasks if control else self._tasks).append(task)
+                # notify_all, not notify: a single notify can be consumed
+                # by the CONTROL-ONLY worker, which cannot take a regular
+                # task and would strand it until the 0.5s wait timeout.
+                self._tasks_cond.notify_all()
         self._update_interest(conn)
 
-    def _handle_hello(self, conn: CoreConn, a: int, b: int) -> None:
+    def _handle_hello(self, conn: CoreConn, seq: int, a: int, b: int) -> None:
         """HELLO answered inline on the selector thread (no payload, no
         handler work): the announced service identity routes the
         connection through the handler table; every mismatch goes
@@ -641,7 +798,82 @@ class ServerCore:
         )
         if status == wire.WIRE_VERSION:
             conn.service = svc
-        conn.reply(status, [tag] if tag else None)
+        self._queue_reply(
+            conn, seq, status, [tag] if tag else None, dispatched=False
+        )
+
+    def _queue_reply(
+        self, conn: CoreConn, seq: int, status: int, bufs: list | None, *,
+        dispatched: bool,
+    ) -> None:
+        """Park one response at its sequence slot and flush every
+        now-contiguous reply into the write buffer (thread-safe; the one
+        reply path for sync returns, async callbacks, HELLO and sheds).
+        Encoding happens BEFORE any state changes, so a buffer the wire
+        cannot encode raises to the caller with the slot still open —
+        the caller's error reply is then the slot's first (and only)
+        frame.  A second reply to an answered slot is a no-op."""
+        views = wire.frames_to_views([
+            wire.RESP_HDR.pack(status, wire.encoded_nbytes(bufs or [])),
+            *(bufs or []),
+        ])
+        total = sum(len(v) for v in views)
+        with self._lock:
+            if conn.closed:
+                return
+            if seq < conn.next_out or seq in conn.parked:
+                return  # already answered (idempotent late resolve)
+            conn.parked[seq] = views
+            # Parked bytes count toward the slow-reader bound: they are
+            # committed response memory whether or not flushable yet.
+            conn.out_bytes += total
+            if dispatched:
+                conn.inflight -= 1
+            while conn.next_out in conn.parked:
+                conn.out.extend(conn.parked.pop(conn.next_out))
+                conn.next_out += 1
+        self._dirty.put(conn)
+        self._wake()
+
+    def _shed_task(self, task, *, cause: str) -> None:
+        """Answer one queued task RETRY_LATER without running its handler
+        (the queue-deadline drop path; counted by cause)."""
+        conn, svc, seq, _t_shed, _req = task
+        with self._lock:
+            self._shed_total += 1
+            if cause == "queue_deadline":
+                self._queue_deadline_drops += 1
+        self._queue_reply(
+            conn, seq, wire.retry_later_status(svc.retry_after_ms), None,
+            dispatched=True,
+        )
+
+    def _sweep_queue_deadlines(self) -> None:
+        """Shed queued data-plane requests whose deadline budget expired
+        while they WAITED (~1/s, on the selector thread): even with every
+        worker wedged, an abandoned request gets its RETRY_LATER answer
+        instead of silently aging in the queue.  The dequeue-time check
+        in the worker covers the fast path; this sweep covers the
+        pathological one."""
+        now = time.monotonic()
+        if now < self._next_deadline_sweep:
+            return
+        self._next_deadline_sweep = now + 1.0
+        expired: list = []
+        with self._tasks_cond:
+            if not self._tasks:
+                return
+            keep: deque = deque()
+            for task in self._tasks:
+                t_shed = task[3]
+                if t_shed is not None and now > t_shed:
+                    expired.append(task)
+                else:
+                    keep.append(task)
+            if expired:
+                self._tasks = keep
+        for task in expired:
+            self._shed_task(task, cause="queue_deadline")
 
     # -- write ----------------------------------------------------------------
 
@@ -694,11 +926,26 @@ class ServerCore:
                 self._dropped_slow += 1
             self._close_conn(conn)
 
+    @staticmethod
+    def _parse_paused(conn: CoreConn) -> bool:
+        """Whether this connection's parse is paused (kernel
+        backpressure): too many replies parked out-of-order, or too many
+        frames in flight.  The in-flight bound matters for CONTROL ops —
+        they are never shed, so a peer pipelining STATS/LEASE_* at line
+        rate must be slowed by the socket, not grow the priority lane
+        unboundedly.  Data-plane frames hit the (much smaller) admission
+        caps first; this is the outer memory bound."""
+        return len(conn.parked) >= 256 or conn.inflight >= 256
+
     def _update_interest(self, conn: CoreConn) -> None:
         if conn.closed:
             return
         want = 0
-        if not conn.in_flight:
+        # Reading stays on even at the data-plane in-flight cap — excess
+        # frames are SHED (admission control), not kernel-back-pressured;
+        # only the parse-pause flood bounds (parked replies / total
+        # in-flight frames) stop the read.
+        if not self._parse_paused(conn):
             want |= selectors.EVENT_READ
         if conn.out:
             want |= selectors.EVENT_WRITE
@@ -722,6 +969,7 @@ class ServerCore:
         with self._lock:
             self._conns.pop(conn.fd, None)
             conn.out.clear()
+            conn.parked.clear()
             conn.out_bytes = 0
         if conn.events:
             try:
@@ -742,25 +990,45 @@ class ServerCore:
 
     # -- the worker pool ------------------------------------------------------
 
-    def _worker(self) -> None:
+    def _next_task(self, control_only: bool):
+        """Pop the next task: the priority lane first (every worker), the
+        regular lane only for regular workers.  None = shutting down."""
+        with self._tasks_cond:
+            while True:
+                if self._workers_stop:
+                    return None
+                if self._ptasks:
+                    return self._ptasks.popleft()
+                if not control_only and self._tasks:
+                    return self._tasks.popleft()
+                self._tasks_cond.wait(timeout=0.5)
+
+    def _worker(self, control_only: bool = False) -> None:
         while True:
-            item = self._tasks.get()
+            item = self._next_task(control_only)
             if item is None:
                 return
-            conn, svc, (op, name, a, b, payload) = item
+            conn, svc, seq, t_shed, (op, name, a, b, payload) = item
             if conn.closed:
                 continue
+            if t_shed is not None and time.monotonic() > t_shed:
+                # The request waited past its queue-deadline budget: the
+                # caller has (or is about to have) abandoned it — shed
+                # BEFORE the handler burns a worker on dead work.
+                self._shed_task(item, cause="queue_deadline")
+                continue
+            handle = _ReplyHandle(conn, seq)
             try:
                 # The unpack and the reply encode stay INSIDE the guard:
                 # a malformed handler return (or a buffer reply() cannot
                 # encode) must answer the same loud per-op error — an
                 # escape here would kill the pool worker and wedge the
-                # connection in_flight forever.
-                out = svc.handler(conn, op, name, a, b, payload)
+                # connection in flight forever.
+                out = svc.handler(handle, op, name, a, b, payload)
                 if out is ASYNC:
                     continue
                 status, bufs = out
-                conn.reply(status, bufs)
+                handle.reply(status, bufs)
             except Exception:
                 # A handler bug must surface as a LOUD per-op error on
                 # the client, not a silent connection close the client
@@ -772,4 +1040,4 @@ class ServerCore:
                 )
                 with self._lock:
                     self._handler_errors += 1
-                conn.reply(svc.error_status, None)
+                handle.reply(svc.error_status, None)

@@ -51,9 +51,15 @@ import numpy as np
 #: 32..55 -> 20..31, layout version and the repl flag added above them) —
 #: the bump makes a v2/v3 HELLO pairing fail loudly (-4) instead of a
 #: relocated field silently reading as "no expectation" and disabling the
-#: mis-wire guard.  Framing is unchanged; HELLO-less connections (plain
-#: f32, no expectations) are version-agnostic, exactly as before.
-WIRE_VERSION = 3
+#: mis-wire guard.  v4 (r18): requests may carry a per-op DEADLINE stamp
+#: (op-byte bit 7 = :data:`DEADLINE_FLAG`, a trailing ``<I`` deadline_ms
+#: field after the standard tail) and servers may SHED with the
+#: :data:`RETRY_LATER_BASE` status band — the bump makes a mixed v3/v4
+#: negotiated pairing fail loudly instead of a stamped frame misparsing
+#: as an unknown op.  Un-stamped frames stay byte-identical to v3, so
+#: HELLO-less connections (plain f32, no expectations) remain
+#: version-agnostic, exactly as before.
+WIRE_VERSION = 4
 
 #: Payload encodings (HELLO dtype codes).  f32 framing is byte-identical
 #: to wire v1; bf16 halves payload bytes and REQUIRES a negotiated peer.
@@ -337,6 +343,48 @@ HELLO_SHARD_MISMATCH = -5
 REPL_REFUSED = -6
 REPL_DIVERGED = -7
 
+# Graceful load shedding (r18, native/ps_server.cc parity).  A server that
+# ADMISSION-REFUSES a request — dispatch queue full, per-connection
+# in-flight cap exceeded, or the request waited past its queue-deadline
+# budget — answers a status in the RETRY_LATER band: ``RETRY_LATER_BASE -
+# retry_after_ms``, so the shed carries its own backoff HINT with zero
+# payload plumbing on any wire (the same pack-into-the-status trick as the
+# HELLO shard-mismatch echo).  The band spans ``RETRY_LATER_SPAN`` ms of
+# hint below the base; anything below that is NOT a shed (the shard-
+# mismatch echoes live around -1M and must never decode as one).  Shed
+# answers are RETRYABLE by contract — but only through the shared retry
+# budget (``parallel/retry.py``): a client that re-hammers a shedding
+# server at line rate is the retry storm admission control exists to
+# prevent.  Control-plane ops (wire.CONTROL_OPS) are NEVER shed: under
+# saturation the cluster stays observable and leases keep renewing, so
+# overload cannot cascade into false member expiry.
+RETRY_LATER_BASE = -1000
+RETRY_LATER_SPAN = 600_000  # max encodable hint: 10 minutes
+
+#: Request op-byte flag (bit 7; every real op code is < 0x80): the frame's
+#: standard tail is followed by one ``<I`` field carrying the caller's
+#: REMAINING per-op deadline in ms.  Servers use it to drop work the
+#: caller has already abandoned (queue-deadline shed) and to clamp
+#: blocking-op waits — a worker never burns on a request whose caller
+#: gave up.  Optional per frame: un-stamped frames are byte-identical to
+#: the v3 layout.
+DEADLINE_FLAG = 0x80
+DEADLINE_TAIL = struct.Struct("<I")
+
+
+def retry_later_status(retry_after_ms: int) -> int:
+    """The shed status for a given backoff hint (clamped to the band)."""
+    return RETRY_LATER_BASE - max(0, min(int(retry_after_ms), RETRY_LATER_SPAN))
+
+
+def retry_after_ms(status: int) -> int | None:
+    """The backoff hint a RETRY_LATER status carries, or None when
+    ``status`` is not a shed (the band check keeps the far-more-negative
+    shard-mismatch echoes from ever decoding as one)."""
+    if RETRY_LATER_BASE - RETRY_LATER_SPAN <= status <= RETRY_LATER_BASE:
+        return RETRY_LATER_BASE - status
+    return None
+
 # Service identity (r10): every wire service has an id + a 4-byte tag.  A
 # client announces the service it EXPECTS in HELLO's b operand (bits
 # 56..62 — above the shard-identity bits, below the sign bit; the native
@@ -473,9 +521,21 @@ REQ_TAIL = struct.Struct("<qqI")
 RESP_HDR = struct.Struct("<qI")
 
 
-def pack_request(op: int, name: str, a: int, b: int, payload_len: int) -> bytes:
-    """The request frame header (everything but the payload)."""
+def pack_request(
+    op: int, name: str, a: int, b: int, payload_len: int,
+    deadline_ms: int = 0,
+) -> bytes:
+    """The request frame header (everything but the payload).
+    ``deadline_ms`` > 0 stamps the caller's remaining per-op deadline
+    (r18): the op byte carries :data:`DEADLINE_FLAG` and one ``<I`` field
+    follows the standard tail — both ends must speak wire v4."""
     nm = name.encode()
+    if deadline_ms > 0:
+        return (
+            struct.pack("<BB", op | DEADLINE_FLAG, len(nm)) + nm
+            + REQ_TAIL.pack(a, b, payload_len)
+            + DEADLINE_TAIL.pack(min(int(deadline_ms), RETRY_LATER_SPAN))
+        )
     return struct.pack("<BB", op, len(nm)) + nm + REQ_TAIL.pack(a, b, payload_len)
 
 
@@ -563,7 +623,10 @@ def recv_exact(sock, view: memoryview) -> None:
 def read_request(sock, hdr2: bytearray | None = None):
     """Server-side request parse: returns ``(op, name, a, b, payload_len)``
     with the payload left unread on the socket (the handler decides the
-    receive buffer), or None on a clean EOF before a new frame."""
+    receive buffer), or None on a clean EOF before a new frame.  A
+    deadline-stamped frame (r18) has its stamp consumed and discarded —
+    this blocking helper serves tests and tooling; the server core's
+    incremental parser is where the stamp is acted on."""
     head = memoryview(hdr2 if hdr2 is not None else bytearray(2))
     try:
         recv_exact(sock, head)
@@ -578,6 +641,10 @@ def read_request(sock, hdr2: bytearray | None = None):
     tail = bytearray(REQ_TAIL.size)
     recv_exact(sock, memoryview(tail))
     a, b, plen = REQ_TAIL.unpack(tail)
+    if op & DEADLINE_FLAG:
+        stamp = bytearray(DEADLINE_TAIL.size)
+        recv_exact(sock, memoryview(stamp))
+        op &= ~DEADLINE_FLAG & 0xFF
     return op, name.decode(), a, b, plen
 
 

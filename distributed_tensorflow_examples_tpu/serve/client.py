@@ -18,6 +18,16 @@ replica kill + supervised restart, the pool absorbs the gap: requests keep
 succeeding on the surviving replicas, and the healed replica rejoins the
 rotation when its ejection expires — the "zero failed client requests"
 contract the fault tests pin.
+
+r18 (graceful degradation): both layers run the shared retry discipline
+(``parallel/retry.py``).  A replica's RETRY_LATER shed answer carries its
+own backoff hint in the status; the pool HONORS it — the shedding replica
+benches for the hinted window and, once a rotation sweep has seen only
+sheds (pool-WIDE overload), the next attempt waits a jittered hint first
+instead of re-hammering the rotation at line rate (rotation must not
+amplify an overload).  Transport replays and shed retries spend a
+token-bucket retry budget; per-address circuit breakers fail dead peers
+fast; every backoff is jittered so recovering clients decorrelate.
 """
 
 from __future__ import annotations
@@ -29,7 +39,7 @@ import time
 
 import numpy as np
 
-from ..parallel import wire
+from ..parallel import retry, wire
 from ..utils import faults, telemetry
 from .model_server import (
     ERR, NO_MODEL, OVERLOAD, SRV_PREDICT, SRV_SHUTDOWN, SRV_STATS,
@@ -47,7 +57,13 @@ class ServeDeadlineError(ServeError):
 
 class ServeOverloadError(ServeError):
     """The replica's admission control refused the request (queue full):
-    back off or try another replica."""
+    back off or try another replica.  ``retry_after_s`` is the backoff
+    hint the shed answer carried (r18: the RETRY_LATER band packs it into
+    the status; the legacy OVERLOAD code point carries none → 0.0)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
 
 
 class ServeUnavailableError(ServeError):
@@ -83,6 +99,10 @@ class ServeClient:
             (faults.current_role() or "client") + "_sv"
         )
         self._injector = faults.client_injector(self.role)
+        # Shared retry discipline (r18): transport replays spend this
+        # token-bucket budget; exhaustion surfaces as ServeDeadlineError
+        # plus a flight-recorder event (parallel/retry.py).
+        self._budget = retry.RetryBudget()
         self._lock = threading.RLock()
         self._sock: socket.socket | None = None
         self._hdr = bytearray(wire.RESP_HDR.size)
@@ -136,7 +156,18 @@ class ServeClient:
         try:
             self._sock.settimeout(self._op_timeout)
             nbytes = wire.encoded_nbytes(payload_bufs) if payload_bufs else 0
-            hdr = wire.pack_request(op, name, a, b, nbytes)
+            # Deadline propagation (r18): the remaining per-op budget
+            # rides in the frame header, so the replica sheds a predict
+            # this client has already abandoned instead of batching it.
+            # Safe unconditionally: every ServeClient connection HELLOs
+            # (v4 confirmed) before any other op — except HELLO itself.
+            hdr = wire.pack_request(
+                op, name, a, b, nbytes,
+                deadline_ms=(
+                    0 if self._op_timeout is None or op == wire.HELLO_OP
+                    else max(1, int(self._op_timeout * 1000))
+                ),
+            )
             wire.send_frames(self._sock, [hdr] + (payload_bufs or []))
             head = memoryview(self._hdr)
             wire.recv_exact(self._sock, head)
@@ -154,10 +185,14 @@ class ServeClient:
 
     def _recover(self, t_end: float) -> None:
         attempt = 0
+        immediate = False
         while True:
-            if attempt:
-                delay = min(self._backoff * (2 ** min(attempt - 1, 6)), 2.0)
+            if attempt and not immediate:
+                # Jittered backoff (r18): recovering peers decorrelate
+                # their re-dials instead of re-arriving in lockstep.
+                delay = retry.jittered(self._backoff, attempt - 1, cap_s=2.0)
                 time.sleep(min(delay, max(0.0, t_end - time.monotonic())))
+            immediate = False
             if time.monotonic() >= t_end:
                 faults.log_event(
                     "reconnect_gave_up", role=self.role, host=self._host,
@@ -169,11 +204,21 @@ class ServeClient:
                     f"for {self._reconnect_deadline:.0f}s ({attempt} attempts)"
                 )
             attempt += 1
+            # Per-address circuit breaker (r18, process-wide): a freshly-
+            # proven-dead replica fails fast for its open window instead
+            # of burning another connect timeout.
+            breaker = retry.breaker_for((self._host, self._port))
+            if not breaker.allow():
+                breaker.wait_for_probe(t_end)
+                immediate = True  # the wait was this attempt's pacing
+                continue
             try:
                 self._connect()
             except OSError:
+                breaker.on_failure()
                 self._sever()
                 continue
+            breaker.on_success()
             faults.log_event("reconnected", role=self.role, attempts=attempt)
             return
 
@@ -182,7 +227,9 @@ class ServeClient:
         payload_bufs: list | None = None, batch: bool = False,
     ):
         """One request/response; recovers + replays on transport failure
-        (every SRV op is pure/idempotent, so replay is always safe)."""
+        (every SRV op is pure/idempotent, so replay is always safe).  A
+        replay spends the shared retry budget (r18): a storm of failing
+        ops cannot replay unboundedly."""
         with self._lock:
             if self._injector is not None and self._injector.before_op(op):
                 self._sever()  # injected drop_conn
@@ -190,7 +237,7 @@ class ServeClient:
             while True:
                 if self._sock is not None:
                     try:
-                        return self._attempt(
+                        got = self._attempt(
                             op, name, a, b, payload_bufs=payload_bufs,
                             batch=batch,
                         )
@@ -203,10 +250,18 @@ class ServeClient:
                             "conn_lost", role=self.role, op_code=op,
                             error=type(e).__name__,
                         )
+                    else:
+                        self._budget.on_success()
+                        return got
                 elif self._reconnect_deadline <= 0:
                     raise ServeError(f"serve op {op} failed: not connected")
                 if t_end is None:
                     t_end = time.monotonic() + self._reconnect_deadline
+                if not self._budget.try_spend():
+                    raise ServeDeadlineError(
+                        f"replica at {self._host}:{self._port} retry budget "
+                        f"exhausted replaying op {op}"
+                    )
                 self._recover(t_end)
 
     # -- ops -----------------------------------------------------------------
@@ -218,7 +273,18 @@ class ServeClient:
         explicit shed statuses (callers/pools back off or rotate)."""
         bufs = wire.encode_batch(inputs)
         status, out = self.call(SRV_PREDICT, payload_bufs=bufs, batch=True)
+        hint_ms = wire.retry_after_ms(status)
+        if hint_ms is not None:
+            # r18: the replica SHED this predict (admission control —
+            # batcher queue full, dispatch bound, or queue-deadline
+            # expiry) and the status carries its own backoff hint.
+            raise ServeOverloadError(
+                f"replica {self._host}:{self._port} overloaded "
+                f"(retry after {hint_ms}ms)",
+                retry_after_s=hint_ms / 1e3,
+            )
         if status == OVERLOAD:
+            # Legacy code point (pre-r18 replicas): no hint.
             raise ServeOverloadError(
                 f"replica {self._host}:{self._port} overloaded"
             )
@@ -283,8 +349,13 @@ class ServePool:
         self._eject_until = [0.0] * n
         self._rr = 0
         self._lock = threading.Lock()
+        # Shared retry discipline (r18): every cross-replica retry spends
+        # this budget — a pool cannot convert one overload into an
+        # unbounded rotation storm.
+        self._budget = retry.RetryBudget()
         self.retries = 0
         self.ejections = 0
+        self.overload_backoffs = 0
         self.last_replica = -1
 
     def _pick(self) -> int | None:
@@ -341,24 +412,36 @@ class ServePool:
         )
         last_err: BaseException | None = None
         first = True
+        sheds_in_row = 0  # consecutive RETRY_LATER answers this request
         while time.monotonic() < t_end:
-            if not first:
-                with self._lock:
-                    self.retries += 1
-            first = False
             i = self._pick()
             if i is None:
                 # Everything benched: sleep to the earliest un-ejection
-                # (bounded by the backoff floor) and try again.
+                # (bounded by the backoff floor) and try again.  Waiting
+                # is free — no request is issued, so no retry token is
+                # spent (the budget prices re-ISSUES, not patience).
                 with self._lock:
                     wake = min(self._eject_until)
                 time.sleep(
                     min(max(self._backoff, wake - time.monotonic()), 1.0)
                 )
                 continue
+            if not first:
+                with self._lock:
+                    self.retries += 1
+                # Every re-issued request consults the shared budget
+                # (r18): refused means the pool is already storming —
+                # surface the typed deadline error instead of feeding it.
+                if not self._budget.try_spend():
+                    raise ServeDeadlineError(
+                        "serve pool retry budget exhausted "
+                        f"(last error: {last_err!r})"
+                    )
+            first = False
             try:
                 got = self._client(i).predict(inputs)
                 self.last_replica = i
+                self._budget.on_success()
                 return got
             except ServeRejectedError:
                 # The replica ANSWERED: the request itself is bad (or the
@@ -367,10 +450,30 @@ class ServePool:
                 # replicas and replaying for the whole deadline.
                 raise
             except (ServeOverloadError, ServeUnavailableError) as e:
-                # Alive but shedding: rotate with a short bench — long
-                # enough to drain, short enough to rejoin promptly.
+                # Alive but shedding: rotate — but HONOR the retry-after
+                # hint the shed carried (r18).  The shedding replica
+                # benches for the hinted window (it told us how long its
+                # queue needs to drain), and once a whole rotation sweep
+                # has answered only sheds — pool-WIDE overload — the next
+                # attempt waits a jittered hint first: rotating at line
+                # rate across N overloaded replicas is amplification, not
+                # load balancing.
                 last_err = e
-                self._eject(i, min(self._eject_s, 0.25))
+                hint_s = getattr(e, "retry_after_s", 0.0)
+                self._eject(i, max(min(self._eject_s, 0.25), hint_s))
+                # Only a genuine SHED answer counts toward the pool-wide-
+                # overload detection — a warming replica (Unavailable, no
+                # hint) is not overload evidence, and must not push the
+                # pool into the backoff sleep.
+                if isinstance(e, ServeOverloadError):
+                    sheds_in_row += 1
+                if hint_s > 0 and sheds_in_row >= len(self.addrs):
+                    with self._lock:
+                        self.overload_backoffs += 1
+                    time.sleep(min(
+                        retry.jittered(hint_s, cap_s=2.0),
+                        max(0.0, t_end - time.monotonic()),
+                    ))
             except IndexError:
                 # set_addrs() shrank the pool between _pick and use (an
                 # elastic scale-down racing this request): the index is
@@ -379,6 +482,7 @@ class ServePool:
                 continue
             except (ServeError, OSError, ConnectionError) as e:
                 last_err = e
+                sheds_in_row = 0  # a transport fault, not a shed answer
                 self._eject(i, self._eject_s)
                 faults.log_event(
                     "serve_replica_ejected", role=self.role, replica=i,

@@ -120,7 +120,7 @@ class ModelReplicaServer:
         membership: bool = True, lease_ttl_s: float = 10.0,
         advertise_addr: str | None = None, ps_replicas: int = 1,
         layout_version: int = 0, follow_reshard: bool = True,
-        handler_workers: int = 8,
+        handler_workers: int = 8, queue_deadline_ms: float = 0.0,
     ):
         import jax
 
@@ -197,6 +197,10 @@ class ModelReplicaServer:
             port=port, loopback_only=loopback_only, name="msrv",
             workers=handler_workers,
         )
+        # Shed answers carry a backoff HINT (r18): roughly two batch
+        # windows — the time a queue slot takes to free under load — so
+        # pools back off for a meaningful beat instead of re-hammering.
+        self._retry_after_ms = max(20, int(2 * max_wait_ms))
         self._core.add_service(server_core.Service(
             SERVICE, self._handle,
             control_ops=_SRV_CONTROL_OPS,
@@ -204,6 +208,14 @@ class ModelReplicaServer:
             # PREDICT batches are the only request payloads; bound them
             # at the write-buffer bound rather than the frame ceiling.
             max_payload=256 << 20,
+            # Admission policy (r18): a predict that sat in the dispatch
+            # queue past this budget (or past the deadline its caller
+            # stamped on the frame) is shed before a worker touches it.
+            # 0 = client-stamped deadlines only.
+            queue_deadline_s=(
+                queue_deadline_ms / 1e3 if queue_deadline_ms else None
+            ),
+            retry_after_ms=self._retry_after_ms,
         ))
         self._core.start()
         self.port = self._core.port
@@ -428,9 +440,13 @@ class ModelReplicaServer:
                 "model_step": self.model_step,
                 # The uniform runtime-accounting shape (r17): requests /
                 # live_conns come from the shared server core, same
-                # meaning on every service's STATS answer.
+                # meaning on every service's STATS answer; the r18 shed
+                # counters surface top-level with the same keys the
+                # native PS exports.
                 "requests": core["requests"],
                 "live_conns": core["live_conns"],
+                "shed_total": core["shed_total"],
+                "queue_deadline_drops": core["queue_deadline_drops"],
                 "core": core,
                 "predict_rows": self._predicts,
                 "overloads": self._overloads,
@@ -497,9 +513,14 @@ class ModelReplicaServer:
         try:
             ticket = self._batcher.submit(inputs, rows=lens.pop(), key=schema)
         except batcher_lib.Overloaded:
+            # r18: the batcher's admission refusal answers the typed
+            # RETRY_LATER band — the shed carries its backoff hint in the
+            # status, so resilient clients back off for a meaningful beat
+            # instead of re-hammering the rotation (the legacy OVERLOAD
+            # code point stays recognized client-side).
             with self._lock:
                 self._overloads += 1
-            return OVERLOAD, None
+            return wire.retry_later_status(self._retry_after_ms), None
 
         def _resolved(value, error) -> None:
             with self._lock:
@@ -570,7 +591,7 @@ def host_serve_task(
     reconnect_deadline_s: float = 60.0, metrics_dir: str | None = None,
     membership: bool = True, lease_ttl_s: float = 10.0,
     advertise_addr: str | None = None, ps_replicas: int = 1,
-    layout_version: int = 0,
+    layout_version: int = 0, queue_deadline_ms: float = 0.0,
 ) -> int:
     """Dedicated serve-task body (``--job_name=serve``): host one replica
     until a client signals SRV_SHUTDOWN (or the supervisor dies).  Arms
@@ -586,7 +607,7 @@ def host_serve_task(
         reconnect_deadline_s=reconnect_deadline_s, metrics_dir=metrics_dir,
         membership=membership, lease_ttl_s=lease_ttl_s,
         advertise_addr=advertise_addr, ps_replicas=ps_replicas,
-        layout_version=layout_version,
+        layout_version=layout_version, queue_deadline_ms=queue_deadline_ms,
     )
     faults.arm_process_faults(
         request_count_fn=server.request_count,

@@ -471,6 +471,9 @@ def run_ps_cluster_task(
             max_batch=int(getattr(FLAGS, "serve_max_batch", 32)),
             max_wait_ms=float(getattr(FLAGS, "serve_max_wait_ms", 5.0)),
             queue_depth=int(getattr(FLAGS, "serve_queue_depth", 128)),
+            queue_deadline_ms=float(
+                getattr(FLAGS, "serve_queue_deadline_ms", 0.0)
+            ),
             refresh_ms=float(getattr(FLAGS, "serve_refresh_ms", 50.0)),
             membership=bool(getattr(FLAGS, "membership_leases", True)),
             lease_ttl_s=float(getattr(FLAGS, "lease_ttl_s", 10.0) or 10.0),

@@ -285,6 +285,17 @@ def define_legacy_cluster_flags():
     )
     _define(
         "float",
+        "serve_queue_deadline_ms",
+        0.0,
+        "Serving replicas: queue-deadline budget (r18 admission control) — "
+        "a predict that waited in the replica's dispatch queue past this "
+        "budget is shed with a typed RETRY_LATER answer before a worker "
+        "touches it (the caller has abandoned or is about to abandon it). "
+        "0 = no server-side policy; only deadlines the CLIENTS stamp on "
+        "their frames apply.",
+    )
+    _define(
+        "float",
         "serve_refresh_ms",
         50.0,
         "Serving replicas: parameter-store poll cadence.  Each poll is one "

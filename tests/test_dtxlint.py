@@ -802,6 +802,118 @@ def test_concurrency_raw_accept_outside_service_dirs_is_clean(tmp_path):
     assert "raw-accept" not in codes(findings)
 
 
+_NAKED_RETRY_PY = textwrap.dedent(
+    """\
+    import socket
+
+
+    class Client:
+        def recover(self):
+            while True:
+                try:
+                    self._sock = socket.create_connection(self._addr)
+                    return
+                except OSError:
+                    continue
+    """
+)
+
+
+def test_concurrency_refuses_naked_retry_loop(tmp_path):
+    """r18: a reconnect loop whose transport handler re-enters the loop
+    without consulting the shared retry discipline is the metastable
+    retry storm in waiting — refused."""
+    findings = run_pass(
+        tmp_path, "concurrency", {"pkg/conc/client.py": _NAKED_RETRY_PY}
+    )
+    naked = [f for f in findings if f.code == "retry-discipline"]
+    assert len(naked) == 1
+    assert "Client.recover" in naked[0].symbol
+    assert "retry.py" in naked[0].message
+    assert "try_spend" in naked[0].message
+
+
+def test_concurrency_budgeted_retry_loop_is_clean(tmp_path):
+    """The clean shape: the same loop consulting the shared budget (and
+    jittering its backoff) passes the rule."""
+    disciplined = textwrap.dedent(
+        """\
+        import socket
+        import time
+
+        from ..parallel import retry
+
+
+        class Client:
+            def __init__(self):
+                self._budget = retry.RetryBudget()
+
+            def recover(self):
+                attempt = 0
+                while True:
+                    try:
+                        self._sock = socket.create_connection(self._addr)
+                        return
+                    except OSError:
+                        if not self._budget.try_spend():
+                            raise
+                        time.sleep(retry.jittered(0.25, attempt))
+                        attempt += 1
+        """
+    )
+    findings = run_pass(
+        tmp_path, "concurrency", {"pkg/conc/client.py": disciplined}
+    )
+    assert "retry-discipline" not in codes(findings)
+
+
+def test_concurrency_bounded_escape_poll_loop_is_clean(tmp_path):
+    """A supervision poll whose handler counts evidence toward a bounded
+    ``break`` is not a retry storm — the escape exempts it (the async_ps
+    orphan-detection shape)."""
+    poll = textwrap.dedent(
+        """\
+        import socket
+
+
+        class Watcher:
+            def watch(self):
+                misses = 0
+                while True:
+                    try:
+                        probe = socket.create_connection(self._peer, 0.5)
+                        probe.close()
+                        misses = 0
+                    except OSError:
+                        misses += 1
+                        if misses >= 10:
+                            break
+                    self._tick()
+        """
+    )
+    findings = run_pass(tmp_path, "concurrency", {"pkg/conc/watch.py": poll})
+    assert "retry-discipline" not in codes(findings)
+
+
+def test_concurrency_loop_without_dial_is_clean(tmp_path):
+    """A loop that catches OSError but never dials (a selector/serve loop
+    shape) is not a retry loop."""
+    srv = textwrap.dedent(
+        """\
+        class Core:
+            def run(self):
+                while not self._stop:
+                    try:
+                        events = self._sel.select(0.5)
+                    except OSError:
+                        continue
+                    self._handle(events)
+        """
+    )
+    findings = run_pass(tmp_path, "concurrency", {"pkg/conc/core.py": srv})
+    assert "retry-discipline" not in codes(findings)
+
+
 # ---------------------------------------------------------------------------
 # Pass 3: fault coverage
 # ---------------------------------------------------------------------------

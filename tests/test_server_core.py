@@ -774,6 +774,360 @@ def test_unserializable_predict_output_answers_err_not_a_wedged_conn():
         ps_service.stop_server(port)
 
 
+# ----------------------------------------------------------------------------
+# Admission control (r18): every shed path answers typed RETRY_LATER
+# ----------------------------------------------------------------------------
+
+
+def test_retry_later_band_roundtrips_and_misses_other_statuses():
+    """The status band codec: every encodable hint roundtrips, and the
+    statuses that LOOK negative (errors, shard-mismatch echoes far below
+    the band) never decode as a shed."""
+    for ms in (0, 1, 50, 600_000, 999_999):
+        st = wire.retry_later_status(ms)
+        assert wire.retry_after_ms(st) == min(ms, wire.RETRY_LATER_SPAN)
+    for not_shed in (0, 1, -1, -2, -7, -999, wire.RETRY_LATER_BASE
+                     - wire.RETRY_LATER_SPAN - 1, -5_000_000):
+        assert wire.retry_after_ms(not_shed) is None
+
+
+def test_deadline_stamped_frame_parses_and_unstamped_is_v3_identical():
+    """The r18 deadline stamp: flagged frames carry one trailing <I
+    field; un-stamped frames are byte-identical to the v3 layout."""
+    plain = wire.pack_request(7, "nm", 1, 2, 3)
+    stamped = wire.pack_request(7, "nm", 1, 2, 3, deadline_ms=1500)
+    assert stamped[0] == 7 | wire.DEADLINE_FLAG
+    assert plain[0] == 7
+    assert len(stamped) == len(plain) + wire.DEADLINE_TAIL.size
+    assert stamped[1:-wire.DEADLINE_TAIL.size] == plain[1:]
+    (ms,) = wire.DEADLINE_TAIL.unpack(stamped[-wire.DEADLINE_TAIL.size:])
+    assert ms == 1500
+    # And the core's incremental parser reads both shapes.
+    got, used = server_core.ServerCore._parse_header(bytearray(stamped))
+    assert got == (7, "nm", 1, 2, 3, 1500) and used == len(stamped)
+    got, used = server_core.ServerCore._parse_header(bytearray(plain))
+    assert got == (7, "nm", 1, 2, 3, 0) and used == len(plain)
+
+
+def _blocked_core(release: threading.Event, **kw):
+    """A core whose dsvc handler BLOCKS until ``release`` fires — the
+    saturated-worker-pool fixture for every shed test."""
+    svc_kw = {
+        k: kw.pop(k)
+        for k in ("queue_deadline_s", "max_inflight_per_conn",
+                  "retry_after_ms", "control_ops")
+        if k in kw
+    }
+    core = server_core.ServerCore(name="shed", workers=1, **kw)
+
+    def handle(conn, op, name, a, b, payload):
+        if op != 65:  # 65 = the test's control/fast op: never blocks
+            release.wait(30.0)
+        return a, None
+
+    core.add_service(server_core.Service("dsvc", handle, **svc_kw))
+    return core.start()
+
+
+def _wait_stat(core, key, minimum=1, timeout=10.0) -> int:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = core.core_stats()[key]
+        if v >= minimum:
+            return v
+        time.sleep(0.02)
+    return core.core_stats()[key]
+
+
+def test_inflight_cap_sheds_pipelined_excess_in_order():
+    """Per-connection in-flight cap: pipelined excess on ONE connection
+    answers typed RETRY_LATER (hint included), response order preserved,
+    and the cause-split counters fold into core_stats()."""
+    release = threading.Event()
+    core = _blocked_core(
+        release, max_inflight_per_conn=2, retry_after_ms=70,
+    )
+    try:
+        s = _dial(core.port, "dsvc")
+        for i in range(6):
+            _send_req(s, 64, a=i)
+        # 2 dispatched (cap), the rest shed the moment they parse.
+        assert _wait_stat(core, "shed_inflight_cap", 4) == 4
+        release.set()
+        statuses = [_read_resp(s)[0] for _ in range(6)]
+        # In order: the two admitted echo their operand, the shed four
+        # answer the RETRY_LATER band carrying the service's hint.
+        assert statuses[:2] == [0, 1]
+        for st in statuses[2:]:
+            assert wire.retry_after_ms(st) == 70
+        stats = core.core_stats()
+        assert stats["shed_total"] == 4
+        assert stats["shed_inflight_cap"] == 4
+        assert stats["shed_dispatch_full"] == 0
+        assert stats["queue_deadline_drops"] == 0
+        # The connection is NOT poisoned: the same socket still serves.
+        assert _call(s, 64, a=9)[0] == 9
+        s.close()
+    finally:
+        release.set()
+        core.stop()
+
+
+def test_dispatch_queue_bound_sheds_across_connections():
+    """The core-wide dispatch bound: once the queue is full, a NEW
+    connection's request sheds instead of queueing unboundedly."""
+    release = threading.Event()
+    core = _blocked_core(release, max_dispatch_depth=1)
+    conns = []
+    try:
+        # First request occupies the one worker; the queue then holds at
+        # most 1; further requests shed with the dispatch-full cause.
+        for i in range(4):
+            s = _dial(core.port, "dsvc")
+            _send_req(s, 64, a=i)
+            conns.append(s)
+        assert _wait_stat(core, "shed_dispatch_full", 2) >= 2
+        # The shed answers arrive NOW, while the worker is still wedged —
+        # admission refusals never wait on handler progress.  (WHICH two
+        # connections shed depends on parse order, so select for the
+        # readable ones.)
+        import select
+
+        readable, _, _ = select.select(conns, [], [], 5.0)
+        assert len(readable) >= 2
+        sheds = 0
+        for s in readable:
+            s.settimeout(5.0)
+            if wire.retry_after_ms(_read_resp(s)[0]) is not None:
+                sheds += 1
+        assert sheds >= 2
+        release.set()
+        served = 0
+        for s in (c for c in conns if c not in readable):
+            s.settimeout(10.0)
+            if wire.retry_after_ms(_read_resp(s)[0]) is None:
+                served += 1
+        assert served >= 1  # the dispatched request really completed
+    finally:
+        release.set()
+        for s in conns:
+            s.close()
+        core.stop()
+
+
+def test_queue_deadline_policy_sheds_waiting_requests():
+    """A request that waited past the SERVICE's queue-deadline budget is
+    shed before a worker touches it — even while every worker is wedged
+    (the selector sweep answers it)."""
+    release = threading.Event()
+    core = _blocked_core(release, queue_deadline_s=0.2)
+    a = b = None
+    try:
+        a = _dial(core.port, "dsvc")
+        _send_req(a, 64, a=1)  # occupies the one worker
+        time.sleep(0.1)
+        b = _dial(core.port, "dsvc")
+        _send_req(b, 64, a=2)  # queued behind the wedge
+        b.settimeout(10.0)
+        t0 = time.monotonic()
+        status, _ = _read_resp(b)  # answered by the ~1/s sweep
+        assert wire.retry_after_ms(status) is not None
+        assert time.monotonic() - t0 < 5.0
+        stats = core.core_stats()
+        assert stats["queue_deadline_drops"] == 1
+        assert stats["shed_total"] == 1
+        release.set()
+        a.settimeout(10.0)
+        assert _read_resp(a)[0] == 1  # the dispatched request completes
+    finally:
+        release.set()
+        for s in (a, b):
+            if s is not None:
+                s.close()
+        core.stop()
+
+
+def test_caller_stamped_deadline_sheds_abandoned_work():
+    """Deadline propagation: with NO service policy, the deadline the
+    CALLER stamped on the frame alone sheds the request once it expires
+    in the queue — servers do not burn workers on abandoned work."""
+    release = threading.Event()
+    core = _blocked_core(release)  # queue_deadline_s=None: stamp only
+    a = b = None
+    try:
+        a = _dial(core.port, "dsvc")
+        _send_req(a, 64, a=1)
+        time.sleep(0.1)
+        b = _dial(core.port, "dsvc")
+        b.sendall(wire.pack_request(64, "", 2, 0, 0, deadline_ms=150))
+        b.settimeout(10.0)
+        status, _ = _read_resp(b)
+        assert wire.retry_after_ms(status) is not None
+        assert core.core_stats()["queue_deadline_drops"] == 1
+        release.set()
+    finally:
+        release.set()
+        for s in (a, b):
+            if s is not None:
+                s.close()
+        core.stop()
+
+
+def test_control_ops_never_shed_under_saturated_pool():
+    """Priority classes: with the worker wedged AND the dispatch queue
+    full AND the in-flight cap at 1, a control op on the SAME connection
+    still answers promptly (dedicated control worker + cap/bound
+    exemption) — under saturation the cluster stays observable."""
+    release = threading.Event()
+    core = _blocked_core(
+        release, max_dispatch_depth=1, max_inflight_per_conn=1,
+        control_ops=frozenset({65}),
+    )
+    extra = []
+    try:
+        s = _dial(core.port, "dsvc")
+        _send_req(s, 64, a=1)  # wedges the one regular worker
+        time.sleep(0.1)
+        # Fill the dispatch queue from another connection.
+        q = _dial(core.port, "dsvc")
+        _send_req(q, 64, a=2)
+        extra.append(q)
+        # Control op from a THIRD connection: bypasses the full queue,
+        # rides the priority lane, answered by the control worker.
+        c = _dial(core.port, "dsvc")
+        extra.append(c)
+        t0 = time.monotonic()
+        c.settimeout(5.0)
+        status, _ = _call(c, 65, a=7)
+        dt = time.monotonic() - t0
+        assert status == 7, "control op was shed or misrouted"
+        assert dt < 2.0, f"control op stalled {dt:.1f}s behind saturation"
+        # And NONE of the shed counters moved for it.
+        assert core.core_stats()["shed_total"] == 0
+        release.set()
+    finally:
+        release.set()
+        for x in extra + [s]:
+            x.close()
+        core.stop()
+
+
+def test_stats_scrape_answers_while_predict_sheds():
+    """The msrv end-to-end shape: a hammered replica sheds predicts with
+    the typed hint, and a STATS scrape DURING the storm answers promptly
+    with the shed counters in the uniform top-level shape."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_examples_tpu import serve
+    from distributed_tensorflow_examples_tpu.parallel import ps_shard
+
+    def init_fn(rng):
+        return {"w": jnp.zeros((4, 2), jnp.float32)}
+
+    def predict_fn(params, batch):
+        return batch["x"] @ params["w"]
+
+    port = ps_service.start_server(0)
+    try:
+        addrs = [("127.0.0.1", port)]
+        group = ps_shard.ShardedPSClients(addrs, role="t18_shed")
+        pstore = ps_shard.ShardedParamStore(
+            group, "params", ps_shard.ShardLayout(8, 1)
+        )
+        pstore.set(1, np.zeros(8, np.float32))
+        srv = serve.ModelReplicaServer(
+            init_fn, predict_fn, addrs, membership=False, refresh_ms=20.0,
+            max_batch=1, max_wait_ms=1.0, queue_depth=1,
+        )
+        try:
+            assert srv.wait_for_model(30.0)
+            srv._batcher._run = lambda items: time.sleep(0.2) or [
+                (1, {"y": np.zeros((1, 2), np.float32)}) for _ in items
+            ]
+            overloads = [0]
+            stop = threading.Event()
+
+            def hammer(i):
+                c = serve.ServeClient(
+                    "127.0.0.1", srv.port, role=f"h{i}_sv",
+                    reconnect_deadline_s=0.0,
+                )
+                while not stop.is_set():
+                    try:
+                        c.predict({"x": np.zeros((1, 4), np.float32)})
+                    except serve.ServeOverloadError as e:
+                        overloads[0] += 1
+                        # The typed hint rode in on the status band.
+                        assert e.retry_after_s > 0
+                    except serve.ServeError:
+                        pass
+                c.close()
+
+            ts = [threading.Thread(target=hammer, args=(i,))
+                  for i in range(4)]
+            for t in ts:
+                t.start()
+            try:
+                # STATS scrapes DURING the storm: prompt, with the shed
+                # telemetry visible in the uniform top-level shape.
+                deadline = time.monotonic() + 10.0
+                seen_overload = False
+                while time.monotonic() < deadline and not seen_overload:
+                    sc = serve.ServeClient(
+                        "127.0.0.1", srv.port, role="scrape_sv",
+                        reconnect_deadline_s=0.0,
+                    )
+                    t0 = time.monotonic()
+                    st = sc.stats()
+                    assert time.monotonic() - t0 < 2.0
+                    assert "shed_total" in st
+                    assert "queue_deadline_drops" in st
+                    sc.close()
+                    seen_overload = st["overloads"] >= 1
+                assert seen_overload, "hammer never tripped admission"
+            finally:
+                stop.set()
+                for t in ts:
+                    t.join(timeout=15.0)
+            assert overloads[0] >= 1
+        finally:
+            srv.stop()
+            group.close()
+    finally:
+        ps_service.stop_server(port)
+
+
+def test_native_ps_sheds_blocking_op_with_exhausted_stamp():
+    """The native mirror: a blocking op whose stamped deadline budget is
+    below the minimum useful wait answers the same typed RETRY_LATER
+    band, and the shed shows in the PS's STATS counters."""
+    port = ps_service.start_server(0)
+    s = None
+    try:
+        client = ps_service.PSClient("127.0.0.1", port, timeout_s=10.0)
+        ps_service.RemoteAccumulator(client, "acc0", 4)
+        client.close()
+        # Raw dial: stamp a 1ms deadline on a would-block ACC_TAKE — the
+        # server must shed it (typed, with hint) instead of parking a
+        # thread it knows the caller will abandon.
+        s = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+        s.sendall(wire.pack_request(
+            wire.PS_OPS["ACC_TAKE"], "acc0", 1, 5_000, 0, deadline_ms=1,
+        ))
+        status, _ = _read_resp(s)
+        hint = wire.retry_after_ms(status)
+        assert hint is not None and hint > 0
+        c2 = ps_service.PSClient("127.0.0.1", port, timeout_s=10.0)
+        st = c2.stats()
+        assert st["shed_total"] >= 1
+        assert st["queue_deadline_drops"] >= 1
+        c2.close()
+    finally:
+        if s is not None:
+            s.close()
+        ps_service.stop_server(port)
+
+
 def test_oversize_frame_announcement_drops_the_connection():
     core = server_core.ServerCore(name="huge", workers=1)
     core.add_service(server_core.Service(

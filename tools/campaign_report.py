@@ -215,6 +215,38 @@ def fmt_loadsim(rec: dict, ok: str) -> str:
     return "\n".join(lines)
 
 
+def fmt_overload(rec: dict, ok: str) -> str:
+    """Graceful-degradation acceptance step (r18): the overload SLO
+    verdict — did the burst genuinely trip admission control, did goodput
+    hold its floor while the excess shed, did anyone's lease expire, and
+    how fast did p99 return to baseline after the burst ended."""
+    j = rec.get("json") or {}
+    if not j:
+        return f"- `loadsim_overload` [{ok}]: NO JSON ({rec['seconds']}s)"
+    gates = j.get("gates", {})
+    bad = sorted(g for g, v in gates.items() if not v)
+    lines = [
+        f"- `loadsim_overload` [{ok}]: SLO "
+        f"{'PASS' if j.get('slo_pass') else 'FAIL'} — burst goodput "
+        f"{j.get('burst_goodput_qps')} qps (floor "
+        f"{j.get('goodput_floor_qps')}), sheds "
+        f"{j.get('shed_total', 0) + j.get('batcher_overloads', 0)} "
+        f"(core {j.get('shed_total')} + batcher "
+        f"{j.get('batcher_overloads')}), leases_expired "
+        f"{j.get('leases_expired')} ({rec['seconds']}s wall)"
+    ]
+    lines.append(
+        f"    - p99 baseline {j.get('baseline_p99_ms')}ms -> recovered in "
+        f"{j.get('recovery_s')}s (target {j.get('recovery_target_ms')}ms, "
+        f"bound {j.get('recovery_bound_s')}s); step {j.get('step_first')} "
+        f"-> {j.get('step_last')} (monotone={j.get('step_monotone')}); "
+        f"retry={j.get('retry')}"
+    )
+    if bad:
+        lines.append(f"    - FAILING GATES: {', '.join(bad)}")
+    return "\n".join(lines)
+
+
 def main():
     path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(ROOT, "CAMPAIGN_r05.json")
     with open(path) as f:
@@ -234,6 +266,8 @@ def main():
             print(fmt_obs(rec, ok))
         elif name == "loadsim":
             print(fmt_loadsim(rec, ok))
+        elif name == "loadsim_overload":
+            print(fmt_overload(rec, ok))
         elif name.startswith("bench_"):
             print(fmt_bench(rec, ok))
         elif name == "flash_parity":

@@ -25,6 +25,18 @@ through a helper method is the helper's finding, at its own site):
   retired (one wedged peer = one wedged thread; 256 idle conns = 256
   stacks).  The core itself (under ``parallel/``) is the one place an
   accept loop belongs.
+- ``retry-discipline`` (r18) — a reconnect/retry loop that does not
+  consult the shared retry discipline (``parallel/retry.py``).  A loop
+  counts when a ``while`` body (lexically) both DIALS (a
+  connect/attempt-shaped call) and catches a transport exception
+  (``OSError``/``ConnectionError``/``TimeoutError``/``socket.timeout``)
+  with a handler every path of which re-enters the loop (no
+  ``raise``/``return``/``break`` anywhere in the handler — a bounded
+  escape marks a supervision poll, not a retry storm).  Such a loop's
+  enclosing function must reference the discipline — ``RetryBudget`` /
+  ``try_spend`` / ``jittered`` / ``breaker_for`` — or it is exactly the
+  naked unbounded retry that turns one blip into a metastable storm
+  (N clients re-dialing in lockstep at line rate).
 
 A lock is any ``with`` context expression whose final name contains
 ``lock`` (``self._lock``, ``self._run_lock``, module ``_role_lock``...) —
@@ -54,6 +66,26 @@ BLOCKING_ALWAYS = {
 #: a ``timeout``/``timeout_s`` keyword.  ``get`` additionally requires
 #: ZERO positional args to count (``d.get(key)`` is a dict lookup).
 BLOCKING_IF_NAKED = {"get", "join", "wait"}
+
+#: Dial/attempt-shaped calls: a loop containing one of these is (re-)
+#: issuing work against a peer, so a fall-through transport handler in it
+#: is a RETRY loop (r18).
+DIAL_CALLS = {
+    "connect", "create_connection", "_connect", "_reconnect", "_recover",
+    "_attempt", "predict", "dial", "_dial",
+}
+
+#: Transport exception names whose fall-through handling marks a retry
+#: loop (matched on the final attribute, so ``socket.timeout`` counts).
+TRANSPORT_EXCS = {
+    "OSError", "ConnectionError", "ConnectionResetError", "TimeoutError",
+    "timeout", "error",  # socket.timeout / socket.error
+}
+
+#: References that count as consulting the shared retry discipline
+#: (``parallel/retry.py``) — any one of them in the enclosing function
+#: satisfies the rule.
+DISCIPLINE_REFS = {"RetryBudget", "try_spend", "jittered", "breaker_for"}
 
 
 def _call_name(node: ast.Call) -> str:
@@ -99,6 +131,79 @@ def _is_blocking(node: ast.Call) -> str | None:
         if not node.args and not has_timeout:
             return f"{name}() with no timeout"
     return None
+
+
+def _scoped_walk(body):
+    """Yield every node lexically in ``body``, NOT descending into nested
+    function/class/lambda scopes (their bodies run elsewhere)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _exc_names(handler: ast.ExceptHandler) -> set[str]:
+    """The (final-attribute) exception names one handler catches."""
+    t = handler.type
+    nodes = t.elts if isinstance(t, ast.Tuple) else ([] if t is None else [t])
+    out: set[str] = set()
+    for n in nodes:
+        name = _expr_name(n)
+        if name:
+            out.add(name.rsplit(".", 1)[-1])
+    return out
+
+
+def _check_retry_discipline(func, qualname: str, linter: "_FileLinter") -> None:
+    """The r18 rule: a while-loop that dials AND falls through a transport
+    exception back into the loop is a retry loop — its function must
+    consult the shared retry discipline (``parallel/retry.py``)."""
+    consults = any(
+        (isinstance(n, ast.Attribute) and n.attr in DISCIPLINE_REFS)
+        or (isinstance(n, ast.Name) and n.id in DISCIPLINE_REFS)
+        for n in ast.walk(func)
+    )
+    if consults:
+        return
+    for node in _scoped_walk(func.body):
+        if not isinstance(node, ast.While):
+            continue
+        dials = [
+            n for n in _scoped_walk(node.body)
+            if isinstance(n, ast.Call) and _call_name(n) in DIAL_CALLS
+        ]
+        if not dials:
+            continue
+        for inner in _scoped_walk(node.body):
+            if not isinstance(inner, ast.Try):
+                continue
+            for handler in inner.handlers:
+                if not (_exc_names(handler) & TRANSPORT_EXCS):
+                    continue
+                # A raise/return/break ANYWHERE in the handler is a
+                # bounded escape (a supervision poll counting evidence,
+                # or a deadline check) — only a handler EVERY path of
+                # which re-enters the loop is the naked retry shape.
+                if any(
+                    isinstance(n, (ast.Raise, ast.Return, ast.Break))
+                    for n in _scoped_walk(handler.body)
+                ):
+                    continue
+                linter.findings.append(Finding(
+                    PASS, "retry-discipline", linter.relpath, qualname,
+                    f"{qualname} retries a dial/op in a loop on "
+                    f"{sorted(_exc_names(handler) & TRANSPORT_EXCS)} "
+                    "without consulting the shared retry discipline "
+                    "(parallel/retry.py: RetryBudget.try_spend / "
+                    "jittered / breaker_for) — a naked retry loop is how "
+                    "one blip becomes a metastable retry storm",
+                    line=handler.lineno,
+                ))
+                return  # one finding per function is enough
 
 
 class _FuncVisitor(ast.NodeVisitor):
@@ -208,6 +313,7 @@ class _FileLinter:
 
     def lint_function(self, node, qualname: str) -> None:
         self._check_bare_acquire(node, qualname)
+        _check_retry_discipline(node, qualname, self)
         v = _FuncVisitor(self, qualname)
         for stmt in node.body:
             v.visit(stmt)

@@ -324,12 +324,27 @@ def snapshot(
             "diverged": sorted(
                 r["role"] for r in ps_rows if r["stats"]["diverged"]
             ),
+            "shed_total": sum(
+                r["stats"].get("shed_total", 0) for r in ps_rows
+            ),
+            "queue_deadline_drops": sum(
+                r["stats"].get("queue_deadline_drops", 0) for r in ps_rows
+            ),
+            "leases_expired": sum(
+                r["stats"].get("leases_expired", 0) for r in ps_rows
+            ),
         },
         "dsvc": {
             "batches_served": sum(
                 r["stats"]["batches_served"] for r in dsvc_rows
             ),
             "reassigned": sum(r["stats"]["reassigned"] for r in dsvc_rows),
+            "shed_total": sum(
+                r["stats"].get("shed_total", 0) for r in dsvc_rows
+            ),
+            "queue_deadline_drops": sum(
+                r["stats"].get("queue_deadline_drops", 0) for r in dsvc_rows
+            ),
         },
         "serve": {
             "model_steps": [r["stats"]["model_step"] for r in serve_rows],
@@ -344,6 +359,28 @@ def snapshot(
                  for r in serve_rows),
                 default=0.0,
             ), 3),
+            "overloads": sum(
+                r["stats"].get("overloads", 0) for r in serve_rows
+            ),
+            "shed_total": sum(
+                r["stats"].get("shed_total", 0) for r in serve_rows
+            ),
+            "queue_deadline_drops": sum(
+                r["stats"].get("queue_deadline_drops", 0) for r in serve_rows
+            ),
+        },
+        # Client-side retry discipline (r18): every Python service's STATS
+        # carries its process registry ride-along, so the shared retry
+        # helper's counters (parallel/retry.py) aggregate here per scrape
+        # — a cluster-wide view of budget exhaustion and open breakers.
+        # (The native PS has no Python registry; .get degrades to 0.)
+        "retry": {
+            key: sum(
+                r["stats"].get("registry", {}).get(f"retry/{key}", 0)
+                for r in ps_rows + dsvc_rows + serve_rows
+            )
+            for key in ("spent", "budget_exhausted", "breaker_open",
+                        "breaker_fast_fails")
         },
     }
     summary["members"] = {
@@ -382,7 +419,9 @@ def _fmt_ps_row(r: dict) -> str:
         f"mirror={s['mirror_applies']:<6} fwd={s['fwd_ok']}"
         f"/{s['fwd_peer_down']}/{s['fwd_refused']} "
         f"syncs={s['repl_syncs_served']}"
-        f"+r{s.get('reshard_syncs', 0)}"
+        f"+r{s.get('reshard_syncs', 0)} "
+        f"shed={s.get('shed_total', 0)}"
+        f"/{s.get('queue_deadline_drops', 0)}"
     )
 
 
@@ -393,7 +432,9 @@ def _fmt_dsvc_row(r: dict) -> str:
         f"batches={s['batches_served']:<7} "
         f"splits={s['splits_completed']}/{s['assigned_total']}"
         f"/{s['reassigned']} (done/assigned/reassigned) "
-        f"workers={s['registered_workers']}"
+        f"workers={s['registered_workers']} "
+        f"shed={s.get('shed_total', 0)}"
+        f"/{s.get('queue_deadline_drops', 0)}"
     )
 
 
@@ -404,7 +445,9 @@ def _fmt_serve_row(r: dict) -> str:
         f"rows={s['predict_rows']:<7} overload={s['overloads']:<4} "
         f"p99={s.get('serve/latency_p99_ms', 0.0):7.2f}ms "
         f"qps={s.get('serve/qps', 0.0):7.1f} "
-        f"batch_p50={s.get('batcher_batch_rows_p50', 0)}"
+        f"batch_p50={s.get('batcher_batch_rows_p50', 0)} "
+        f"shed={s.get('shed_total', 0)}"
+        f"/{s.get('queue_deadline_drops', 0)}"
     )
 
 
@@ -476,6 +519,23 @@ def render(snap: dict, prev: dict | None = None) -> str:
         f"reassigned={su['dsvc']['reassigned']} | "
         f"serve_steps={su['serve']['model_steps']} "
         f"qps={su['serve']['qps']} p99={su['serve']['p99_ms']}ms"
+    )
+    # Overload posture (r18): shed answers per plane (total/queue-deadline
+    # drops) and the client-side retry discipline's cluster-wide counters.
+    rt = su.get("retry", {})
+    lines.append(
+        "overload: shed ps="
+        f"{su['ps'].get('shed_total', 0)}"
+        f"/{su['ps'].get('queue_deadline_drops', 0)} "
+        f"dsvc={su['dsvc'].get('shed_total', 0)}"
+        f"/{su['dsvc'].get('queue_deadline_drops', 0)} "
+        f"serve={su['serve'].get('shed_total', 0)}"
+        f"/{su['serve'].get('queue_deadline_drops', 0)} "
+        f"(+{su['serve'].get('overloads', 0)} batcher) | "
+        f"retry: spent={rt.get('spent', 0)} "
+        f"budget_exhausted={rt.get('budget_exhausted', 0)} "
+        f"breaker_open={rt.get('breaker_open', 0)} | "
+        f"leases_expired={su['ps'].get('leases_expired', 0)}"
     )
     return "\n".join(lines)
 

@@ -44,6 +44,14 @@ Usage::
 r17: the default scenario drives 4x the original closed-loop client count
 (16 generator connections at qps 100) with the SLO gates unchanged — the
 serve plane now rides the unified server core (parallel/server_core.py).
+
+r18 (``--scenario=overload``): the graceful-degradation acceptance — a
+baseline phase, then an UNPACED 4x burst slams the serve pool past its
+(deliberately bounded) capacity, then recovery.  Gates: goodput floor
+during the burst, zero lease expirations (control ops are never shed),
+p99 back under a bounded multiple of baseline within ``--recovery_bound_s``
+of burst end (the no-metastability proof), training step monotone and
+advancing throughout.  See ``run_overload``.
 """
 
 from __future__ import annotations
@@ -90,6 +98,22 @@ RESHARD_PHASES = {
     "reshard_down": 0.55,
 }
 
+#: Overload-scenario timeline (r18, ``--scenario=overload``), as fractions
+#: of the load window: a baseline phase establishes the healthy p99, then
+#: an UNPACED burst generator (``--burst_threads``, default 4x the paced
+#: client count) slams the serve pool past capacity, then the burst stops
+#: and the recovery clock runs.  The no-metastability proof: goodput
+#: holds a floor DURING the burst (admission sheds excess instead of
+#: collapsing), no live member's lease expires (control ops are never
+#: shed), and p99 returns to a bounded multiple of baseline WITHIN
+#: ``--recovery_bound_s`` after the burst ends (retry budgets + jittered
+#: backoff keep the recovering clients from re-overloading the cluster —
+#: the storm dies WITH the burst, it does not outlive it).
+OVERLOAD_PHASES = {
+    "burst_start": 0.35,
+    "burst_end": 0.65,
+}
+
 
 def free_ports(n: int) -> list[int]:
     socks, ports = [], []
@@ -125,26 +149,56 @@ def build_plan(ready_s: float, duration_s: float, join_worker_id: int) -> str:
 
 class LoadGenerator:
     """Closed-loop predict load at a target qps over a ServePool, with
-    replica discovery following the LEASE registry (the elastic pool)."""
+    replica discovery following the LEASE registry (the elastic pool).
+
+    ``qps=None`` runs UNPACED (r18 overload scenario): every thread
+    re-issues the moment its previous predict resolves — the burst
+    generator that drives the cluster past capacity.  ``snap_window``
+    drains the stats accumulated since the last snap, so the overload
+    scenario can measure per-phase p99/goodput from ONE generator
+    without restarting its connections.
+
+    ``pool_per_thread=True`` gives every generator thread its OWN static
+    ``ServePool`` (burst generators).  ``ServeClient`` serializes ops
+    per connection, so N threads sharing one pool hold at most
+    one request in flight PER REPLICA no matter how large N is — a
+    burst that must exceed the replicas' admission bounds needs N
+    independent connections, the real N-clients overload shape."""
 
     def __init__(
-        self, ps_addrs, serve_addrs, *, qps: float, threads: int = 16,
-        deadline_s: float = 60.0,
+        self, ps_addrs, serve_addrs, *, qps: float | None, threads: int = 16,
+        deadline_s: float = 60.0, role: str = "loadsim_sv",
+        op_timeout_s: float | None = 10.0, rows: int = 4,
+        pool_per_thread: bool = False,
     ):
         from distributed_tensorflow_examples_tpu import serve
 
-        self.qps = float(qps)
+        self.qps = None if qps is None else float(qps)
+        self.rows = int(rows)
+        self._serve_addrs = list(serve_addrs)
+        self._deadline_s = deadline_s
+        self._op_timeout_s = op_timeout_s
+        self._pool_per_thread = bool(pool_per_thread)
+        self.role = role
         self.ok = 0
         self.failed = 0
         self.errors: list[str] = []
         self.latencies_ms: list[float] = []
+        self._win_ok = 0
+        self._win_failed = 0
+        self._win_lat: list[float] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self.pool = serve.ServePool(
-            list(serve_addrs), role="loadsim_sv", deadline_s=deadline_s,
+            list(serve_addrs), role=role, deadline_s=deadline_s,
+            op_timeout_s=op_timeout_s,
         )
-        self.discovery = serve.LeaseServeDiscovery(
-            list(ps_addrs), self.pool, poll_s=1.0,
+        # No PS addresses = static pool only (the burst-child processes:
+        # a 10s burst needs no elastic discovery).
+        self.discovery = (
+            serve.LeaseServeDiscovery(list(ps_addrs), self.pool, poll_s=1.0)
+            if ps_addrs
+            else None
         )
         self._threads = [
             threading.Thread(
@@ -157,28 +211,63 @@ class LoadGenerator:
     def _loop(self, tid: int, n_threads: int) -> None:
         import numpy as np
 
-        x = np.zeros((4, 784), np.float32)
-        period = n_threads / self.qps
-        next_t = time.monotonic() + tid * period / n_threads
+        pool = self.pool
+        if self._pool_per_thread:
+            from distributed_tensorflow_examples_tpu import serve
+
+            pool = serve.ServePool(
+                list(self._serve_addrs), role=f"{self.role}{tid}",
+                deadline_s=self._deadline_s,
+                op_timeout_s=self._op_timeout_s,
+            )
+        x = np.zeros((self.rows, 784), np.float32)
+        period = None if self.qps is None else n_threads / self.qps
+        next_t = (
+            time.monotonic() + tid * period / n_threads
+            if period is not None
+            else 0.0
+        )
         while not self._stop.is_set():
-            now = time.monotonic()
-            if now < next_t:
-                time.sleep(min(next_t - now, 0.05))
-                continue
-            next_t += period
+            if period is not None:
+                now = time.monotonic()
+                if now < next_t:
+                    time.sleep(min(next_t - now, 0.05))
+                    continue
+                next_t += period
             t0 = time.perf_counter()
             try:
-                self.pool.predict({"image": x})
+                pool.predict({"image": x})
             except Exception as e:  # noqa: BLE001 — every failure is counted
                 with self._lock:
                     self.failed += 1
+                    self._win_failed += 1
                     if len(self.errors) < 20:
                         self.errors.append(f"{type(e).__name__}: {e}")
                 continue
             dt_ms = (time.perf_counter() - t0) * 1e3
             with self._lock:
                 self.ok += 1
+                self._win_ok += 1
                 self.latencies_ms.append(dt_ms)
+                self._win_lat.append(dt_ms)
+        if pool is not self.pool:
+            pool.close()
+
+    def snap_window(self) -> dict:
+        """Drain and return the stats accumulated since the last snap
+        (phase-local goodput/latency for the overload scenario; the
+        cumulative counters for :meth:`stop` are untouched)."""
+        with self._lock:
+            lat = sorted(self._win_lat)
+            ok, failed = self._win_ok, self._win_failed
+            self._win_lat, self._win_ok, self._win_failed = [], 0, 0
+        pct = lambda p: (  # noqa: E731
+            round(lat[min(len(lat) - 1, int(p * len(lat)))], 3) if lat else 0.0
+        )
+        return {
+            "ok": ok, "failed": failed,
+            "p50_ms": pct(0.50), "p99_ms": pct(0.99),
+        }
 
     def start(self) -> None:
         for t in self._threads:
@@ -188,7 +277,8 @@ class LoadGenerator:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=10.0)
-        self.discovery.close()
+        if self.discovery is not None:
+            self.discovery.close()
         self.pool.close()
         with self._lock:
             lat = sorted(self.latencies_ms)
@@ -291,6 +381,7 @@ def run_reshard(args) -> int:
 
     faults.set_role("loadsim")
     logdir = args.logdir or tempfile.mkdtemp(prefix="dtx-loadsim-rs-")
+    os.makedirs(logdir, exist_ok=True)
     n1 = max(1, args.ps_shards)
     n2 = n1 + 1
     topo_shards = {1: n1, 2: n2, 3: n1}
@@ -541,6 +632,341 @@ def run_reshard(args) -> int:
     return 0 if verdict["slo_pass"] else 1
 
 
+def run_overload(args) -> int:
+    """The graceful-degradation acceptance scenario (``--scenario=overload``,
+    r18): boot a real multi-process train-and-serve cluster with BOUNDED
+    serve capacity (small batcher queue + a queue-deadline policy), hold
+    paced closed-loop predict load, then slam the pool with an unpaced
+    burst of ``--burst_threads`` extra clients (>= 4x the paced count) for
+    the middle of the window, stop the burst, and measure recovery.
+
+    SLO verdict (``overload_slo``):
+
+    - ``goodput_floor`` — ok-predicts/sec across ALL generators during
+      the burst stays above ``--goodput_floor_frac`` x the paced target
+      (shedding is graceful: excess is refused, admitted work completes);
+    - ``zero_lease_expirations`` — no live member's lease expires during
+      the whole run (control ops are never shed, so heartbeats renew
+      straight through saturation);
+    - ``p99_recovered`` within ``--recovery_bound_s`` of burst end, to
+      ``--recovery_factor`` x the baseline p99 (no metastable retry storm
+      outliving the burst);
+    - training step monotone and strictly advancing across the run;
+    - the paced (SLO) traffic never fails a logical predict.
+    """
+    from distributed_tensorflow_examples_tpu.utils import faults
+    from tools import dtxtop
+
+    faults.set_role("loadsim")
+    logdir = args.logdir or tempfile.mkdtemp(prefix="dtx-loadsim-ov-")
+    os.makedirs(logdir, exist_ok=True)
+    n_ps = args.ps_shards * args.ps_replicas
+    ports = free_ports(n_ps + args.serve_replicas)
+    ps_ports, serve_ports = ports[:n_ps], ports[n_ps:]
+    ps_addrs = [("127.0.0.1", p) for p in ps_ports]
+    serve_addrs = [("127.0.0.1", p) for p in serve_ports]
+    common = [
+        "--sync_replicas=false",
+        "--batch_size=64",
+        "--train_steps=1000000",  # outlives the window; loadsim tears down
+        # Bounded serve capacity: the burst must actually EXCEED it on any
+        # dev box, or the scenario proves nothing.  A WIDE hidden layer
+        # makes each apply genuinely cost milliseconds (the batch thread
+        # is one thread, so apply time bounds replica throughput), small
+        # max_batch keeps coalescing from buying it back, and the small
+        # queue + queue-deadline policy exercise the r18 shed paths under
+        # genuine saturation (the `overload_tripped` gate pins that it
+        # really happened).
+        f"--hidden_units={args.hidden_units}",
+        f"--ps_hosts={','.join(f'127.0.0.1:{p}' for p in ps_ports)}",
+        f"--ps_shards={args.ps_shards}",
+        f"--ps_replicas={args.ps_replicas}",
+        f"--worker_hosts={','.join(f'127.0.0.1:{7000 + i}' for i in range(args.workers))}",
+        f"--serve_hosts={','.join(f'127.0.0.1:{p}' for p in serve_ports)}",
+        "--ps_restarts=3",
+        f"--lease_ttl_s={args.lease_ttl_s}",
+        "--log_every_steps=50",
+        f"--serve_queue_depth={args.serve_queue_depth}",
+        "--serve_max_batch=2",
+        "--serve_max_wait_ms=20",
+        f"--serve_queue_deadline_ms={args.serve_queue_deadline_ms}",
+    ]
+    env = dict(os.environ)
+    env.pop("DTX_FAULT_ROLE", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["DTX_FAULT_PLAN"] = ""  # overload IS the fault; no injected chaos
+    procs: dict[str, subprocess.Popen] = {}
+
+    def spawn(job: str, index: int) -> None:
+        procs[f"{job}{index}"] = launch_task(
+            args.example, common, job, index, logdir, env
+        )
+
+    verdict: dict = {
+        "schema_version": VERDICT_SCHEMA_VERSION,
+        "metric": "loadsim_overload_slo",  # perf_gate baseline auto-select
+        "qps_target": args.qps,
+        "gen_threads": args.gen_threads,
+        "burst_threads": args.burst_threads,
+        "duration_s": args.duration_s,
+        "goodput_floor_frac": args.goodput_floor_frac,
+        "recovery_bound_s": args.recovery_bound_s,
+        "recovery_factor": args.recovery_factor,
+        "logdir": logdir,
+    }
+    gen = None
+    burst_children: list[subprocess.Popen] = []
+    step_series: list[tuple[float, int]] = []
+    scrape_fail = 0
+    members_before: set = set()
+    members_after: set = set()
+    last_summary: dict = {}
+
+    def scrape(dst_members: set | None = None) -> None:
+        nonlocal scrape_fail, last_summary
+        try:
+            snap = dtxtop.snapshot(
+                ps_addrs, ps_shards=args.ps_shards,
+                ps_replicas=args.ps_replicas, timeout_s=3.0,
+            )
+            steps = snap["summary"]["serve"]["model_steps"]
+            step_series.append(
+                (time.monotonic(), max(steps) if steps else -1)
+            )
+            last_summary = snap["summary"]
+            if dst_members is not None:
+                mem = snap["summary"]["members"]
+                dst_members.update(mem["workers"], mem["serve"])
+        except Exception:  # noqa: BLE001 — a saturated scrape may miss
+            scrape_fail += 1
+
+    try:
+        for i in range(n_ps):
+            spawn("ps", i)
+        if not wait_ps_ready(ps_addrs, args.ready_wait_s):
+            raise RuntimeError(f"PS tasks never came up (logs: {logdir})")
+        spawn("chief", 0)
+        for i in range(args.workers):
+            spawn("worker", i)
+        for i in range(args.serve_replicas):
+            spawn("serve", i)
+        if not wait_serve_ready(serve_addrs, args.ready_wait_s):
+            raise RuntimeError(
+                f"serve replicas never pulled a model (logs: {logdir})"
+            )
+
+        gen = LoadGenerator(
+            ps_addrs, serve_addrs, qps=args.qps, threads=args.gen_threads,
+            deadline_s=max(30.0, args.duration_s),
+        )
+        gen.start()
+        t0 = time.monotonic()
+        t_burst_on = t0 + OVERLOAD_PHASES["burst_start"] * args.duration_s
+        t_burst_off = t0 + OVERLOAD_PHASES["burst_end"] * args.duration_s
+
+        # Phase 1 — baseline: the healthy p99 the recovery gate compares
+        # against, plus the live-member set whose leases must survive.
+        while time.monotonic() < t_burst_on:
+            scrape(members_before)
+            time.sleep(1.0)
+        baseline = gen.snap_window()
+        verdict["baseline_p99_ms"] = baseline["p99_ms"]
+        verdict["baseline_ok"] = baseline["ok"]
+        verdict["baseline_failed"] = baseline["failed"]
+
+        # Phase 2 — burst: unpaced closed-loop clients in SEPARATE
+        # processes (the orchestrator's own GIL must not cap the offered
+        # load — and N distinct client processes is the real overload
+        # shape).  Each child's pool runs a SHORT logical deadline: under
+        # saturation a burst predict fails fast (through the retry
+        # budget) instead of queueing forever — burst failures are
+        # EXPECTED and not gated; the goodput floor is.
+        burst_s = t_burst_off - time.monotonic()
+        per_proc = max(1, args.burst_threads // args.burst_procs)
+        burst_children += [
+            subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--scenario=burst_child",
+                 "--burst_serve_hosts="
+                 + ",".join(f"127.0.0.1:{p}" for p in serve_ports),
+                 f"--gen_threads={per_proc}",
+                 f"--burst_rows={args.burst_rows}",
+                 f"--duration_s={burst_s:.1f}"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, env=env, cwd=ROOT,
+            )
+            for _ in range(args.burst_procs)
+        ]
+        faults.log_event(
+            "loadsim_burst_on", procs=args.burst_procs, threads=per_proc,
+        )
+        while any(c.poll() is None for c in burst_children):
+            scrape()
+            time.sleep(1.0)
+            if time.monotonic() > t_burst_off + 60.0:
+                for c in burst_children:
+                    c.kill()
+                break
+        paced_burst = gen.snap_window()
+        t_recover0 = time.monotonic()
+        faults.log_event("loadsim_burst_off")
+        burst_ok = burst_failed = 0
+        for c in burst_children:
+            try:
+                out, _ = c.communicate(timeout=10.0)
+                st = json.loads(out.strip().splitlines()[-1])
+                burst_ok += st["predict_ok"]
+                burst_failed += st["predict_failed"]
+            except Exception:  # noqa: BLE001 — a killed child reports 0
+                burst_failed += 1
+        burst_window = t_recover0 - t_burst_on
+        goodput = (paced_burst["ok"] + burst_ok) / max(0.1, burst_window)
+        verdict["burst_window_s"] = round(burst_window, 1)
+        verdict["burst_procs"] = args.burst_procs
+        verdict["burst_goodput_qps"] = round(goodput, 2)
+        verdict["burst_paced"] = paced_burst
+        verdict["burst_ok"] = burst_ok
+        verdict["burst_failed"] = burst_failed
+
+        # Phase 3 — recovery: windowed p99 of the PACED traffic until it
+        # returns under the bounded multiple of baseline (or the bound
+        # expires).  The clock starts the moment the burst stops.
+        target_ms = max(
+            args.recovery_factor * baseline["p99_ms"], args.recovery_floor_ms
+        )
+        verdict["recovery_target_ms"] = round(target_ms, 3)
+        recovery_s = None
+        windows = []
+        while time.monotonic() < t_recover0 + args.recovery_bound_s:
+            t_win = time.monotonic()
+            while time.monotonic() < t_win + 2.0:
+                scrape()
+                time.sleep(1.0)
+            w = gen.snap_window()
+            windows.append(w)
+            # Recovered = a window that is fully HEALTHY again: traffic
+            # flowing, zero typed failures (the retry budgets refilled),
+            # p99 back under the bounded multiple of baseline.
+            if w["ok"] > 0 and w["failed"] == 0 and w["p99_ms"] <= target_ms:
+                recovery_s = time.monotonic() - t_recover0
+                break
+        verdict["recovery_windows"] = windows
+        verdict["recovery_s"] = (
+            round(recovery_s, 1) if recovery_s is not None else -1.0
+        )
+        # A short settled tail so the step/lease gates see the recovered
+        # cluster, and the member set to compare against the baseline's.
+        t_tail = time.monotonic() + 3.0
+        while time.monotonic() < t_tail:
+            scrape(members_after)
+            time.sleep(1.0)
+        verdict["window_s"] = round(time.monotonic() - t0, 1)
+    finally:
+        for c in burst_children:
+            if c.poll() is None:  # an exception mid-burst: don't orphan
+                c.kill()
+        load = gen.stop() if gen is not None else {
+            "predict_ok": 0, "predict_failed": -1, "errors": ["never ran"],
+            "p50_ms": 0.0, "p90_ms": 0.0, "p99_ms": 0.0,
+        }
+        for name, p in procs.items():
+            if p.poll() is None:
+                p.send_signal(
+                    signal.SIGTERM
+                    if name.startswith(("ps", "serve"))
+                    else signal.SIGKILL
+                )
+        deadline = time.monotonic() + 15.0
+        for p in procs.values():
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+            getattr(p, "_dtx_logf").close()
+
+    verdict.update(load)
+    verdict["scrape_failures"] = scrape_fail
+    verdict.update(analyze_steps(step_series, {"burst": 0.0}))
+    # The overload telemetry the run produced (dtxtop's last scrape):
+    # sheds prove admission control engaged; leases_expired must be 0.
+    verdict["shed_total"] = (
+        last_summary.get("serve", {}).get("shed_total", 0)
+        + last_summary.get("ps", {}).get("shed_total", 0)
+        + last_summary.get("dsvc", {}).get("shed_total", 0)
+    )
+    verdict["batcher_overloads"] = last_summary.get("serve", {}).get(
+        "overloads", 0
+    )
+    verdict["leases_expired"] = last_summary.get("ps", {}).get(
+        "leases_expired", -1
+    )
+    verdict["retry"] = last_summary.get("retry", {})
+    verdict["members_before"] = sorted(members_before)
+    verdict["members_after"] = sorted(members_after)
+    goodput_floor = args.goodput_floor_frac * args.qps
+    verdict["goodput_floor_qps"] = round(goodput_floor, 2)
+    gates = {
+        # The HEALTHY phases are spotless: zero typed failures before the
+        # burst.  (During the burst, paced predicts MAY surface the typed
+        # budget-exhausted/deadline errors — that is the discipline
+        # working, and the goodput + recovery gates bound its cost.)
+        "zero_failed_baseline": verdict["baseline_failed"] == 0,
+        "baseline_served": verdict["baseline_ok"] > 0,
+        # Graceful degradation DURING the burst: admitted work completes
+        # at or above the floor while the excess sheds.
+        "goodput_floor": verdict["burst_goodput_qps"] >= goodput_floor,
+        # Control-plane priority: saturation never starved a heartbeat
+        # into a false member expiry — and every pre-burst member is
+        # still leased after recovery.
+        "zero_lease_expirations": verdict["leases_expired"] == 0,
+        "members_retained": members_before <= members_after,
+        # The no-metastability proof: p99 back under the bounded multiple
+        # of baseline within the recovery window of burst end.
+        "p99_recovered_in_bound": verdict["recovery_s"] >= 0.0,
+        "step_monotone": verdict["step_monotone"],
+        "step_advanced": verdict["step_advanced"],
+        # The burst genuinely tripped admission control somewhere (core
+        # shed or batcher refusal): a burst the cluster absorbed without
+        # shedding proves nothing about degradation.
+        "overload_tripped": (
+            verdict["shed_total"] + verdict["batcher_overloads"] > 0
+        ),
+    }
+    verdict["gates"] = gates
+    verdict["slo_pass"] = all(gates.values())
+    verdict["loadsim_p99_ms"] = load["p99_ms"]
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(verdict, f, indent=2)
+    print(json.dumps(verdict))
+    return 0 if verdict["slo_pass"] else 1
+
+
+def run_burst_child(args) -> int:
+    """Internal (``--scenario=burst_child``): one burst-client process of
+    the overload scenario — ``--gen_threads`` unpaced closed-loop clients
+    against ``--burst_serve_hosts`` for ``--duration_s``, final stats as
+    the last stdout line."""
+    from distributed_tensorflow_examples_tpu.utils import faults
+
+    faults.set_role("loadsim_burst")
+    serve_addrs = [
+        (h, int(p))
+        for h, _, p in (
+            a.rpartition(":") for a in args.burst_serve_hosts.split(",") if a
+        )
+    ]
+    gen = LoadGenerator(
+        [], serve_addrs, qps=None, threads=args.gen_threads,
+        deadline_s=3.0, role="loadsim_burst_sv", op_timeout_s=3.0,
+        rows=args.burst_rows, pool_per_thread=True,
+    )
+    gen.start()
+    time.sleep(args.duration_s)
+    print(json.dumps(gen.stop()))
+    return 0
+
+
 def _fired_in(p, needle: str) -> bool:
     path = getattr(p, "_dtx_log", "") if p is not None else ""
     try:
@@ -572,14 +998,79 @@ def main(argv=None) -> int:
         help="expected boot window baked into the chaos after_s offsets",
     )
     ap.add_argument(
-        "--scenario", choices=("chaos", "reshard"), default="chaos",
+        "--scenario",
+        choices=("chaos", "reshard", "overload", "burst_child"),
+        default="chaos",
         help="chaos = the r14 kill/join/leave cycle; reshard = the r15 "
-        "live N->N+1->N PS resizing under load (one worker kill)",
+        "live N->N+1->N PS resizing under load (one worker kill); "
+        "overload = the r18 graceful-degradation burst (admission "
+        "control, deadline propagation, retry budgets); burst_child is "
+        "internal (one spawned burst-client process of the overload run)",
     )
     ap.add_argument(
         "--reshard_bound_s", type=float, default=30.0,
         help="reshard scenario: max wall-time per epoch transition "
         "(joiner spawn -> commit observed)",
+    )
+    ap.add_argument(
+        "--burst_threads", type=int, default=64,
+        help="overload scenario: unpaced burst clients slammed at the "
+        "serve pool mid-run (4x the paced 16 by default — each re-issues "
+        "the instant its previous predict resolves, so offered load is "
+        "whatever the cluster will bear plus a queue)",
+    )
+    ap.add_argument(
+        "--burst_procs", type=int, default=4,
+        help="overload scenario: burst-client PROCESSES the threads are "
+        "spread over (one GIL must not cap the offered load)",
+    )
+    ap.add_argument(
+        "--burst_serve_hosts", default="",
+        help="internal (burst_child): static serve host list to hammer",
+    )
+    ap.add_argument(
+        "--burst_rows", type=int, default=64,
+        help="overload scenario: rows per burst predict — heavy requests "
+        "make each admitted burst batch cost real apply time, so the "
+        "replica queue genuinely BUILDS instead of draining at wire "
+        "speed (the paced SLO traffic stays at 4 rows)",
+    )
+    ap.add_argument(
+        "--goodput_floor_frac", type=float, default=0.5,
+        help="overload scenario: ok-predicts/sec during the burst must "
+        "stay above this fraction of the paced qps target",
+    )
+    ap.add_argument(
+        "--recovery_bound_s", type=float, default=20.0,
+        help="overload scenario: p99 must return under the recovery "
+        "target within this many seconds of burst end",
+    )
+    ap.add_argument(
+        "--recovery_factor", type=float, default=1.5,
+        help="overload scenario: the recovery target as a multiple of "
+        "the baseline-phase p99",
+    )
+    ap.add_argument(
+        "--recovery_floor_ms", type=float, default=50.0,
+        help="overload scenario: absolute floor on the recovery target "
+        "(a very fast baseline must not make recovery unprovable)",
+    )
+    ap.add_argument(
+        "--serve_queue_depth", type=int, default=8,
+        help="overload scenario: the replicas' bounded in-system predict "
+        "queue (small enough that --burst_threads genuinely exceeds "
+        "capacity on a dev box)",
+    )
+    ap.add_argument(
+        "--serve_queue_deadline_ms", type=float, default=500.0,
+        help="overload scenario: the replicas' queue-deadline policy "
+        "(requests that waited past it are shed before a worker runs)",
+    )
+    ap.add_argument(
+        "--hidden_units", type=int, default=4096,
+        help="overload scenario: MLP width — wide enough that one apply "
+        "costs real milliseconds, bounding replica throughput below the "
+        "burst's offered load",
     )
     ap.add_argument("--no_chaos", action="store_true")
     ap.add_argument("--out", default="", help="write the verdict JSON here")
@@ -595,6 +1086,10 @@ def main(argv=None) -> int:
         if args.ps_shards < 2:
             args.ps_shards = 2  # the acceptance resizes 2->3->2
         return run_reshard(args)
+    if args.scenario == "overload":
+        return run_overload(args)
+    if args.scenario == "burst_child":
+        return run_burst_child(args)
 
     from distributed_tensorflow_examples_tpu.parallel import membership
     from distributed_tensorflow_examples_tpu.utils import faults
@@ -602,6 +1097,7 @@ def main(argv=None) -> int:
 
     faults.set_role("loadsim")
     logdir = args.logdir or tempfile.mkdtemp(prefix="dtx-loadsim-")
+    os.makedirs(logdir, exist_ok=True)
     n_ps = args.ps_shards * args.ps_replicas
     join_wid = args.workers  # the joiner takes the next task index
     ports = free_ports(n_ps + args.serve_replicas)

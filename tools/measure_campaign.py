@@ -201,6 +201,18 @@ def steps_plan() -> list[dict]:
              cmd=[PY, "tools/loadsim.py", "--scenario", "reshard", "--qps",
                   "25", "--duration_s", "45", "--p99_bound_ms", "400"],
              timeout=900, cpu_ok=True),
+        # Graceful-degradation acceptance (r18): a >=4x-capacity unpaced
+        # burst against deliberately bounded serve replicas — admission
+        # control must shed the excess (goodput floor holds), control ops
+        # are never shed (zero lease expirations), and p99 returns to a
+        # bounded multiple of baseline within the recovery window of
+        # burst end (no metastable retry storm).  JAX-on-CPU, so cpu_ok;
+        # verdict gated against tools/loadsim_overload_baseline.json by
+        # perf_gate (metric loadsim_overload_slo).
+        dict(name="loadsim_overload",
+             cmd=[PY, "tools/loadsim.py", "--scenario", "overload",
+                  "--qps", "100", "--duration_s", "30"],
+             timeout=900, cpu_ok=True),
     ]
     return plan
 

@@ -32,15 +32,21 @@ REQUIRED_KEYS = {
         "requests", "incarnation", "shard_id", "shard_count", "live_conns",
         "fwd_ok", "fwd_refused", "repl_syncs_served", "mirror_applies",
         "acc_deduped", "gq_deduped", "diverged",
+        # r18 admission control: the shed counters every service exports
+        # in the same top-level shape (dtxtop + the overload SLO read
+        # them uniformly).
+        "shed_total", "queue_deadline_drops",
     ),
     "dsvc": (
         "requests", "incarnation", "epoch", "batches_served",
         "assigned_total", "acks", "reassigned", "registry",
+        "shed_total", "queue_deadline_drops",
     ),
     "serve": (
         "requests", "incarnation", "model_step", "predict_rows",
         "batcher_batch_rows_p50", "batcher_queue_depth_p99",
         "serve/latency_p99_ms", "registry",
+        "shed_total", "queue_deadline_drops",
     ),
 }
 

@@ -80,6 +80,16 @@ BASELINES = {
     # every epoch visible to dtxtop) must hold, and a gate present in the
     # baseline must still be computed by the result.
     "loadsim_reshard_slo": "loadsim_reshard_baseline.json",
+    # r18 graceful-degradation acceptance (tools/loadsim.py
+    # --scenario=overload): binary slo_pass over the overload gate set —
+    # goodput floor during a >=4x-capacity burst, zero lease expirations
+    # for live members (control ops are never shed), p99 recovered to a
+    # bounded multiple of baseline within the recovery window of burst
+    # end (the no-metastability proof), step monotone, and the burst
+    # genuinely tripping admission control (a run the cluster absorbed
+    # without shedding proves nothing).  Gate-set shrink detection as
+    # with the other loadsim verdicts.
+    "loadsim_overload_slo": "loadsim_overload_baseline.json",
     # r16 static-analysis wall-time budget (tools/dtxlint_step.py): the
     # lint's repo gate runs inside tier-1, so a pass whose cost silently
     # explodes taxes every future test run — the campaign fails first.
