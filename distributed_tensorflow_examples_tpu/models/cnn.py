@@ -42,15 +42,29 @@ def init(cfg: Config, rng: jax.Array, *, image_size: int = 32, in_channels: int 
     feat = (image_size // (2 ** n_conv)) ** 2 * cin
     din = feat
     for j, dout in enumerate(cfg.dense):
-        params[f"dense_{j}"] = layers.dense_init(rngs[n_conv + j], din, dout)
+        # He (fan-in) init for the relu'd hidden denses (r19 convergence
+        # fix): glorot under-scales a relu stack by sqrt(2) per layer,
+        # and on this 2-dense head the compounded deficit left the async
+        # run's early dynamics on the 2.303 plateau after upstream RNG
+        # drift moved the draw.  He restores the TF-tutorial-era scale.
+        params[f"dense_{j}"] = layers.dense_init(
+            rngs[n_conv + j], din, dout, init="he"
+        )
         din = dout
     params["logits"] = layers.dense_init(rngs[-1], din, cfg.num_classes)
-    # Zero-init the softmax layer (the TF CIFAR tutorial uses stddev=1/192
-    # for the same reason): glorot-scale logits on 192 inputs start the loss
+    # Small-stddev softmax init, the TF CIFAR tutorial's exact choice
+    # (stddev = 1/192): glorot-scale logits on 192 inputs start the loss
     # at ~4.6 instead of ln(10), and the resulting ~50x-too-big first
     # gradients collapse the relu stack to the uniform plateau (observed:
-    # 400 steps stuck at loss 2.303) or NaN outright at lr>=0.1.
-    params["logits"]["kernel"] = jnp.zeros_like(params["logits"]["kernel"])
+    # 400 steps stuck at loss 2.303) or NaN outright at lr>=0.1.  The r10
+    # zero-init avoided that too but also ZEROED the gradient into every
+    # layer below for the first apply(s) — with the r19 convergence-rate
+    # fix (He hidden denses + LR warmup) the tutorial's tiny-but-nonzero
+    # scale keeps the whole stack learning from step 1 at ln(10) loss.
+    kr = jax.random.split(rngs[-1])[0]
+    params["logits"]["kernel"] = (1.0 / din) * jax.random.normal(
+        kr, (din, cfg.num_classes), jnp.float32
+    )
     return params
 
 

@@ -35,6 +35,14 @@ def he_normal_conv(rng, shape, dtype=jnp.float32):
     return std * jax.random.normal(rng, shape, dtype)
 
 
+def he_normal(rng, shape, in_axis=-2, dtype=jnp.float32):
+    """He (fan-in) init for dense kernels — the relu-correct scale
+    (glorot averages fan_in/fan_out and under-scales a relu stack by
+    sqrt(2), which compounds per layer)."""
+    std = jnp.sqrt(2.0 / shape[in_axis])
+    return std * jax.random.normal(rng, shape, dtype)
+
+
 def uniform_embedding(rng, shape, scale=None, dtype=jnp.float32):
     """word2vec-style U[-1/dim, 1/dim] embedding init."""
     scale = scale if scale is not None else 1.0 / shape[-1]
@@ -46,9 +54,20 @@ def uniform_embedding(rng, shape, scale=None, dtype=jnp.float32):
 # ----------------------------------------------------------------------------
 
 
-def dense_init(rng, in_dim: int, out_dim: int, *, use_bias: bool = True):
+def dense_init(
+    rng, in_dim: int, out_dim: int, *, use_bias: bool = True,
+    init: str = "glorot",
+):
+    """``init``: "glorot" (the default every linear/softmax layer keeps)
+    or "he" (fan-in — the relu-correct scale for hidden layers)."""
     kr, _ = jax.random.split(rng)
-    p = {"kernel": glorot_uniform(kr, (in_dim, out_dim))}
+    if init == "he":
+        kernel = he_normal(kr, (in_dim, out_dim))
+    elif init == "glorot":
+        kernel = glorot_uniform(kr, (in_dim, out_dim))
+    else:
+        raise ValueError(f"unknown dense init {init!r}")
+    p = {"kernel": kernel}
     if use_bias:
         p["bias"] = jnp.zeros((out_dim,), jnp.float32)
     return p

@@ -538,6 +538,96 @@ def decode_step(cfg: Config, params, cache, token, pos, *, mesh: Mesh | None = N
     return layers.dense(params["head"], h, dtype=cfg.dtype)[:, 0], new_cache
 
 
+def _block_decode_batch(cfg: Config, p, h, layer_cache, pos, *, constrain, mesh=None):
+    """One block for ONE new token PER ROW at per-row positions: h
+    [B, 1, D], ``pos`` [B] int32 — the sequence-slot serving shape
+    (models/transformer.py's half of serve/batcher.SlotBatcher): each row
+    is an independent decode session at its own depth.
+
+    Identical math to :func:`_block_decode` row-for-row: the cache write
+    is a one-hot ``where`` at each row's position (same values
+    ``dynamic_update_slice`` writes at a shared position), and the causal
+    mask bounds each row at ITS ``pos`` — so a session's row depends only
+    on cache positions that session wrote itself, which is what lets a
+    freed slot be reseated with no cache reset and keeps batched decode
+    byte-identical to a session running alone (tested)."""
+    B = h.shape[0]
+    T = layer_cache["k"].shape[2]
+    da = cfg.data_axes
+    y = _layernorm(p["ln1"], h)
+    qkv = layers.dense(p["qkv"], y, dtype=cfg.dtype)
+    qkv = qkv.reshape(B, 1, cfg.n_heads, 3, cfg.head_dim)
+    q, k, v = [jnp.moveaxis(qkv[:, :, :, j], 2, 1) for j in range(3)]  # [B,H,1,hd]
+    q = constrain(q, P(da, "model", None, None))
+    onehot = (
+        jnp.arange(T)[None, :] == pos[:, None]
+    )[:, None, :, None]  # [B,1,T,1]
+    ck = jnp.where(onehot, k, layer_cache["k"])
+    cv = jnp.where(onehot, v, layer_cache["v"])
+    ck = constrain(ck, P(da, "model", None, None))
+    cv = constrain(cv, P(da, "model", None, None))
+    s = jnp.einsum(
+        "bhqd,bhtd->bhqt", q, ck, preferred_element_type=jnp.float32
+    ) / math.sqrt(cfg.head_dim)
+    t_idx = jnp.arange(T)
+    s = jnp.where(
+        t_idx[None, None, None, :] <= pos[:, None, None, None], s, -jnp.inf
+    )
+    w = jax.nn.softmax(s, axis=-1).astype(cfg.dtype)
+    o = jnp.einsum("bhqt,bhtd->bhqd", w, cv)
+    o = jnp.moveaxis(o, 1, 2).reshape(B, 1, cfg.dim)
+    h = h + layers.dense(p["proj"], o, dtype=cfg.dtype)
+    h = constrain(h, P(da, None, None))
+    if "moe" in p:
+        h, _ = _moe_tail(cfg, p, h, constrain, mesh)
+    else:
+        h = _mlp_tail(cfg, p, h, constrain)
+    return h, {"k": ck, "v": cv}
+
+
+def decode_step_batch(
+    cfg: Config, params, cache, token, pos, *, mesh: Mesh | None = None,
+):
+    """token [B] int32, pos [B] int32 (PER-ROW positions) -> (logits
+    [B, V], new cache) — the sequence-slot batched decode step: row b
+    advances its own session at position ``pos[b]``.  Same math as
+    :func:`decode_step` per row (which requires ONE shared position); the
+    serving engine jits this once at the fixed slot shape and every
+    active session rides one apply."""
+    if cfg.pipeline_stages > 1:
+        raise NotImplementedError(
+            "decode supports the non-pipelined model (dense or MoE)"
+        )
+    constrain = _decode_constrain(mesh)
+    da = cfg.data_axes
+    h = layers.embedding_lookup(params["emb"], token[:, None], dtype=cfg.dtype)
+    h = h + params["pos"]["table"][pos].astype(cfg.dtype)[:, None]
+    h = constrain(h, P(da, None, None))
+    new_cache = {}
+    for i in range(cfg.n_layers):
+        h, new_cache[f"block_{i}"] = _block_decode_batch(
+            cfg, params[f"block_{i}"], h, cache[f"block_{i}"], pos,
+            constrain=constrain, mesh=mesh,
+        )
+    h = _layernorm(params["ln_f"], h)
+    return layers.dense(params["head"], h, dtype=cfg.dtype)[:, 0], new_cache
+
+
+def serve_decode_fns(cfg: Config, *, mesh: Mesh | None = None):
+    """The ``(init_cache_fn, step_fn)`` pair a serving replica's decode
+    engine needs (``serve.ModelReplicaServer(decode_fns=...)``): slot-
+    shaped KV cache + the per-row-position batched step.  One definition,
+    so the served decode path and the model cannot drift."""
+
+    def init_cache_fn(slots: int, max_len: int):
+        return init_cache(cfg, slots, max_len, mesh=mesh)
+
+    def step_fn(params, cache, tokens, pos):
+        return decode_step_batch(cfg, params, cache, tokens, pos, mesh=mesh)
+
+    return init_cache_fn, step_fn
+
+
 def generate(
     cfg: Config,
     params,

@@ -157,6 +157,7 @@ class Service:
         "name", "handler", "control_ops", "counts_fn", "error_status",
         "accept_dtypes", "max_payload", "on_disconnect",
         "queue_deadline_s", "max_inflight_per_conn", "retry_after_ms",
+        "hello_extra",
     )
 
     def __init__(
@@ -169,6 +170,7 @@ class Service:
         queue_deadline_s: float | None = None,
         max_inflight_per_conn: int = 16,
         retry_after_ms: int = 50,
+        hello_extra: Callable | None = None,
     ):
         if name not in wire.SERVICE_IDS:
             raise ValueError(
@@ -188,6 +190,10 @@ class Service:
         )
         self.max_inflight_per_conn = max(1, int(max_inflight_per_conn))
         self.retry_after_ms = max(0, int(retry_after_ms))
+        # Extra bytes appended to the HELLO success tag (the msrv model-
+        # version word, r19): called per HELLO on the selector thread, so
+        # it must be cheap and never raise.
+        self.hello_extra = hello_extra
 
 
 class CoreConn:
@@ -798,6 +804,8 @@ class ServerCore:
         )
         if status == wire.WIRE_VERSION:
             conn.service = svc
+            if tag and svc.hello_extra is not None:
+                tag = tag + svc.hello_extra()
         self._queue_reply(
             conn, seq, status, [tag] if tag else None, dispatched=False
         )

@@ -188,11 +188,24 @@ DSVC_OPS: dict[str, int] = {
 }
 
 #: Serving-replica op codes (serve/model_server.py), disjoint from both.
+#: DECODE_* (r19) are the STREAM code points of the decode-serving wire:
+#: a stateful autoregressive session is OPENed (payload = the prompt
+#: batch, ``a`` = max new tokens; the session id answers as the status),
+#: then the client PULLS its token stream incrementally — DECODE_NEXT's
+#: ``a`` is the session id and ``b`` the client's CURSOR (tokens already
+#: received), and the server answers ``emitted[cursor:]`` — so a replayed
+#: poll after a reconnect re-reads instead of double-draining (the same
+#: replay-safety discipline as pure PREDICT, bought with a cursor instead
+#: of purity).  DECODE_CLOSE is idempotent.  All three are DATA-plane ops
+#: (counted; a decode session is real served work, not poll cadence).
 SRV_OPS: dict[str, int] = {
     "HELLO": 26,
     "PREDICT": 96,
     "STATS": 97,
     "SHUTDOWN": 98,
+    "DECODE_OPEN": 99,
+    "DECODE_NEXT": 100,
+    "DECODE_CLOSE": 101,
 }
 
 #: Data-service response statuses.  Positive codes are per-op results
@@ -214,7 +227,41 @@ SRV_STATUS: dict[str, int] = {
     "ERR": -2,  # bad request / failed apply
     "OVERLOAD": -7,  # admission control: queue full, back off / try a peer
     "NO_MODEL": -8,  # replica up but no published snapshot yet (warming)
+    "BAD_SESSION": -9,  # DECODE_NEXT/CLOSE: unknown or expired session id
+    "NO_DECODER": -10,  # DECODE_OPEN: this replica serves no decode path
 }
+
+#: Reserved field name the serving replica stamps into every predict /
+#: decode response batch: the REGISTRY MODEL VERSION the answer was served
+#: from (r19; 0 = hot-tracking the live training run, no pinned version).
+#: The client strips it before handing outputs to the caller, so the
+#: version rides next to ``model_step`` with zero schema impact on user
+#: fields — pools read it to keep per-version (canary vs stable)
+#: latency/error accounting.
+SRV_VERSION_FIELD = "__model_version__"
+
+#: msrv HELLO version word (r19): a serving replica's HELLO success answer
+#: is its 4-byte service tag PLUS one ``<q`` MODEL VERSION (0 =
+#: hot-tracking) — a dialing pool learns which registry version the
+#: replica serves before routing a single predict, which is what makes
+#: canary-weighted routing work on freshly discovered replicas.  Pre-r19
+#: msrv replicas answer the bare tag; clients treat that as version 0.
+HELLO_VERSION_TAIL = struct.Struct("<q")
+
+
+def unpack_hello_tag(payload: bytes | None) -> tuple[bytes | None, int]:
+    """Split a Python-service HELLO success payload into ``(tag,
+    model_version)``.  A bare 4-byte tag (dsvc, pre-r19 msrv) carries
+    version 0; anything else hands the payload back unsplit so
+    :func:`hello_failure` names it in the diagnostic."""
+    if payload is None:
+        return None, 0
+    payload = bytes(payload)
+    if len(payload) == 4:
+        return payload, 0
+    if len(payload) == 4 + HELLO_VERSION_TAIL.size:
+        return payload[:4], HELLO_VERSION_TAIL.unpack(payload[4:])[0]
+    return payload, 0
 
 #: Control-plane ops per service (r16): the ONE definition of which ops
 #: are excluded from (a) every server's request counter and (b) the
@@ -482,7 +529,10 @@ def hello_failure(
     a valid success for ``service``, else a diagnostic naming both ends —
     what this client speaks AND what the peer turned out to be."""
     want = SERVICE_NAMES[service]
-    if status == WIRE_VERSION and tag == SERVICE_TAGS[service]:
+    # The success payload is the 4-byte service tag, optionally followed
+    # by the msrv HELLO version word (r19) — split before comparing.
+    tag4, _version = unpack_hello_tag(tag)
+    if status == WIRE_VERSION and tag4 == SERVICE_TAGS[service]:
         return None
     got = unpack_wrong_service(status)
     if got is not None:

@@ -20,6 +20,15 @@ params to any consumer" substrate, applied to serving:
   in-process replica set against measured queue depth / p99, and
   :class:`LeaseServeDiscovery` follows the membership lease registry so
   pools track an elastic replica set with no static flags.
+- ``registry`` (r19) — :class:`ModelRegistry`: immutable ``(name,
+  version)`` flat-param snapshots with fsync'd atomic manifests and
+  lease-style pins; replicas PIN a version instead of hot-tracking, and
+  GC can never reclaim a version a live replica serves.
+- ``deploy`` (r19) — :class:`RollingDeploy`: canary/promote/rollback
+  version flips over a live pool with zero failed predicts
+  (start-then-stop surge + lease-release-before-stop), and
+  :func:`canary_verdict`, the promote-or-rollback policy over the pool's
+  per-version accounting.
 """
 
 from .autoscale import (  # noqa: F401
@@ -27,7 +36,7 @@ from .autoscale import (  # noqa: F401
     ServeAutoscaler,
     make_replica_factory,
 )
-from .batcher import DynamicBatcher, Overloaded  # noqa: F401
+from .batcher import DynamicBatcher, Overloaded, SlotBatcher  # noqa: F401
 from .client import (  # noqa: F401
     ServeClient,
     ServeDeadlineError,
@@ -35,9 +44,16 @@ from .client import (  # noqa: F401
     ServeOverloadError,
     ServePool,
     ServeRejectedError,
+    ServeSessionError,
     ServeUnavailableError,
+)
+from .deploy import (  # noqa: F401
+    RollingDeploy,
+    canary_verdict,
+    make_pinned_factory,
 )
 from .model_server import (  # noqa: F401
     ModelReplicaServer,
     host_serve_task,
 )
+from .registry import ModelRegistry, RegistryError  # noqa: F401

@@ -214,7 +214,13 @@ class ServeAutoscaler:
 def make_replica_factory(init_fn, predict_fn, ps_addrs, **server_kw):
     """The standard ``make_server`` for :class:`ServeAutoscaler`: each
     replica binds an ephemeral port, leases itself as ``<role>-es<i>``
-    (elastic-serve) and inherits the caller's batcher/refresh knobs."""
+    (elastic-serve) and inherits the caller's batcher/refresh knobs.
+
+    Registry pinning (r19) composes: pass ``registry_dir=`` +
+    ``model_version=`` through ``server_kw`` and every autoscaled
+    replica pins the SAME immutable version — demand-driven scale-up
+    cannot drift a versioned pool (version flips are
+    :class:`serve.deploy.RollingDeploy`'s job, not the autoscaler's)."""
     base_role = faults.current_role() or "serve"
 
     def make(i: int) -> msrv_lib.ModelReplicaServer:

@@ -19,6 +19,17 @@ tail (the load-shedding half of the serving SLO).
 The batcher is model-agnostic: ``run_batch(items) -> results`` is the only
 coupling, so the unit tests drive it with plain functions and the model
 server plugs in the padded jitted apply.
+
+Sequence-slot batching (r19): :class:`SlotBatcher` is the second mode —
+for STATEFUL, VARIABLE-LENGTH work the row-wise padding model cannot
+express (autoregressive decode: a session lives for many steps, holds a
+KV cache, and ends at its own time).  Sessions occupy SLOTS of a
+fixed-width batch; one step thread advances every active slot together
+(``run_step(slots)`` — one jitted apply over the whole slot array), each
+session streams its emissions through a :class:`StreamTicket`, and a
+finished session frees its slot for the next queued one mid-flight.  The
+schema-keyed row batcher and the slot batcher coexist in one replica:
+stateless predicts coalesce rows, decode sessions occupy slots.
 """
 
 from __future__ import annotations
@@ -27,6 +38,7 @@ import logging
 import queue
 import threading
 import time
+from collections import deque
 
 from ..utils import telemetry
 
@@ -313,3 +325,225 @@ class DynamicBatcher:
         for t in pending:
             self._items.pop(t, None)
             t._resolve(error=err)
+
+
+# ----------------------------------------------------------------------------
+# Sequence-slot batching (r19): stateful variable-length sessions
+# ----------------------------------------------------------------------------
+
+
+class StreamTicket:
+    """One decode session's stream: the step thread APPENDS emissions,
+    consumers read them by CURSOR (``snapshot(cursor)`` returns everything
+    from ``cursor`` on), so a replayed poll after a reconnect re-reads
+    instead of double-draining.  Terminal states: ``done`` (the session
+    produced its full budget) or an error (the step function raised — the
+    whole active batch fails, like the row batcher's contract)."""
+
+    __slots__ = ("state", "_emits", "_done", "_error", "_cancelled",
+                 "_lock", "_event")
+
+    def __init__(self, state):
+        self.state = state
+        self._emits: list = []
+        self._done = False
+        self._error: BaseException | None = None
+        self._cancelled = False
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+
+    # -- step-thread side --
+    def _emit(self, items) -> None:
+        with self._lock:
+            self._emits.extend(items)
+        self._event.set()
+
+    def _finish(self, error: BaseException | None = None) -> None:
+        with self._lock:
+            if self._done:
+                return
+            self._done = True
+            self._error = error
+        self._event.set()
+
+    # -- consumer side --
+    def cancel(self) -> None:
+        """Ask the step thread to drop this session at its next step (or
+        before it ever takes a slot).  Idempotent."""
+        self._cancelled = True
+        self._finish(error=None)
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    @property
+    def error(self) -> BaseException | None:
+        return self._error
+
+    def snapshot(self, cursor: int = 0) -> tuple[list, bool]:
+        """``(emissions[cursor:], done)`` — non-blocking, replay-safe (the
+        full emission list is retained for the session's lifetime; decode
+        budgets bound it).  Raises the session's error if it failed."""
+        with self._lock:
+            if self._error is not None:
+                raise self._error
+            return list(self._emits[max(0, int(cursor)):]), self._done
+
+    def wait(self, timeout_s: float | None = None) -> bool:
+        """Block until at least one emission (or a terminal state) since
+        the last ``wait``; True unless the timeout passed."""
+        ok = self._event.wait(timeout_s)
+        self._event.clear()
+        return ok
+
+
+class SlotBatcher:
+    """The sequence-slot step loop.  ``run_step(slots)`` runs on the one
+    step thread with ``slots`` a fixed-length list — ``StreamTicket`` for
+    an occupied slot, None for a free one — and returns a same-length
+    list whose occupied entries are ``(emits, done)``; a free slot's
+    entry is ignored.  The step function owns all cross-step state (KV
+    caches, positions) keyed by SLOT INDEX; the batcher owns occupancy,
+    admission and streaming.
+
+    ``slots``         fixed batch width of one step (the jit shape).
+    ``max_sessions``  admission bound on in-system sessions (active +
+                      queued); past it ``open`` raises :class:`Overloaded`
+                      (the same explicit-shed contract as ``submit``).
+    ``idle_wait_s``   how long the step thread parks when no slot is
+                      active.
+
+    An exception out of ``run_step`` fails every ACTIVE session (each
+    waiter sees it) and frees their slots — queued sessions then take
+    slots and run; the batcher itself never dies.
+    """
+
+    def __init__(
+        self, run_step, *, slots: int = 4, max_sessions: int = 64,
+        idle_wait_s: float = 0.2, name: str = "decode",
+    ):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self._run = run_step
+        self.slots = int(slots)
+        self.max_sessions = max(self.slots, int(max_sessions))
+        self._idle_wait_s = float(idle_wait_s)
+        self._slots: list[StreamTicket | None] = [None] * self.slots
+        self._queue: deque = deque()
+        self._fresh: set = set()  # tickets not yet seen by the step thread
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._stopped = False
+        # Counters (stats(); mutate under _lock or on the step thread).
+        self.sessions = 0
+        self.overloads = 0
+        self.steps = 0
+        self.emitted = 0
+        self.step_errors = 0
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"dtx-{name}-slots"
+        )
+        self._thread.start()
+
+    # -- producer side -------------------------------------------------------
+
+    def open(self, state) -> StreamTicket:
+        """Admit one session (its ``state`` is whatever the step function
+        needs to seed a slot).  Raises :class:`Overloaded` past
+        ``max_sessions`` in-system."""
+        t = StreamTicket(state)
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("slot batcher is stopped")
+            active = sum(1 for s in self._slots if s is not None)
+            if active + len(self._queue) >= self.max_sessions:
+                self.overloads += 1
+                raise Overloaded(
+                    f"{active} active + {len(self._queue)} queued decode "
+                    f"sessions (bound {self.max_sessions})"
+                )
+            self.sessions += 1
+            self._queue.append(t)
+        self._work.set()
+        return t
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "slots": self.slots,
+                "slots_active": sum(1 for s in self._slots if s is not None),
+                "sessions_queued": len(self._queue),
+                "sessions": self.sessions,
+                "overloads": self.overloads,
+                "steps": self.steps,
+                "emitted": self.emitted,
+                "step_errors": self.step_errors,
+            }
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+        self._work.set()
+        self._thread.join(timeout=10.0)
+
+    # -- the step thread -----------------------------------------------------
+
+    def _fill_slots(self) -> tuple[list, bool]:
+        """Seat queued sessions in free slots, drop cancelled ones;
+        returns ``(slots snapshot, any_active)``."""
+        with self._lock:
+            for i in range(self.slots):
+                t = self._slots[i]
+                if t is not None and (t._cancelled or t.done):
+                    self._slots[i] = None
+            while self._queue and any(s is None for s in self._slots):
+                t = self._queue.popleft()
+                if t._cancelled:
+                    continue
+                i = next(
+                    k for k, s in enumerate(self._slots) if s is None
+                )
+                self._slots[i] = t
+                self._fresh.add(t)
+            snapshot = list(self._slots)
+        return snapshot, any(s is not None for s in snapshot)
+
+    def _loop(self) -> None:
+        while True:
+            if self._stopped:
+                break
+            slots, active = self._fill_slots()
+            if not active:
+                self._work.wait(self._idle_wait_s)
+                self._work.clear()
+                continue
+            try:
+                results = self._run(slots)
+            except BaseException as e:  # noqa: BLE001 — re-raised per session
+                self.step_errors += 1
+                for t in slots:
+                    if t is not None:
+                        t._finish(error=e)
+                continue
+            self.steps += 1
+            for i, t in enumerate(slots):
+                if t is None:
+                    continue
+                self._fresh.discard(t)
+                emits, done = results[i]
+                if emits:
+                    self.emitted += len(emits)
+                    t._emit(emits)
+                if done:
+                    t._finish()
+        # Drain: every active and queued session fails loudly instead of
+        # hanging its poller.
+        err = RuntimeError("slot batcher stopped")
+        with self._lock:
+            pending = [s for s in self._slots if s is not None]
+            pending += [t for t in self._queue]
+            self._queue.clear()
+            self._slots = [None] * self.slots
+        for t in pending:
+            t._finish(error=err)

@@ -41,8 +41,10 @@ import numpy as np
 
 from ..parallel import retry, wire
 from ..utils import faults, telemetry
+from ..utils.metrics import LatencyRecorder
 from .model_server import (
-    ERR, NO_MODEL, OVERLOAD, SRV_PREDICT, SRV_SHUTDOWN, SRV_STATS,
+    BAD_SESSION, ERR, NO_DECODER, NO_MODEL, OVERLOAD, SRV_DECODE_CLOSE,
+    SRV_DECODE_NEXT, SRV_DECODE_OPEN, SRV_PREDICT, SRV_SHUTDOWN, SRV_STATS,
 )
 
 
@@ -78,6 +80,12 @@ class ServeRejectedError(ServeError):
     ejecting the healthy replica and replaying the bad request."""
 
 
+class ServeSessionError(ServeError):
+    """A decode session id the replica no longer knows (expired by the
+    idle sweep, lost to a replica restart, or never existed) — the caller
+    re-opens a session rather than retrying the poll."""
+
+
 class ServeClient:
     """One TCP connection to a model replica (requests serialized on it).
 
@@ -106,6 +114,12 @@ class ServeClient:
         self._lock = threading.RLock()
         self._sock: socket.socket | None = None
         self._hdr = bytearray(wire.RESP_HDR.size)
+        # The served registry version (r19): learned from the msrv HELLO
+        # version word at connect (0 = hot-tracking / pre-r19 replica),
+        # refreshed per response via the SRV_VERSION_FIELD stamp — pools
+        # read both for canary routing and per-version accounting.
+        self.server_model_version = 0
+        self.last_model_version = -1
         try:
             self._connect()
         except OSError:
@@ -131,6 +145,7 @@ class ServeClient:
         if err is not None:
             self._sever()
             raise ServeError(err)
+        _tag4, self.server_model_version = wire.unpack_hello_tag(tag)
 
     def _sever(self) -> None:
         sock, self._sock = self._sock, None
@@ -303,7 +318,101 @@ class ServeClient:
             )
         if status < 0 or out is None:
             raise ServeRejectedError(f"predict rejected: {status}")
-        return status, out
+        return status, self._strip_version(out)
+
+    def _strip_version(self, out: dict) -> dict:
+        """Pop the per-response version stamp (r19) into
+        ``last_model_version`` — user code sees only its own fields."""
+        ver = out.pop(wire.SRV_VERSION_FIELD, None)
+        if ver is not None:
+            self.last_model_version = int(np.asarray(ver).reshape(()))
+        return out
+
+    def _decode_status_check(self, status: int) -> None:
+        """The shared decode-wire error mapping (every status a replica
+        can answer on the DECODE ops gets its typed client error)."""
+        hint_ms = wire.retry_after_ms(status)
+        if hint_ms is not None:
+            raise ServeOverloadError(
+                f"replica {self._host}:{self._port} shed the decode op "
+                f"(retry after {hint_ms}ms)", retry_after_s=hint_ms / 1e3,
+            )
+        if status == NO_MODEL:
+            raise ServeUnavailableError(
+                f"replica {self._host}:{self._port} has no model yet"
+            )
+        if status == NO_DECODER:
+            raise ServeRejectedError(
+                f"replica {self._host}:{self._port} serves no decode path "
+                "(predict-only model)"
+            )
+        if status == BAD_SESSION:
+            raise ServeSessionError(
+                f"replica {self._host}:{self._port} does not know this "
+                "decode session (expired, or lost to a restart) — re-open"
+            )
+        if status < 0:
+            raise ServeRejectedError(f"decode op rejected: {status}")
+
+    def decode_open(self, prompt, max_new_tokens: int) -> int:
+        """Open one stepped-decode session (greedy continuation of
+        ``prompt``, a 1-D int32 token array); returns the session id.
+        A transport replay can orphan a server-side session — the
+        replica's idle sweep reclaims it, so replay stays safe."""
+        bufs = wire.encode_batch({"prompt": np.asarray(prompt, np.int32)})
+        status, _ = self.call(
+            SRV_DECODE_OPEN, a=int(max_new_tokens), payload_bufs=bufs,
+        )
+        self._decode_status_check(status)
+        return status
+
+    def decode_next(self, session: int, cursor: int = 0):
+        """Poll a session's token stream from ``cursor`` (tokens already
+        received): ``(tokens, done, model_step)``.  Cursor-addressed, so
+        replaying the poll after a reconnect re-reads instead of
+        double-draining."""
+        status, out = self.call(
+            SRV_DECODE_NEXT, a=int(session), b=int(cursor), batch=True,
+        )
+        self._decode_status_check(status)
+        out = self._strip_version(out)
+        return (
+            np.asarray(out["tokens"], np.int32).reshape(-1),
+            bool(np.asarray(out["done"]).reshape(-1)[0]),
+            status,
+        )
+
+    def decode_close(self, session: int) -> None:
+        """Release a session server-side (idempotent)."""
+        self.call(SRV_DECODE_CLOSE, a=int(session))
+
+    def generate(
+        self, prompt, max_new_tokens: int, *, poll_s: float = 0.005,
+        deadline_s: float = 120.0,
+    ) -> np.ndarray:
+        """Convenience client for the whole stream: open, poll the token
+        stream to completion, close; returns the generated int32 tokens
+        (the continuation only — the prompt is not echoed)."""
+        sid = self.decode_open(prompt, max_new_tokens)
+        tokens: list[int] = []
+        try:
+            t_end = time.monotonic() + deadline_s
+            while True:
+                got, done, _step = self.decode_next(sid, cursor=len(tokens))
+                tokens.extend(int(t) for t in got)
+                if done:
+                    return np.asarray(tokens, np.int32)
+                if time.monotonic() >= t_end:
+                    raise ServeDeadlineError(
+                        f"decode session {sid} incomplete after "
+                        f"{deadline_s:.0f}s ({len(tokens)} tokens)"
+                    )
+                time.sleep(poll_s)
+        finally:
+            try:
+                self.decode_close(sid)
+            except ServeError:
+                pass  # best-effort release; the idle sweep is the backstop
 
     def stats(self) -> dict:
         status, raw = self.call(SRV_STATS)
@@ -347,8 +456,21 @@ class ServePool:
         n = len(self.addrs)
         self._clients: list[ServeClient | None] = [None] * n
         self._eject_until = [0.0] * n
+        # Per-replica served registry version (r19): learned from the
+        # HELLO version word at dial and refreshed per response; None =
+        # not yet dialed.  The canary lane keys off it.
+        self._ver: list[int | None] = [None] * n
         self._rr = 0
         self._lock = threading.Lock()
+        # Canary routing (r19): (version, weight) — that fraction of
+        # picks routes to replicas serving ``version``, the rest to the
+        # stable lane.  None = plain round-robin.
+        self._canary: tuple[int, float] | None = None
+        self._canary_acc = 0.0
+        # Per-version accounting (r19): ok/error counts + a latency ring
+        # per served version — the promote-or-rollback evidence
+        # (serve.deploy.canary_verdict consumes version_stats()).
+        self._vstats: dict[int, dict] = {}
         # Shared retry discipline (r18): every cross-replica retry spends
         # this budget — a pool cannot convert one overload into an
         # unbounded rotation storm.
@@ -357,16 +479,63 @@ class ServePool:
         self.ejections = 0
         self.overload_backoffs = 0
         self.last_replica = -1
+        self.last_version = -1
+
+    def set_canary(self, version: int, weight: float) -> None:
+        """Route ``weight`` (0..1) of picks to replicas serving registry
+        ``version`` (the canary lane), the rest to everything else (the
+        stable lane).  A lane with no live replica falls back to plain
+        rotation — a canary that dies degrades to stable service, it
+        never blackholes the weighted fraction."""
+        if not 0.0 <= weight <= 1.0:
+            raise ValueError(f"canary weight must be in [0, 1], got {weight}")
+        with self._lock:
+            self._canary = (int(version), float(weight))
+            self._canary_acc = 0.0
+        faults.log_event(
+            "serve_canary_set", role=self.role, version=int(version),
+            weight=round(float(weight), 3),
+        )
+
+    def clear_canary(self) -> None:
+        with self._lock:
+            self._canary = None
+
+    def _rr_pick_locked(self, now: float, lane=None) -> int | None:
+        """Round-robin over un-ejected replicas (optionally restricted to
+        a lane of indices); caller holds the lock."""
+        for k in range(len(self.addrs)):
+            i = (self._rr + k) % len(self.addrs)
+            if now >= self._eject_until[i] and (lane is None or i in lane):
+                self._rr = i + 1
+                return i
+        return None
 
     def _pick(self) -> int | None:
         with self._lock:
             now = time.monotonic()
-            for k in range(len(self.addrs)):
-                i = (self._rr + k) % len(self.addrs)
-                if now >= self._eject_until[i]:
-                    self._rr = i + 1
-                    return i
-            return None  # every replica currently benched
+            if self._canary is not None:
+                cver, weight = self._canary
+                live = [
+                    i for i in range(len(self.addrs))
+                    if now >= self._eject_until[i]
+                ]
+                c_lane = {i for i in live if self._ver[i] == cver}
+                s_lane = {i for i in live if self._ver[i] != cver}
+                if c_lane and s_lane:
+                    # Deterministic weighted split: the accumulator hands
+                    # exactly ``weight`` of picks to the canary lane over
+                    # any window (no RNG to decorrelate in tests).
+                    self._canary_acc += weight
+                    if self._canary_acc >= 1.0:
+                        self._canary_acc -= 1.0
+                        lane = c_lane
+                    else:
+                        lane = s_lane
+                    got = self._rr_pick_locked(now, lane)
+                    if got is not None:
+                        return got
+            return self._rr_pick_locked(now)  # plain rotation / fallback
 
     def _eject(self, i: int, for_s: float) -> None:
         with self._lock:
@@ -395,10 +564,63 @@ class ServePool:
             # and shares the winner's client.
             if self._clients[i] is None:
                 self._clients[i] = c
+                if i < len(self._ver):
+                    self._ver[i] = c.server_model_version
                 return c
             winner = self._clients[i]
         c.close()
         return winner
+
+    # -- per-version accounting (r19) ----------------------------------------
+
+    def _record_version(
+        self, i: int, version: int | None, ok: bool, dt_s: float = 0.0,
+    ) -> None:
+        with self._lock:
+            if version is None:
+                # An errored attempt: charge the replica's last-known
+                # version (-1 when it was never learned).
+                known = self._ver[i] if 0 <= i < len(self._ver) else None
+                ver = -1 if known is None else int(known)
+            else:
+                ver = int(version)
+                if 0 <= i < len(self._ver):
+                    self._ver[i] = ver
+            st = self._vstats.get(ver)
+            if st is None:
+                st = self._vstats[ver] = {
+                    "ok": 0, "err": 0, "lat": LatencyRecorder(),
+                }
+            if ok:
+                st["ok"] += 1
+                st["lat"].record(dt_s)
+            else:
+                st["err"] += 1
+        if ok and version is not None:
+            self.last_version = ver
+
+    def version_stats(self) -> dict[int, dict]:
+        """Per served-version accounting: ``{version: {ok, err,
+        latency percentiles/qps}}`` (version -1 = attempts whose replica's
+        version was never learned) — the canary-vs-stable evidence a
+        promote-or-rollback decision reads (serve.deploy.canary_verdict)."""
+        with self._lock:
+            items = list(self._vstats.items())
+        out: dict[int, dict] = {}
+        for ver, st in items:
+            row = {"ok": st["ok"], "err": st["err"]}
+            for k, v in st["lat"].percentile_scalars("v").items():
+                row[k.split("/", 1)[1]] = v
+            out[ver] = row
+        return out
+
+    def known_versions(self) -> dict[str, int | None]:
+        """Last-known served version per replica address (None = never
+        dialed)."""
+        with self._lock:
+            return {
+                f"{h}:{p}": v for (h, p), v in zip(self.addrs, self._ver)
+            }
 
     def predict(
         self, inputs: dict, *, deadline_s: float | None = None,
@@ -439,8 +661,20 @@ class ServePool:
                     )
             first = False
             try:
-                got = self._client(i).predict(inputs)
+                c = self._client(i)
+                t0 = time.perf_counter()
+                got = c.predict(inputs)
                 self.last_replica = i
+                # The response's version stamp (r19) — fall back to the
+                # HELLO word against a pre-stamp replica.
+                ver = (
+                    c.last_model_version
+                    if c.last_model_version >= 0
+                    else c.server_model_version
+                )
+                self._record_version(
+                    i, ver, ok=True, dt_s=time.perf_counter() - t0
+                )
                 self._budget.on_success()
                 return got
             except ServeRejectedError:
@@ -459,6 +693,7 @@ class ServePool:
                 # rate across N overloaded replicas is amplification, not
                 # load balancing.
                 last_err = e
+                self._record_version(i, None, ok=False)
                 hint_s = getattr(e, "retry_after_s", 0.0)
                 self._eject(i, max(min(self._eject_s, 0.25), hint_s))
                 # Only a genuine SHED answer counts toward the pool-wide-
@@ -483,6 +718,7 @@ class ServePool:
             except (ServeError, OSError, ConnectionError) as e:
                 last_err = e
                 sheds_in_row = 0  # a transport fault, not a shed answer
+                self._record_version(i, None, ok=False)
                 self._eject(i, self._eject_s)
                 faults.log_event(
                     "serve_replica_ejected", role=self.role, replica=i,
@@ -509,6 +745,7 @@ class ServePool:
                 return
             keep_clients = dict(zip(self.addrs, self._clients))
             keep_eject = dict(zip(self.addrs, self._eject_until))
+            keep_ver = dict(zip(self.addrs, self._ver))
             stale = [
                 c
                 for a, c in keep_clients.items()
@@ -517,6 +754,7 @@ class ServePool:
             self.addrs = addrs
             self._clients = [keep_clients.get(a) for a in addrs]
             self._eject_until = [keep_eject.get(a, 0.0) for a in addrs]
+            self._ver = [keep_ver.get(a) for a in addrs]
             self._rr %= len(addrs)
         for c in stale:
             try:

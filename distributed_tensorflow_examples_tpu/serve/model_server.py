@@ -66,6 +66,9 @@ SRV_HELLO = wire.SRV_OPS["HELLO"]
 SRV_PREDICT = wire.SRV_OPS["PREDICT"]
 SRV_STATS = wire.SRV_OPS["STATS"]
 SRV_SHUTDOWN = wire.SRV_OPS["SHUTDOWN"]
+SRV_DECODE_OPEN = wire.SRV_OPS["DECODE_OPEN"]
+SRV_DECODE_NEXT = wire.SRV_OPS["DECODE_NEXT"]
+SRV_DECODE_CLOSE = wire.SRV_OPS["DECODE_CLOSE"]
 
 #: Ops excluded from the request counter — derived from the one
 #: control-plane registry (wire.CONTROL_OPS; dtxlint pins this site).
@@ -79,6 +82,8 @@ _SRV_CONTROL_OPS = frozenset(
 ERR = wire.SRV_STATUS["ERR"]
 OVERLOAD = wire.SRV_STATUS["OVERLOAD"]
 NO_MODEL = wire.SRV_STATUS["NO_MODEL"]
+BAD_SESSION = wire.SRV_STATUS["BAD_SESSION"]
+NO_DECODER = wire.SRV_STATUS["NO_DECODER"]
 
 
 def flat_param_spec(init_fn):
@@ -94,6 +99,105 @@ def flat_param_spec(init_fn):
     return ps_shard.flat_param_spec(template)
 
 
+class _DecodeEngine:
+    """Stepped KV-cache decode behind the sequence-slot batcher (r19).
+
+    Model-agnostic: the model supplies ``init_cache_fn(slots, max_len)``
+    (a per-slot cache pytree) and ``step_fn(params, cache, tokens[S],
+    pos[S]) -> (logits [S, V], cache)`` — one jitted apply advances EVERY
+    active session one position.  The engine owns the host-side slot
+    state (current token and position per slot), greedy next-token
+    selection and prompt teacher-forcing, so batched decode is
+    byte-identical to a session running alone: the slot array shape is
+    FIXED (inactive slots compute inert rows, like the row batcher's pad
+    rows), every row's math depends only on its own slot, and the
+    attention mask confines each session to the cache positions it wrote
+    itself — a freed slot needs no cache reset.
+    """
+
+    def __init__(
+        self, model_getter, init_cache_fn, step_fn, *, slots: int,
+        max_len: int, max_sessions: int,
+    ):
+        import jax
+
+        self._get_model = model_getter  # () -> (step, params) | None
+        self._cache = init_cache_fn(slots, max_len)
+        self._step_jit = jax.jit(step_fn)
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self._tokens = np.zeros((self.slots,), np.int32)
+        self._pos = np.zeros((self.slots,), np.int32)
+        self.batcher = batcher_lib.SlotBatcher(
+            self._run_step, slots=self.slots, max_sessions=max_sessions,
+        )
+
+    def open(self, prompt: np.ndarray, max_new_tokens: int):
+        """Admit one greedy decode session; returns its StreamTicket.
+        Raises ValueError on a prompt/budget the cache cannot hold, and
+        ``batcher.Overloaded`` past the session bound."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        n = int(max_new_tokens)
+        if prompt.size < 1:
+            raise ValueError("decode needs a non-empty prompt")
+        if n < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {n}")
+        if prompt.size + n > self.max_len:
+            raise ValueError(
+                f"{prompt.size} prompt + {n} new tokens exceeds the "
+                f"replica's decode_max_len={self.max_len}"
+            )
+        return self.batcher.open(
+            {"prompt": prompt, "n": n, "emitted": 0, "seated": False}
+        )
+
+    def _run_step(self, slots):
+        import jax.numpy as jnp
+
+        model = self._get_model()
+        if model is None:
+            raise _NoModel()
+        _step, params = model
+        for i, t in enumerate(slots):
+            if t is not None and not t.state["seated"]:
+                # A freshly seated session starts its slot at position 0
+                # feeding its first prompt token; the cache needs no
+                # reset (see the class docstring).
+                t.state["seated"] = True
+                self._tokens[i] = t.state["prompt"][0]
+                self._pos[i] = 0
+        logits, self._cache = self._step_jit(
+            params, self._cache,
+            jnp.asarray(self._tokens), jnp.asarray(self._pos),
+        )
+        out = np.asarray(logits)
+        results: list = [None] * len(slots)
+        for i, t in enumerate(slots):
+            if t is None:
+                continue
+            st = t.state
+            p = int(self._pos[i])
+            if p + 1 < len(st["prompt"]):
+                nxt = int(st["prompt"][p + 1])  # teacher-force the prompt
+                emits: list[int] = []
+            else:
+                nxt = int(np.argmax(out[i]))  # greedy continuation
+                emits = [nxt]
+                st["emitted"] += 1
+            self._tokens[i] = nxt
+            self._pos[i] = p + 1
+            results[i] = (emits, st["emitted"] >= st["n"])
+        return results
+
+    def stats(self) -> dict:
+        s = self.batcher.stats()
+        s["max_len"] = self.max_len
+        return s
+
+    def stop(self) -> None:
+        self.batcher.stop()
+
+
 class ModelReplicaServer:
     """One serving replica: PS-tracking model + micro-batched predict.
 
@@ -104,10 +208,26 @@ class ModelReplicaServer:
                       depend only on inputs row i) — that is what makes
                       padded batching exact and the scatter well-defined.
     ``ps_addrs``      the shard servers in shard order (``--ps_hosts``).
+                      May be EMPTY in pin mode (a registry-only replica
+                      needs no PS at all — membership then stays off).
     ``max_batch`` / ``max_wait_ms`` / ``queue_depth``
                       the micro-batcher knobs (serve/batcher.py).
     ``refresh_ms``    param-poll cadence; each poll is O(header) per shard
                       while the published step is unchanged.
+
+    Registry pin mode (r19): with ``registry_dir`` + ``model_version``
+    the replica serves an IMMUTABLE registry snapshot instead of
+    hot-tracking the PS — the version loads once at construction, a
+    lease-style PIN protects it from registry GC for the replica's
+    lifetime (renewed by the refresher thread), and ``model_version``
+    stamps the HELLO answer, every predict/decode response and STATS, so
+    pools can route and account per version (canary vs stable).
+
+    Decode serving (r19): ``decode_fns=(init_cache_fn, step_fn)`` adds
+    the stepped KV-cache decode path — stateful sessions behind the
+    sequence-slot batcher, streamed token responses over the
+    DECODE_OPEN/NEXT/CLOSE wire (``serve.ServeClient.generate`` is the
+    client side).
     """
 
     def __init__(
@@ -121,10 +241,16 @@ class ModelReplicaServer:
         advertise_addr: str | None = None, ps_replicas: int = 1,
         layout_version: int = 0, follow_reshard: bool = True,
         handler_workers: int = 8, queue_deadline_ms: float = 0.0,
+        registry_dir: str | None = None, model_name: str = "default",
+        model_version: int | None = None, pin_ttl_s: float = 30.0,
+        decode_fns: tuple | None = None, decode_slots: int = 4,
+        decode_max_len: int = 512, decode_max_sessions: int = 64,
+        session_idle_s: float = 60.0,
     ):
         import jax
 
         from ..parallel import reshard
+        from . import registry as registry_lib
 
         total, self._unflatten = flat_param_spec(init_fn)
         self._predict = jax.jit(predict_fn)
@@ -133,26 +259,53 @@ class ModelReplicaServer:
         )
         self._op_timeout_s = op_timeout_s
         self._reconnect_deadline_s = reconnect_deadline_s
-        self._group = ps_shard.ShardedPSClients(
-            list(ps_addrs), role=self.role, op_timeout_s=op_timeout_s,
-            reconnect_deadline_s=reconnect_deadline_s,
-            replicas=ps_replicas, layout_version=layout_version,
+        # Registry pin (r19): a pinned replica serves one immutable
+        # version for its whole lifetime; version 0 means hot-tracking.
+        self.model_version = int(model_version or 0)
+        self.model_name = model_name
+        self._registry = (
+            registry_lib.ModelRegistry(registry_dir) if registry_dir else None
         )
-        self._layout = self._group.layout_for(total)
-        self._pstore = ps_shard.ShardedParamStore(
-            self._group, "params", self._layout
-        )
+        self._pinned = self._registry is not None and self.model_version > 0
+        if self.model_version > 0 and self._registry is None:
+            raise ValueError(
+                f"model_version={self.model_version} needs a registry_dir "
+                "to load it from"
+            )
+        self._pin_ttl_s = max(5.0, float(pin_ttl_s))
+        self._next_pin_renew = 0.0
+        ps_addrs = list(ps_addrs or [])
+        if not ps_addrs and not self._pinned:
+            raise ValueError(
+                "a hot-tracking replica needs ps_addrs (only a registry-"
+                "pinned replica can run PS-free)"
+            )
+        if ps_addrs:
+            self._group = ps_shard.ShardedPSClients(
+                ps_addrs, role=self.role, op_timeout_s=op_timeout_s,
+                reconnect_deadline_s=reconnect_deadline_s,
+                replicas=ps_replicas, layout_version=layout_version,
+            )
+            self._layout = self._group.layout_for(total)
+            self._pstore = ps_shard.ShardedParamStore(
+                self._group, "params", self._layout
+            )
+        else:
+            self._group = self._layout = self._pstore = None
+            membership = False
         # Live resharding (r15): the refresher polls the coordinator for a
         # committed layout epoch (O(header) while unchanged) and swaps its
         # whole PS-side onto the new topology — a replica keeps
-        # hot-tracking through an N→M reshard with zero restarts.
+        # hot-tracking through an N→M reshard with zero restarts.  A
+        # PINNED replica never follows: its params come from the registry,
+        # and its PS legs (when present) serve membership only.
         self._reshards = 0
         self._follower = (
             reshard.EpochFollower(
                 self._group.coordinator, layout_version,
                 max(0.5, refresh_ms / 1e3),
             )
-            if follow_reshard
+            if follow_reshard and self._group is not None and not self._pinned
             else None
         )
         self.max_batch = int(max_batch)
@@ -162,6 +315,20 @@ class ModelReplicaServer:
         # (the store's contract), so a batch holding the previous tuple is
         # never torn by the swap.
         self._model: tuple[int, object] | None = None
+        if self._pinned:
+            # Pin mode: the version loads ONCE, here — a replica that
+            # cannot load its pinned version must fail its construction
+            # loudly (the deploy controller's signal to not route to it),
+            # never come up serving NO_MODEL forever.
+            step, flat, _manifest = self._registry.load(
+                self.model_name, self.model_version
+            )
+            self._model = (int(step), jax.device_put(self._unflatten(flat)))
+            self._registry.pin(
+                self.model_name, self.model_version, self.role,
+                ttl_s=self._pin_ttl_s,
+            )
+            self._next_pin_renew = time.monotonic() + self._pin_ttl_s / 3
         self._incarnation = int.from_bytes(os.urandom(4), "little") | 1
         self._lock = threading.Lock()
         # The wedged-apply backstop (the 120 s bound the old blocking
@@ -185,6 +352,23 @@ class ModelReplicaServer:
             self._run_batch, max_batch=max_batch, max_wait_ms=max_wait_ms,
             queue_depth=queue_depth,
         )
+        # Decode serving (r19): stateful sessions behind the sequence-slot
+        # batcher.  Session ids are handed to clients as the DECODE_OPEN
+        # status; the table maps them to stream tickets, and the refresher
+        # sweeps sessions nobody polled for ``session_idle_s``.
+        self._engine = (
+            _DecodeEngine(
+                lambda: self._model, decode_fns[0], decode_fns[1],
+                slots=decode_slots, max_len=decode_max_len,
+                max_sessions=decode_max_sessions,
+            )
+            if decode_fns is not None
+            else None
+        )
+        self._session_idle_s = float(session_idle_s)
+        self._sessions: dict[int, list] = {}  # sid -> [ticket, last_poll]
+        self._next_sid = 1
+        self._decode_opens = 0
         self._stop = threading.Event()
         self.shutdown_requested = threading.Event()
         # The shared server runtime (r17): selector-driven I/O, bounded
@@ -216,6 +400,13 @@ class ModelReplicaServer:
                 queue_deadline_ms / 1e3 if queue_deadline_ms else None
             ),
             retry_after_ms=self._retry_after_ms,
+            # The msrv HELLO version word (r19): a dialing pool learns the
+            # served registry version (0 = hot-tracking) at connect, before
+            # routing a single predict — canary-weighted routing's
+            # discovery half.
+            hello_extra=lambda: wire.HELLO_VERSION_TAIL.pack(
+                self.model_version
+            ),
         ))
         self._core.start()
         self.port = self._core.port
@@ -239,10 +430,15 @@ class ModelReplicaServer:
         )
         self._refresher.start()
         log.info(
-            "model replica %s serving on port %d (%d PS shard(s), "
-            "max_batch=%d, incarnation %d)",
-            self.role, self.port, self._group.num_shards, self.max_batch,
-            self._incarnation,
+            "model replica %s serving on port %d (%s, max_batch=%d, "
+            "incarnation %d)",
+            self.role, self.port,
+            (
+                f"pinned {self.model_name}/v{self.model_version}"
+                if self._pinned
+                else f"{self._group.num_shards} PS shard(s)"
+            ),
+            self.max_batch, self._incarnation,
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -286,9 +482,21 @@ class ModelReplicaServer:
         self._core.stop()
         self._refresher.join(timeout=5.0)
         self._batcher.stop()
+        if self._engine is not None:
+            self._engine.stop()
+        if self._pinned:
+            # Release the registry pin LAST: GC must not reclaim the
+            # served version while in-flight work could still touch it.
+            try:
+                self._registry.unpin(
+                    self.model_name, self.model_version, self.role
+                )
+            except Exception:  # noqa: BLE001 — unpin is best-effort cleanup
+                log.warning("registry unpin failed", exc_info=True)
         if self._writer is not None:
             self._writer.close()
-        self._group.close()
+        if self._group is not None:
+            self._group.close()
 
     # -- the param refresher (hot-tracking thread) ---------------------------
 
@@ -349,11 +557,50 @@ class ModelReplicaServer:
                 f"{self._ticket_deadline_s:.0f}s (batch thread wedged?)"
             ))
 
+    def _sweep_idle_sessions(self) -> None:
+        """Cancel decode sessions nobody polled for ``session_idle_s`` —
+        an abandoned client (crash, lost interest) must not hold a slot
+        or its emission buffer forever.  DECODE_CLOSE is the polite path;
+        this is the backstop."""
+        if self._engine is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            stale = [
+                sid for sid, (_t, last) in self._sessions.items()
+                if now - last > self._session_idle_s
+            ]
+            tickets = [self._sessions.pop(sid)[0] for sid in stale]
+        for t in tickets:
+            t.cancel()
+
     def _refresh_loop(self) -> None:
         from ..parallel import ps_service
 
         while not self._stop.is_set():
             self._sweep_stuck_tickets()
+            self._sweep_idle_sessions()
+            if self._pinned:
+                # Pin mode: no PS polling — the refresher's job is the
+                # lease-style pin renewal (plus the sweeps above), so
+                # registry GC can never reclaim a version this live
+                # replica serves.
+                now = time.monotonic()
+                if now >= self._next_pin_renew:
+                    self._next_pin_renew = now + self._pin_ttl_s / 3
+                    try:
+                        self._registry.pin(
+                            self.model_name, self.model_version, self.role,
+                            ttl_s=self._pin_ttl_s,
+                        )
+                    except Exception:  # noqa: BLE001 — retried next renew
+                        self._refresh_errors += 1
+                        faults.log_event(
+                            "serve_pin_renew_failed", role=self.role,
+                            version=self.model_version,
+                        )
+                self._stop.wait(max(self._refresh_s, 0.25))
+                continue
             if self._follower is not None:
                 rec = self._follower.poll()
                 if rec is not None:
@@ -438,6 +685,13 @@ class ModelReplicaServer:
                 "role": self.role,
                 "incarnation": self._incarnation,
                 "model_step": self.model_step,
+                # The served registry version (r19): 0 = hot-tracking the
+                # live run; > 0 = pinned to an immutable registry snapshot
+                # (same stamp the HELLO word and every predict response
+                # carry — dtxtop's per-version rollup keys off this).
+                "model_version": self.model_version,
+                "model_name": self.model_name,
+                "pinned": self._pinned,
                 # The uniform runtime-accounting shape (r17): requests /
                 # live_conns come from the shared server core, same
                 # meaning on every service's STATS answer; the r18 shed
@@ -452,14 +706,22 @@ class ModelReplicaServer:
                 "overloads": self._overloads,
                 "refreshes": self._refreshes,
                 "refresh_errors": self._refresh_errors,
-                "ps_shards": self._group.num_shards,
-                "layout_epoch": self._layout.version,
+                "ps_shards": (
+                    self._group.num_shards if self._group is not None else 0
+                ),
+                "layout_epoch": (
+                    self._layout.version if self._layout is not None else 0
+                ),
                 "reshards_followed": self._reshards,
+                "decode_sessions_open": len(self._sessions),
+                "decode_opens": self._decode_opens,
                 "leased": bool(
                     self._heartbeat is not None and self._heartbeat.enabled
                 ),
             }
         s.update({f"batcher_{k}": v for k, v in b.items()})
+        if self._engine is not None:
+            s.update({f"decode_{k}": v for k, v in self._engine.stats().items()})
         s.update(self.latency.percentile_scalars("serve"))
         # The replica process's client-side instruments ride along (r13):
         # its PS legs' reconnect/failover counters are the externally
@@ -484,12 +746,85 @@ class ModelReplicaServer:
             except (ValueError, TypeError, KeyError):
                 return ERR, None
             return self._handle_predict(conn, inputs, t0)
+        if op == SRV_DECODE_OPEN:
+            return self._handle_decode_open(a, payload)
+        if op == SRV_DECODE_NEXT:
+            return self._handle_decode_next(a, b)
+        if op == SRV_DECODE_CLOSE:
+            return self._handle_decode_close(a)
         if op == SRV_STATS:
             return 0, [json.dumps(self.stats()).encode()]
         if op == SRV_SHUTDOWN:
             self.shutdown_requested.set()
             return 0, None
         return ERR, None
+
+    # -- decode sessions (r19) ----------------------------------------------
+
+    def _stamp(self, out: dict) -> dict:
+        """Every predict/decode response batch carries the served registry
+        version next to its model_step (the status) — the per-response
+        half of version observability (wire.SRV_VERSION_FIELD; clients
+        strip it before handing outputs to the caller)."""
+        out = dict(out)
+        out[wire.SRV_VERSION_FIELD] = np.int64(self.model_version)
+        return out
+
+    def _handle_decode_open(self, max_new_tokens: int, payload):
+        if self._engine is None:
+            return NO_DECODER, None
+        if self._model is None:
+            return NO_MODEL, None
+        try:
+            inputs = wire.decode_batch_bytes(payload)
+            prompt = np.asarray(inputs["prompt"])
+        except (ValueError, TypeError, KeyError):
+            return ERR, None
+        try:
+            ticket = self._engine.open(prompt, max_new_tokens)
+        except ValueError:
+            return ERR, None
+        except batcher_lib.Overloaded:
+            with self._lock:
+                self._overloads += 1
+            return wire.retry_later_status(self._retry_after_ms), None
+        with self._lock:
+            sid = self._next_sid
+            self._next_sid += 1
+            self._sessions[sid] = [ticket, time.monotonic()]
+            self._decode_opens += 1
+        return sid, None
+
+    def _handle_decode_next(self, sid: int, cursor: int):
+        with self._lock:
+            entry = self._sessions.get(sid)
+            if entry is not None:
+                entry[1] = time.monotonic()
+        if entry is None:
+            return BAD_SESSION, None
+        ticket = entry[0]
+        try:
+            tokens, done = ticket.snapshot(cursor)
+        except _NoModel:
+            return NO_MODEL, None
+        except Exception:  # noqa: BLE001 — a failed step answers loudly
+            log.error("decode session %d failed server-side", sid,
+                      exc_info=True)
+            with self._lock:
+                self._sessions.pop(sid, None)
+            return ERR, None
+        out = self._stamp({
+            "tokens": np.asarray(tokens, np.int32),
+            "done": np.asarray([1 if done else 0], np.uint8),
+        })
+        return self.model_step, wire.encode_batch(out)
+
+    def _handle_decode_close(self, sid: int):
+        with self._lock:
+            entry = self._sessions.pop(sid, None)
+        if entry is not None:
+            entry[0].cancel()
+        return 0, None  # idempotent: closing an unknown session is a no-op
 
     def _handle_predict(self, conn, inputs: dict, t0: float):
         if not inputs:
@@ -548,7 +883,7 @@ class ModelReplicaServer:
                 # wedging the connection in_flight forever.  reply()
                 # normalizes its buffers before queuing anything, so
                 # the ERR after a failed attempt is the first frame.
-                conn.reply(step, wire.encode_batch(out))
+                conn.reply(step, wire.encode_batch(self._stamp(out)))
             except Exception:
                 log.error(
                     "predict reply failed (unserializable output?)",
@@ -592,13 +927,21 @@ def host_serve_task(
     membership: bool = True, lease_ttl_s: float = 10.0,
     advertise_addr: str | None = None, ps_replicas: int = 1,
     layout_version: int = 0, queue_deadline_ms: float = 0.0,
+    registry_dir: str | None = None, model_name: str = "default",
+    model_version: int | None = None, decode_fns: tuple | None = None,
+    decode_slots: int = 4, decode_max_len: int = 512,
 ) -> int:
     """Dedicated serve-task body (``--job_name=serve``): host one replica
     until a client signals SRV_SHUTDOWN (or the supervisor dies).  Arms
     ``die`` fault specs off the replica's request counter — the
     deterministic "kill replica i at request N" fault the serving recovery
     tests inject; a supervisor restart re-pulls the current params from the
-    PS and rejoins the rotation with zero coordination."""
+    PS and rejoins the rotation with zero coordination.  With
+    ``registry_dir`` + ``model_version`` (``--registry_dir`` /
+    ``--serve_model_version``) the replica PINS that registry version
+    instead of hot-tracking — a supervised restart re-loads the SAME
+    version, so a rolling deploy's replica set keeps its meaning through
+    kills."""
     server = ModelReplicaServer(
         init_fn, predict_fn, ps_addrs, port=port,
         loopback_only=loopback_only, max_batch=max_batch,
@@ -608,6 +951,9 @@ def host_serve_task(
         membership=membership, lease_ttl_s=lease_ttl_s,
         advertise_addr=advertise_addr, ps_replicas=ps_replicas,
         layout_version=layout_version, queue_deadline_ms=queue_deadline_ms,
+        registry_dir=registry_dir, model_name=model_name,
+        model_version=model_version, decode_fns=decode_fns,
+        decode_slots=decode_slots, decode_max_len=decode_max_len,
     )
     faults.arm_process_faults(
         request_count_fn=server.request_count,

@@ -457,7 +457,15 @@ def run_ps_cluster_task(
                     f"no PS service at {sh}:{sp} after 120 s (the serve "
                     "replica pulls its params from there)"
                 )
+        # Registry pin mode (r19): --registry_dir + --serve_model_version
+        # serve an immutable registry version instead of hot-tracking;
+        # the PS legs stay up for membership leases, so rolling deploys
+        # ride the same discovery as the elastic pool.
         bound = serve_pkg.host_serve_task(
+            registry_dir=getattr(FLAGS, "registry_dir", "") or None,
+            model_version=(
+                int(getattr(FLAGS, "serve_model_version", 0) or 0) or None
+            ),
             init_fn=init_fn,
             predict_fn=predict_fn,
             # Full replica-major list (r15): the replica's PS legs get the

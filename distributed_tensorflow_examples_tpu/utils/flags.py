@@ -40,6 +40,16 @@ def define_training_flags(default_batch_size: int = 128, default_steps: int = 10
     _define("string", "data_dir", None, "Dataset directory (synthetic if absent).")
     _define("string", "log_dir", None, "Checkpoints + metrics directory.")
     _define("float", "learning_rate", 0.01, "Base learning rate.")
+    _define(
+        "integer",
+        "warmup_steps",
+        0,
+        "Linear learning-rate warmup from 0 to --learning_rate over this "
+        "many optimizer updates (0 = none).  Training-quality knob for "
+        "workloads whose early gradients are outsized relative to the "
+        "init scale — the async cifar10 path defaults it on (see the "
+        "example) so stale first applies cannot collapse the relu stack.",
+    )
     _define("integer", "seed", 0, "Global RNG seed (determinism knob).")
     _define(
         "integer", "log_every_steps", 100, "Metric logging cadence (LoggingTensorHook analog)."
@@ -301,6 +311,31 @@ def define_legacy_cluster_flags():
         "Serving replicas: parameter-store poll cadence.  Each poll is one "
         "O(header) round trip per shard while the published step is "
         "unchanged (PSTORE_GET_IF_NEWER), so tight cadences stay cheap.",
+    )
+    _define(
+        "string",
+        "registry_dir",
+        "",
+        "Model registry root (r19, serve/registry.py): a directory of "
+        "immutable (model_name, version) flat-param snapshots with "
+        "fsync'd atomic manifests and lease-style pins.  Training CLIs "
+        "PUBLISH their final params here as a new version; a "
+        "--job_name=serve replica given --serve_model_version PINS one "
+        "version from here instead of hot-tracking the PS (registry GC "
+        "never deletes a version a live replica has pinned).  Empty = no "
+        "registry (the pre-r19 hot-tracking-only serve plane).",
+    )
+    _define(
+        "integer",
+        "serve_model_version",
+        0,
+        "Serving replicas (r19): pin this registry version from "
+        "--registry_dir and serve it IMMUTABLY — the version stamps the "
+        "msrv HELLO word, every predict/decode response and STATS, so "
+        "pools route and account per version (canary vs stable) and "
+        "rolling deploys flip a live pool with zero failed requests.  0 "
+        "= hot-track the live training run off the PS (the r10 "
+        "behavior).",
     )
     _define(
         "bool",

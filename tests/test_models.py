@@ -47,14 +47,16 @@ def test_cnn_shapes_and_loss_falls(mesh8):
         models.cnn.loss_fn(cfg), opt, mesh=mesh8, state_shardings=sh
     )
     losses = []
-    for _ in range(30):
+    for _ in range(45):
         state, m = step(state, as_global(next(it), mesh8))
         losses.append(float(m["loss"]))
-    # Zero-init logits start the loss exactly at ln(10); any drop below it is
-    # real learning (the old 0.8x-relative gate only measured the decay of an
-    # inflated glorot-logits init).  Average the tail: single-batch losses
-    # are noisy at this scale.
-    assert abs(losses[0] - 2.3026) < 1e-3, losses[0]
+    # The small-stddev (1/fan_in) softmax init starts the loss NEAR ln(10)
+    # — tiny-but-nonzero logits, so every layer below gets gradients from
+    # step 1 (the r19 convergence fix; a glorot-scale head would start at
+    # ~4.6 and its ~50x first gradients collapse the relu stack).  Any
+    # drop below the plateau is real learning.  Average the tail:
+    # single-batch losses are noisy at this scale.
+    assert abs(losses[0] - 2.3026) < 0.05, losses[0]
     assert sum(losses[-10:]) / 10 < 2.27, losses[-10:]
 
 

@@ -818,3 +818,232 @@ def test_perf_gate_concurrent_p99_ratio_rule():
         baseline = json.load(f)
     assert baseline["detail"]["concurrency"]["p99_ratio"] is not None
     assert pg.gate(baseline, baseline, **kw) == []
+
+
+# ----------------------------------------------------------------------------
+# SlotBatcher: the sequence-slot mode (r19)
+# ----------------------------------------------------------------------------
+
+
+def test_slot_batcher_advances_sessions_and_frees_slots():
+    """Variable-length sessions share a fixed slot width: a finished
+    session frees its slot for a QUEUED one mid-flight, and every
+    session's emission stream is cursor-replayable."""
+
+    def run_step(slots):
+        out = [None] * len(slots)
+        for i, t in enumerate(slots):
+            if t is None:
+                continue
+            st = t.state
+            st["count"] = st.get("count", 0) + 1
+            out[i] = ([st["count"]], st["count"] >= st["n"])
+        return out
+
+    b = batcher_lib.SlotBatcher(run_step, slots=2, max_sessions=3)
+    try:
+        t1 = b.open({"n": 3})
+        t2 = b.open({"n": 1})
+        t3 = b.open({"n": 2})  # queued: both slots busy
+        with pytest.raises(batcher_lib.Overloaded):
+            b.open({"n": 1})  # admission bound
+        deadline = time.monotonic() + 10
+        while not (t1.done and t2.done and t3.done):
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        assert t1.snapshot() == ([1, 2, 3], True)
+        assert t2.snapshot() == ([1], True)
+        assert t3.snapshot() == ([1, 2], True)
+        # Cursor addressing: a replayed poll re-reads, never re-drains.
+        assert t3.snapshot(1) == ([2], True)
+        assert t3.snapshot(1) == ([2], True)
+        s = b.stats()
+        assert s["sessions"] == 3 and s["overloads"] == 1
+        assert s["slots_active"] == 0
+    finally:
+        b.stop()
+
+
+def test_slot_batcher_step_error_fails_active_sessions_only():
+    fail = threading.Event()
+
+    def run_step(slots):
+        if fail.is_set():
+            raise ValueError("bad step")
+        return [
+            (["x"], True) if t is not None else None for t in slots
+        ]
+
+    b = batcher_lib.SlotBatcher(run_step, slots=1)
+    try:
+        ok = b.open({})
+        deadline = time.monotonic() + 10
+        while not ok.done:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        assert ok.snapshot() == (["x"], True)
+        fail.set()
+        bad = b.open({})
+        while not bad.done:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        with pytest.raises(ValueError, match="bad step"):
+            bad.snapshot()
+        # The batcher survived: a later session succeeds again.
+        fail.clear()
+        again = b.open({})
+        while not again.done:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        assert again.snapshot() == (["x"], True)
+        assert b.stats()["step_errors"] == 1
+    finally:
+        b.stop()
+
+
+# ----------------------------------------------------------------------------
+# Decode sessions over the wire (r19)
+# ----------------------------------------------------------------------------
+
+
+def _toy_decode_fns(vocab: int = 11):
+    """next token = (token + 1) mod vocab — deterministic, stateless in
+    the cache (which just counts steps), so expectations are exact."""
+    import jax
+    import jax.numpy as jnp
+
+    def init_cache_fn(slots, max_len):
+        return jnp.zeros((slots,), jnp.int32)
+
+    def step_fn(params, cache, tokens, pos):
+        return jax.nn.one_hot((tokens + 1) % vocab, vocab), cache + 1
+
+    return init_cache_fn, step_fn
+
+
+def _pinned_decode_server(tmp_path, role, **kw):
+    from distributed_tensorflow_examples_tpu.serve.registry import (
+        ModelRegistry,
+    )
+
+    reg = ModelRegistry(str(tmp_path))
+    if not reg.versions("default"):
+        reg.publish("default", np.zeros(D * 4 + 4, np.float32), step=7)
+    return serve.ModelReplicaServer(
+        _init_fn, _predict_fn, [], registry_dir=str(tmp_path),
+        model_version=1, role=role, decode_fns=_toy_decode_fns(),
+        decode_slots=2, decode_max_len=32, **kw,
+    )
+
+
+def test_decode_stream_end_to_end_and_session_errors(tmp_path):
+    srv = _pinned_decode_server(tmp_path, "dec0")
+    try:
+        c = serve.ServeClient("127.0.0.1", srv.port, role="dec_sv")
+        out = c.generate(np.array([3, 4, 5], np.int32), 5)
+        assert out.tolist() == [6, 7, 8, 9, 10]
+        # Stamps ride the decode wire too.
+        assert c.last_model_version == 1
+        # Cursor replay at the op level: the same poll twice returns the
+        # same suffix (a reconnect replay cannot double-drain).
+        sid = c.decode_open(np.array([1], np.int32), 3)
+        deadline = time.monotonic() + 10
+        while True:
+            toks, done, step = c.decode_next(sid, cursor=0)
+            if done:
+                break
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        assert toks.tolist() == [2, 3, 4] and step == 7
+        toks2, done2, _ = c.decode_next(sid, cursor=1)
+        assert toks2.tolist() == [3, 4] and done2
+        c.decode_close(sid)
+        c.decode_close(sid)  # idempotent
+        # Unknown session: the typed error, immediately.
+        with pytest.raises(serve.ServeSessionError):
+            c.decode_next(99999)
+        # Bad budget: rejected, not a hang.
+        with pytest.raises(serve.ServeRejectedError):
+            c.decode_open(np.array([1], np.int32), 10_000)
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_decode_concurrent_sessions_byte_identical_to_solo(tmp_path):
+    """The sequence-slot contract (the decode analog of the padded-apply
+    r10 contract): a session's token stream is identical whether it ran
+    alone or coalesced with concurrent sessions of OTHER lengths."""
+    srv = _pinned_decode_server(tmp_path, "dec1")
+    try:
+        solo = serve.ServeClient("127.0.0.1", srv.port, role="solo_sv")
+        prompt = np.array([2, 9], np.int32)
+        ref = solo.generate(prompt, 6)
+        prompts = [prompt, np.array([5], np.int32),
+                   np.array([1, 2, 3, 4], np.int32), np.array([8], np.int32)]
+        outs: list = [None] * 4
+
+        def body(i):
+            ci = serve.ServeClient("127.0.0.1", srv.port, role=f"dc{i}_sv")
+            outs[i] = ci.generate(prompts[i], 6)
+            ci.close()
+
+        ts = [threading.Thread(target=body, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert all(o is not None for o in outs)
+        assert np.array_equal(outs[0], ref)
+        # Sessions genuinely interleaved through 2 slots.
+        st = solo.stats()
+        assert st["decode_sessions"] >= 5 and st["decode_steps"] > 0
+        solo.close()
+    finally:
+        srv.stop()
+
+
+def test_predict_only_replica_answers_no_decoder(tmp_path):
+    from distributed_tensorflow_examples_tpu.serve.registry import (
+        ModelRegistry,
+    )
+
+    ModelRegistry(str(tmp_path)).publish(
+        "default", np.zeros(D * 4 + 4, np.float32), step=1
+    )
+    srv = serve.ModelReplicaServer(
+        _init_fn, _predict_fn, [], registry_dir=str(tmp_path),
+        model_version=1, role="nodec",
+    )
+    try:
+        c = serve.ServeClient("127.0.0.1", srv.port, role="nd_sv")
+        with pytest.raises(serve.ServeRejectedError, match="no decode path"):
+            c.decode_open(np.array([1], np.int32), 2)
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_hot_tracking_replica_stamps_version_zero():
+    """A hot-tracking replica is version 0 on every stamp — the pre-r19
+    wire shape, so mixed pools keep working."""
+    port = ps_service.start_server(0)
+    addrs = [("127.0.0.1", port)]
+    group, _, _ = _publish(addrs, step=0)
+    srv = serve.ModelReplicaServer(
+        _init_fn, _predict_fn, addrs, max_wait_ms=2.0, refresh_ms=10.0,
+        role="srv_v0",
+    )
+    try:
+        assert srv.wait_for_model(30.0)
+        c = serve.ServeClient("127.0.0.1", srv.port, role="v0_sv")
+        assert c.server_model_version == 0
+        c.predict({"x": np.ones((1, D), np.float32)})
+        assert c.last_model_version == 0
+        st = c.stats()
+        assert st["model_version"] == 0 and st["pinned"] is False
+        c.close()
+    finally:
+        srv.stop()
+        group.close()
+        ps_service.stop_server()

@@ -33,6 +33,27 @@ Finding codes:
                                   release leaks it (the exact r14 bug).
 - ``resource-attr-unreleased``    a class-owned resource no method of the
                                   class ever releases.
+
+Registry-manifest check (r19, files named ``registry.py``): the model
+registry's crash-safety contract — a version either exists completely or
+not at all — rests on every manifest write being ATOMIC AND DURABLE
+(tmp handle closed on all exits, ``os.fsync`` before ``os.replace``,
+and publish paths routing through the one compliant writer).  Codes:
+
+- ``registry-manifest-unfsynced``  a function ``json.dump``s a manifest
+                                   without both ``os.fsync`` and
+                                   ``os.replace`` — a crash can leave a
+                                   torn or non-durable manifest.
+- ``registry-manifest-unguarded``  an ``open()`` in the registry whose
+                                   handle is neither ``with``-managed nor
+                                   closed in a ``finally`` — an exception
+                                   mid-write leaks the handle (and on
+                                   some platforms blocks the rename).
+- ``registry-manifest-unrouted``   a ``publish``-named function that
+                                   neither is a compliant writer nor
+                                   (transitively, through module-local
+                                   calls) reaches one — a new publish
+                                   path skipped the atomic writer.
 """
 
 from __future__ import annotations
@@ -289,6 +310,142 @@ def _lint_class_attrs(
             ))
 
 
+# ----------------------------------------------------------------------------
+# Registry-manifest pass (r19): atomic+durable manifest writes
+# ----------------------------------------------------------------------------
+
+
+def _call_tails_in(func: ast.AST) -> set[str]:
+    """Last components of every call made in ``func`` (not descending
+    into nested defs)."""
+    out: set[str] = set()
+    for sub in _walk_skip_defs(func):
+        if isinstance(sub, ast.Call):
+            t = _call_tail(sub)
+            if t:
+                out.add(t)
+    return out
+
+
+def _open_calls_unguarded(func: ast.AST) -> list[int]:
+    """Line numbers of ``open()`` calls whose handle is neither
+    ``with``-managed nor (when assigned to a local) closed inside a
+    ``finally:`` suite."""
+    fin = _finally_nodes(func)
+    owned: set[int] = set()  # ids of open() Call nodes with an owner shape
+    assigned: dict[str, int] = {}  # var -> open() lineno
+    for sub in _walk_skip_defs(func):
+        if isinstance(sub, (ast.With, ast.AsyncWith)):
+            for item in sub.items:
+                if (
+                    isinstance(item.context_expr, ast.Call)
+                    and _call_tail(item.context_expr) == "open"
+                ):
+                    owned.add(id(item.context_expr))
+        elif isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                isinstance(sub.targets[0], ast.Name) and \
+                isinstance(sub.value, ast.Call) and \
+                _call_tail(sub.value) == "open":
+            owned.add(id(sub.value))
+            assigned[sub.targets[0].id] = sub.lineno
+    out: list[int] = []
+    for sub in _walk_skip_defs(func):
+        if isinstance(sub, ast.Call) and _call_tail(sub) == "open" and \
+                id(sub) not in owned:
+            out.append(sub.lineno)  # nothing owns the handle at all
+    for var, line in assigned.items():
+        # Both close shapes count: ``f.close()`` (file objects) and
+        # ``os.close(fd)`` (raw descriptors from ``os.open``).
+        closed_in_finally = any(
+            isinstance(sub, ast.Call)
+            and id(sub) in fin
+            and (
+                (
+                    isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "close"
+                    and isinstance(sub.func.value, ast.Name)
+                    and sub.func.value.id == var
+                )
+                or (
+                    _call_tail(sub) == "close"
+                    and any(
+                        isinstance(a, ast.Name) and a.id == var
+                        for a in sub.args
+                    )
+                )
+            )
+            for sub in _walk_skip_defs(func)
+        )
+        if not closed_in_finally:
+            out.append(line)
+    return sorted(out)
+
+
+def _lint_registry_manifest(
+    tree: ast.Module, rel: str, findings: list[Finding],
+) -> None:
+    """The registry.py manifest-durability rules (module docstring)."""
+    funcs = list(_functions(tree))
+    compliant: set[str] = set()  # names of compliant manifest writers
+    calls: dict[str, set[str]] = {}
+    for func, qual, _cls in funcs:
+        tails = _call_tails_in(func)
+        calls[func.name] = tails
+        unguarded = _open_calls_unguarded(func)
+        for line in unguarded:
+            findings.append(Finding(
+                PASS, "registry-manifest-unguarded", rel,
+                f"{qual}:open@{line}",
+                f"{qual} opens a registry file whose handle is neither "
+                "with-managed nor closed in a finally — an exception "
+                "mid-write leaks it",
+                line=line,
+            ))
+        if "dump" in tails:
+            if "fsync" in tails and "replace" in tails and not unguarded:
+                compliant.add(func.name)
+            else:
+                missing = [v for v in ("fsync", "replace") if v not in tails]
+                if missing or unguarded:
+                    findings.append(Finding(
+                        PASS, "registry-manifest-unfsynced", rel, qual,
+                        f"{qual} writes a manifest (json.dump) without "
+                        + (
+                            f"calling os.{'/os.'.join(missing)}"
+                            if missing
+                            else "a guarded file handle"
+                        )
+                        + " — a crash can leave a torn or non-durable "
+                        "manifest; route through the atomic writer",
+                        line=getattr(func, "lineno", 0),
+                    ))
+    # Publish paths must (transitively) reach a compliant writer.
+    for func, qual, _cls in funcs:
+        if "publish" not in func.name:
+            continue
+        seen: set[str] = set()
+        frontier = [func.name]
+        reaches = False
+        while frontier and not reaches:
+            name = frontier.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            if name in compliant:
+                reaches = True
+                break
+            frontier.extend(calls.get(name, ()))
+        if not reaches:
+            findings.append(Finding(
+                PASS, "registry-manifest-unrouted", rel, qual,
+                f"{qual} is a publish path that never reaches a compliant "
+                "manifest writer (json.dump + os.fsync + os.replace with "
+                "guarded handles) — its version can appear without a "
+                "durable manifest",
+                line=getattr(func, "lineno", 0),
+            ))
+
+
 def run(cfg: LintConfig) -> list[Finding]:
     findings: list[Finding] = []
     files: list[Path] = []
@@ -306,4 +463,6 @@ def run(cfg: LintConfig) -> list[Finding]:
         for func, qual, _cls in _functions(tree):
             _lint_function(func, qual, rel, findings)
         _lint_class_attrs(tree, rel, findings)
+        if path.name == "registry.py":
+            _lint_registry_manifest(tree, rel, findings)
     return findings

@@ -137,6 +137,14 @@ def cluster_roles(
 _SCRAPERS = {"ps": _scrape_ps, "dsvc": _scrape_dsvc, "serve": _scrape_serve}
 
 
+def _serve_by_version(serve_rows: list[dict]) -> dict[int, list[dict]]:
+    """Scraped serve roles grouped by served registry version (r19)."""
+    out: dict[int, list[dict]] = {}
+    for r in serve_rows:
+        out.setdefault(int(r["stats"].get("model_version", 0)), []).append(r)
+    return dict(sorted(out.items()))
+
+
 def scrape_leases(
     ps_addrs, timeout_s: float, *, ps_shards: int = 0, ps_replicas: int = 1,
 ) -> list[dict]:
@@ -348,6 +356,35 @@ def snapshot(
         },
         "serve": {
             "model_steps": [r["stats"]["model_step"] for r in serve_rows],
+            "model_versions": [
+                r["stats"].get("model_version", 0) for r in serve_rows
+            ],
+            # Per-version rollup (r19): the canary-vs-stable read — one
+            # row per served registry version (0 = hot-tracking) with
+            # replica count, summed qps, worst p99 and shed totals, so a
+            # rolling flip's traffic split is visible in one scrape.
+            "by_version": {
+                str(v): {
+                    "replicas": len(rows_v),
+                    "qps": round(sum(
+                        r["stats"].get("serve/qps", 0.0) for r in rows_v
+                    ), 2),
+                    "p99_ms": round(max(
+                        (r["stats"].get("serve/latency_p99_ms", 0.0)
+                         for r in rows_v),
+                        default=0.0,
+                    ), 3),
+                    "sheds": sum(
+                        r["stats"].get("shed_total", 0)
+                        + r["stats"].get("overloads", 0)
+                        for r in rows_v
+                    ),
+                    "predict_rows": sum(
+                        r["stats"].get("predict_rows", 0) for r in rows_v
+                    ),
+                }
+                for v, rows_v in _serve_by_version(serve_rows).items()
+            },
             "predict_rows": sum(
                 r["stats"]["predict_rows"] for r in serve_rows
             ),
@@ -442,6 +479,7 @@ def _fmt_serve_row(r: dict) -> str:
     s = r["stats"]
     return (
         f"{s['requests']:>9} step={s['model_step']:<6} "
+        f"version={s.get('model_version', 0):<4} "
         f"rows={s['predict_rows']:<7} overload={s['overloads']:<4} "
         f"p99={s.get('serve/latency_p99_ms', 0.0):7.2f}ms "
         f"qps={s.get('serve/qps', 0.0):7.1f} "
@@ -493,6 +531,16 @@ def render(snap: dict, prev: dict | None = None) -> str:
         f"(workers={','.join(mem.get('workers', [])) or 'none'} "
         f"serve={','.join(mem.get('serve', [])) or 'none'})"
     )
+    # Per-version serve rollup (r19): rendered whenever any replica is
+    # pinned (a hot-tracking-only pool stays one implicit v0 and needs no
+    # extra line).
+    bv = su["serve"].get("by_version", {})
+    if len(bv) > 1 or any(v != "0" for v in bv):
+        lines.append("serve versions: " + " | ".join(
+            f"v{v}: {d['replicas']}x qps={d['qps']} p99={d['p99_ms']}ms "
+            f"sheds={d['sheds']}"
+            for v, d in bv.items()
+        ))
     rs = su["ps"].get("reshard", {})
     if rs.get("committed") or rs.get("pending"):
         lines.append(

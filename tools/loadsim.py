@@ -114,6 +114,26 @@ OVERLOAD_PHASES = {
     "burst_end": 0.65,
 }
 
+#: Canary-scenario timeline (r19, ``--scenario=canary``), as fractions of
+#: the load window: v1 registry replicas serve from t0; mid-run the
+#: orchestrator publishes v2 (the training run's CURRENT params — the
+#: registry decouples deploys from the live run), spawns ONE canary
+#: replica pinned v2 and routes ``--canary_weight`` of the paced traffic
+#: at it; a stable replica is KILLED during the flip (healed by its
+#: supervisor, re-pinning v1 — a restart cannot change what a replica
+#: serves); then the rolling promote spawns v2 replacements (surge) and
+#: retires every v1 task.  Gates: zero failed predicts through the whole
+#: flip, canary weight honored ±tolerance, the served model_version
+#: monotone across scrapes and all-v2 at the end, both versions visible
+#: to dtxtop's per-version rollup mid-flip.
+CANARY_PHASES = {
+    "publish_v2": 0.18,
+    "canary_up": 0.22,
+    "kill_serve": 0.40,
+    "promote_start": 0.55,
+    "retire_old": 0.72,
+}
+
 
 def free_ports(n: int) -> list[int]:
     socks, ports = [], []
@@ -942,6 +962,341 @@ def run_overload(args) -> int:
     return 0 if verdict["slo_pass"] else 1
 
 
+def run_canary(args) -> int:
+    """The rolling-deploy acceptance scenario (``--scenario=canary``, r19):
+    boot a real multi-process train-and-serve cluster whose serve replicas
+    PIN registry versions (``--registry_dir``/``--serve_model_version``),
+    hold closed-loop predict load, and drive a full stable→canary→promoted
+    version flip WITH a kill/join cycle landing mid-flip:
+
+    - t0: the training run's params publish to the registry as v1; three
+      replicas pin it;
+    - mid-run: the CURRENT params publish as v2, one canary replica pins
+      it (the join), and ``--canary_weight`` of the paced traffic routes
+      at it (``ServePool.set_canary`` over lease-discovered replicas whose
+      versions ride the msrv HELLO word / response stamps);
+    - a stable replica is killed during the flip (supervised restart
+      re-pins v1 — version identity survives the heal);
+    - promote: v2 replacements spawn (surge), then every v1 task retires.
+
+    SLO verdict (``canary_slo``): zero failed predicts across the whole
+    flip, the canary traffic fraction within ``--canary_tol`` of the
+    weight, the served model_version monotone across scrapes and all-v2 at
+    the end, training step advancing, the kill really fired, and dtxtop's
+    per-version rollup showing BOTH versions mid-flip."""
+    import jax  # noqa: F401 — the orchestrator reads PS params itself
+
+    from distributed_tensorflow_examples_tpu import models
+    from distributed_tensorflow_examples_tpu.parallel import ps_shard
+    from distributed_tensorflow_examples_tpu.serve.registry import (
+        ModelRegistry,
+    )
+    from distributed_tensorflow_examples_tpu.utils import faults
+    from tools import dtxtop
+
+    faults.set_role("loadsim")
+    logdir = args.logdir or tempfile.mkdtemp(prefix="dtx-loadsim-cn-")
+    os.makedirs(logdir, exist_ok=True)
+    # Fresh registry per run (versions are immutable — a reused logdir
+    # must not collide with a previous run's v1/v2).
+    registry_dir = tempfile.mkdtemp(prefix="registry-", dir=logdir)
+    n_replicas = max(3, args.serve_replicas)  # the acceptance flips a 3-pool
+    n_ps = args.ps_shards * args.ps_replicas
+    # Serve ports: [0..R) stable v1, [R] the canary, [R+1..2R] the v2
+    # replacements — one --serve_hosts list, task_index selects.
+    ports = free_ports(n_ps + 2 * n_replicas + 1)
+    ps_ports = ports[:n_ps]
+    serve_ports = ports[n_ps:]
+    stable_ports = serve_ports[:n_replicas]
+    canary_port = serve_ports[n_replicas]
+    replacement_ports = serve_ports[n_replicas + 1 : 2 * n_replicas + 1]
+    ps_addrs = [("127.0.0.1", p) for p in ps_ports]
+    t_kill = args.boot_offset_s + CANARY_PHASES["kill_serve"] * args.duration_s
+    plan = "" if args.no_chaos else f"die:role=serve1,after_s={t_kill:.1f}"
+    env = dict(os.environ)
+    env.pop("DTX_FAULT_ROLE", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["DTX_FAULT_PLAN"] = plan
+    procs: dict[str, subprocess.Popen] = {}
+
+    def common(version: int) -> list[str]:
+        return [
+            "--sync_replicas=false",
+            "--batch_size=64",
+            "--train_steps=1000000",  # outlives the window; loadsim tears down
+            "--hidden_units=32",
+            f"--ps_hosts={','.join(f'127.0.0.1:{p}' for p in ps_ports)}",
+            f"--ps_shards={args.ps_shards}",
+            f"--ps_replicas={args.ps_replicas}",
+            f"--worker_hosts={','.join(f'127.0.0.1:{7000 + i}' for i in range(args.workers))}",
+            f"--serve_hosts={','.join(f'127.0.0.1:{p}' for p in serve_ports)}",
+            "--ps_restarts=3",
+            f"--lease_ttl_s={args.lease_ttl_s}",
+            "--log_every_steps=50",
+            f"--registry_dir={registry_dir}",
+            f"--serve_model_version={version}",
+        ]
+
+    def spawn(name: str, job: str, index: int, version: int = 0) -> None:
+        procs[name] = launch_task(
+            args.example, common(version), job, index, logdir, env,
+            log_name=name,
+        )
+
+    verdict: dict = {
+        "schema_version": VERDICT_SCHEMA_VERSION,
+        "metric": "loadsim_canary_slo",  # perf_gate baseline auto-select
+        "qps_target": args.qps,
+        "duration_s": args.duration_s,
+        "p99_bound_ms": args.p99_bound_ms,
+        "canary_weight": args.canary_weight,
+        "canary_tol": args.canary_tol,
+        "replicas": n_replicas,
+        "logdir": logdir,
+        "chaos": not args.no_chaos,
+    }
+    gen = None
+    step_series: list[tuple[float, int]] = []
+    version_series: list[tuple[float, int]] = []
+    both_versions_seen = False
+    scrape_fail = 0
+    final_versions: list[int] = []
+
+    # The orchestrator's own PS-side: pull the live run's params to
+    # publish registry versions from (the same flat vector the chief
+    # publishes — ps_shard is the one layout definition).
+    cfg = models.mlp.Config(hidden=(32,))
+    total, _ = ps_shard.flat_param_spec(
+        models.mlp.init(cfg, __import__("jax").random.key(0))
+    )
+    registry = ModelRegistry(registry_dir)
+    group = None
+
+    def publish_current(version: int) -> int:
+        step, flat = pstore.get()
+        if step < 0:
+            raise RuntimeError("chief has not published params yet")
+        return registry.publish(
+            "default", flat, step=int(step), version=version,
+            source="loadsim canary",
+        )
+
+    try:
+        for i in range(n_ps):
+            spawn(f"ps{i}", "ps", i)
+        if not wait_ps_ready(ps_addrs, args.ready_wait_s):
+            raise RuntimeError(f"PS tasks never came up (logs: {logdir})")
+        spawn("chief0", "chief", 0)
+        for i in range(args.workers):
+            spawn(f"worker{i}", "worker", i)
+        group = ps_shard.ShardedPSClients(
+            ps_addrs[: args.ps_shards], role="loadsim_pub",
+            op_timeout_s=10.0, replicas=1,
+        )
+        pstore = ps_shard.ShardedParamStore(
+            group, "params", group.layout_for(total)
+        )
+        t_pub = time.monotonic() + args.ready_wait_s
+        while True:
+            try:
+                if pstore.get()[0] >= 0:
+                    break
+            except Exception:  # noqa: BLE001 — chief still booting
+                pass
+            if time.monotonic() > t_pub:
+                raise RuntimeError("chief never published params to the PS")
+            time.sleep(0.5)
+        publish_current(1)
+        for i in range(n_replicas):
+            spawn(f"serve{i}", "serve", i, version=1)
+        stable_addrs = [("127.0.0.1", p) for p in stable_ports]
+        if not wait_serve_ready(stable_addrs, args.ready_wait_s):
+            raise RuntimeError(
+                f"serve replicas never pinned v1 (logs: {logdir})"
+            )
+
+        gen = LoadGenerator(
+            ps_addrs, stable_addrs, qps=args.qps, threads=args.gen_threads,
+            deadline_s=max(30.0, args.duration_s),
+        )
+        gen.start()
+        t0 = time.monotonic()
+        t_end = t0 + args.duration_s
+        markers = {
+            name: t0 + frac * args.duration_s
+            for name, frac in CANARY_PHASES.items()
+        }
+        published_v2 = canary_spawned = promoted = retired = False
+        canary_window_base: dict | None = None
+        canary_routed_t: float | None = None
+        # The window extends (bounded) until the flip COMPLETES: on a
+        # slow box the boot/evidence waits may push the retire past the
+        # nominal duration, and a verdict for half a flip proves nothing.
+        while time.monotonic() < t_end or (
+            not retired and time.monotonic() < t_end + 90.0
+        ):
+            now = time.monotonic()
+            if not published_v2 and now >= markers["publish_v2"]:
+                published_v2 = True
+                publish_current(2)  # the flip artifact: CURRENT params
+                faults.log_event("loadsim_canary_published", version=2)
+            if not canary_spawned and now >= markers["canary_up"]:
+                canary_spawned = True
+                spawn("serve_canary", "serve", n_replicas, version=2)
+                if wait_serve_ready(
+                    [("127.0.0.1", canary_port)], args.ready_wait_s
+                ):
+                    # The weighted split is measured from the moment the
+                    # POOL actually routes the canary (lease discovery +
+                    # the HELLO version word), not from the spawn — the
+                    # replica's boot must not eat the evidence window.
+                    t_disc = time.monotonic() + 20.0
+                    while time.monotonic() < t_disc and 2 not in (
+                        gen.pool.known_versions().values()
+                    ):
+                        time.sleep(0.3)
+                    gen.pool.set_canary(2, args.canary_weight)
+                    canary_window_base = gen.pool.version_stats()
+                    canary_routed_t = time.monotonic()
+                    faults.log_event("loadsim_canary_routed")
+            if not promoted and now >= markers["promote_start"] and (
+                canary_routed_t is None
+                or now >= canary_routed_t + args.canary_window_s
+            ):
+                promoted = True
+                # Canary verdict window closes here: measure the honored
+                # traffic split before the promote changes the lanes.
+                if canary_window_base is not None:
+                    vs = gen.pool.version_stats()
+                    d_can = (
+                        vs.get(2, {}).get("ok", 0)
+                        - canary_window_base.get(2, {}).get("ok", 0)
+                    )
+                    d_tot = sum(
+                        row.get("ok", 0) for row in vs.values()
+                    ) - sum(
+                        row.get("ok", 0)
+                        for row in canary_window_base.values()
+                    )
+                    verdict["canary_ok"] = d_can
+                    verdict["canary_window_ok"] = d_tot
+                    verdict["canary_frac"] = (
+                        round(d_can / d_tot, 4) if d_tot else -1.0
+                    )
+                gen.pool.clear_canary()
+                for i in range(n_replicas):
+                    spawn(
+                        f"serve_v2_{i}", "serve", n_replicas + 1 + i,
+                        version=2,
+                    )
+                faults.log_event("loadsim_promote_spawned", replicas=n_replicas)
+            if promoted and not retired and now >= markers["retire_old"]:
+                # SURGE ordering: the v1 tier retires only once every v2
+                # replacement is model-loaded and routable — capacity
+                # never dips below the pool size mid-flip.
+                if wait_serve_ready(
+                    [("127.0.0.1", p) for p in replacement_ports],
+                    args.ready_wait_s,
+                ):
+                    retired = True
+                    for i in range(n_replicas):
+                        p = procs.get(f"serve{i}")
+                        if p is not None and p.poll() is None:
+                            p.send_signal(signal.SIGTERM)
+                    faults.log_event(
+                        "loadsim_old_retired", replicas=n_replicas
+                    )
+            try:
+                snap = dtxtop.snapshot(
+                    ps_addrs, ps_shards=args.ps_shards,
+                    ps_replicas=args.ps_replicas, timeout_s=3.0,
+                )
+                su = snap["summary"]["serve"]
+                steps = su["model_steps"]
+                step_series.append(
+                    (time.monotonic(), max(steps) if steps else -1)
+                )
+                versions = [v for v in su.get("model_versions", []) if v > 0]
+                version_series.append(
+                    (time.monotonic(), max(versions) if versions else -1)
+                )
+                bv = su.get("by_version", {})
+                if {"1", "2"} <= set(bv):
+                    both_versions_seen = True
+                final_versions = versions
+                verdict["members_last"] = snap["summary"]["members"]
+            except Exception:  # noqa: BLE001 — mid-flip scrapes may miss
+                scrape_fail += 1
+            time.sleep(1.0)
+        verdict["window_s"] = round(time.monotonic() - t0, 1)
+    finally:
+        load = gen.stop() if gen is not None else {
+            "predict_ok": 0, "predict_failed": -1, "errors": ["never ran"],
+            "p50_ms": 0.0, "p90_ms": 0.0, "p99_ms": 0.0,
+        }
+        if group is not None:
+            group.close()
+        for name, p in procs.items():
+            if p.poll() is None:
+                p.send_signal(
+                    signal.SIGTERM
+                    if name.startswith(("ps", "serve"))
+                    else signal.SIGKILL
+                )
+        deadline = time.monotonic() + 15.0
+        for p in procs.values():
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+            getattr(p, "_dtx_logf").close()
+
+    window = verdict.get("window_s") or args.duration_s
+    verdict.update(load)
+    verdict["qps_achieved"] = round(load["predict_ok"] / window, 2)
+    verdict["scrape_failures"] = scrape_fail
+    verdict.update(analyze_steps(step_series, {"flip": 0.0}))
+    versions = [v for _, v in version_series if v >= 0]
+    verdict["version_first"] = versions[0] if versions else -1
+    verdict["version_last"] = versions[-1] if versions else -1
+    verdict["version_monotone"] = all(
+        b >= a for a, b in zip(versions, versions[1:])
+    )
+    verdict["final_versions"] = final_versions
+    verdict["both_versions_observed"] = both_versions_seen
+    verdict["kill_fired"] = _fired_in(
+        procs.get("serve1"), "event=inject_die"
+    )
+    frac = verdict.get("canary_frac", -1.0)
+    gates = {
+        "zero_failed_predicts": load["predict_failed"] == 0,
+        "p99_under_bound": 0.0 < load["p99_ms"] <= args.p99_bound_ms,
+        "qps_at_target": verdict["qps_achieved"] >= 0.6 * args.qps,
+        # The flip itself: canary traffic split honored, versions only
+        # ever move forward, and the pool ends fully promoted.
+        "canary_weight_honored": (
+            frac >= 0.0 and abs(frac - args.canary_weight) <= args.canary_tol
+        ),
+        "version_monotone": verdict["version_monotone"],
+        "flip_completed": bool(final_versions) and all(
+            v == 2 for v in final_versions
+        ),
+        "both_versions_observed": both_versions_seen,
+        "step_monotone": verdict["step_monotone"],
+        "step_advanced": verdict["step_advanced"],
+    }
+    if not args.no_chaos:
+        gates["kill_fired"] = verdict["kill_fired"]
+    verdict["gates"] = gates
+    verdict["slo_pass"] = all(gates.values())
+    verdict["loadsim_p99_ms"] = load["p99_ms"]
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(verdict, f, indent=2)
+    print(json.dumps(verdict))
+    return 0 if verdict["slo_pass"] else 1
+
+
 def run_burst_child(args) -> int:
     """Internal (``--scenario=burst_child``): one burst-client process of
     the overload scenario — ``--gen_threads`` unpaced closed-loop clients
@@ -999,13 +1354,33 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--scenario",
-        choices=("chaos", "reshard", "overload", "burst_child"),
+        choices=("chaos", "reshard", "overload", "canary", "burst_child"),
         default="chaos",
         help="chaos = the r14 kill/join/leave cycle; reshard = the r15 "
         "live N->N+1->N PS resizing under load (one worker kill); "
         "overload = the r18 graceful-degradation burst (admission "
-        "control, deadline propagation, retry budgets); burst_child is "
+        "control, deadline propagation, retry budgets); canary = the r19 "
+        "rolling registry-version flip (stable->canary->promoted with a "
+        "kill/join cycle mid-flip, zero failed predicts, canary weight "
+        "honored); burst_child is "
         "internal (one spawned burst-client process of the overload run)",
+    )
+    ap.add_argument(
+        "--canary_weight", type=float, default=0.4,
+        help="canary scenario: fraction of paced traffic routed at the "
+        "canary replica while both lanes are live (deliberately NOT the "
+        "plain round-robin share, so an ignored weight fails the gate)",
+    )
+    ap.add_argument(
+        "--canary_tol", type=float, default=0.12,
+        help="canary scenario: allowed |achieved - weight| on the canary "
+        "traffic fraction",
+    )
+    ap.add_argument(
+        "--canary_window_s", type=float, default=10.0,
+        help="canary scenario: minimum seconds of weighted-routing "
+        "evidence before the promote may start (the flip must not "
+        "outrun its own canary measurement on a slow box)",
     )
     ap.add_argument(
         "--reshard_bound_s", type=float, default=30.0,
@@ -1088,6 +1463,8 @@ def main(argv=None) -> int:
         return run_reshard(args)
     if args.scenario == "overload":
         return run_overload(args)
+    if args.scenario == "canary":
+        return run_canary(args)
     if args.scenario == "burst_child":
         return run_burst_child(args)
 

@@ -213,6 +213,23 @@ def steps_plan() -> list[dict]:
              cmd=[PY, "tools/loadsim.py", "--scenario", "overload",
                   "--qps", "100", "--duration_s", "30"],
              timeout=900, cpu_ok=True),
+        # Rolling-deploy acceptance (r19): a 3-replica registry-pinned
+        # serve pool flips stable→canary→promoted under closed-loop load
+        # with a kill/join cycle landing mid-flip — zero failed predicts,
+        # canary weight honored ±tolerance, served model_version monotone
+        # and fully promoted, both versions dtxtop-visible.  JAX-on-CPU,
+        # so cpu_ok; verdict gated against
+        # tools/loadsim_canary_baseline.json by perf_gate (metric
+        # loadsim_canary_slo).
+        # p99 bound: the flip runs ~14 processes (training + 7 serve
+        # tasks + the orchestrator) on whatever the dev box has — the
+        # hard zero-failure/weight/monotonicity gates carry the
+        # acceptance; the latency bound is a loose tail tripwire.
+        dict(name="loadsim_canary",
+             cmd=[PY, "tools/loadsim.py", "--scenario", "canary",
+                  "--qps", "50", "--duration_s", "60",
+                  "--p99_bound_ms", "2500"],
+             timeout=900, cpu_ok=True),
     ]
     return plan
 

@@ -47,6 +47,10 @@ REQUIRED_KEYS = {
         "batcher_batch_rows_p50", "batcher_queue_depth_p99",
         "serve/latency_p99_ms", "registry",
         "shed_total", "queue_deadline_drops",
+        # r19 versioned serving: the per-replica registry version (0 =
+        # hot-tracking) dtxtop's version column and per-version rollup
+        # key off — pinned here so the stamp cannot silently vanish.
+        "model_version",
     ),
 }
 

@@ -90,6 +90,15 @@ BASELINES = {
     # without shedding proves nothing).  Gate-set shrink detection as
     # with the other loadsim verdicts.
     "loadsim_overload_slo": "loadsim_overload_baseline.json",
+    # r19 rolling-deploy acceptance (tools/loadsim.py --scenario=canary):
+    # binary slo_pass over the canary gate set — zero failed predicts
+    # through a full stable→canary→promoted registry-version flip with a
+    # kill/join cycle landing mid-flip, the canary traffic fraction
+    # within tolerance of the routed weight, the served model_version
+    # monotone and all-promoted at the end, and both versions visible to
+    # dtxtop's per-version rollup mid-flip.  Gate-set shrink detection as
+    # with the other loadsim verdicts.
+    "loadsim_canary_slo": "loadsim_canary_baseline.json",
     # r16 static-analysis wall-time budget (tools/dtxlint_step.py): the
     # lint's repo gate runs inside tier-1, so a pass whose cost silently
     # explodes taxes every future test run — the campaign fails first.
