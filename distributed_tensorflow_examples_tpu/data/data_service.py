@@ -70,7 +70,7 @@ from typing import Callable, Iterator, Sequence
 
 import numpy as np
 
-from ..parallel import retry, server_core, wire
+from ..parallel import retry, server_core, tenancy, wire
 from ..utils import faults, telemetry
 from . import filestream
 
@@ -110,6 +110,13 @@ CLAIM_TAKEN = wire.DSVC_STATUS["CLAIM_TAKEN"]
 WAIT = wire.DSVC_STATUS["WAIT"]
 EPOCH_ROLLED = wire.DSVC_STATUS["EPOCH_ROLLED"]
 ERR = wire.DSVC_STATUS["ERR"]
+
+
+def _tenant_of_request(op: int, name: str, a: int, b: int) -> str:
+    """The server core's per-tenant admission attribution (r20): the
+    tenant rides the ``name`` operand as a ``,t=<tenant>`` tag — absent
+    (= the default tenant) on every untagged client's frames."""
+    return tenancy.untag_name(name)[1]
 
 
 class DSVCError(RuntimeError):
@@ -157,6 +164,55 @@ read_batch = wire.read_batch
 # ----------------------------------------------------------------------------
 
 
+class _TenantJob:
+    """One tenant's dispatcher state machine over the SHARED split set
+    (r20 multi-tenancy): each tenant consumes the same splits as its own
+    independent job — its own epoch, pending order, assignments,
+    visitation and liveness tables — so two training runs can draw from
+    one data service without ever seeing each other's assignment state
+    (the tf.data-service sharing argument: input workers exist to be
+    shared across jobs).  The split CONTENT (decode cache, batch bytes)
+    stays shared on the server; only the assignment plane is per-tenant.
+    All fields are guarded by the owning server's ``_lock``."""
+
+    def __init__(self, n_splits: int, order: list[int]):
+        self.epoch = 0
+        self.pending: deque[int] = deque(order)
+        self.assigned: dict[int, tuple[int, float]] = {}  # split -> (worker, t)
+        self.worker_split: dict[int, int] = {}  # worker -> unacked split
+        self.completed: set[int] = set()
+        self.visits = {i: 0 for i in range(n_splits)}
+        self.last_seen: dict[int, float] = {}
+        self.stale_members: set[int] = set()
+        self.stale_marked = 0
+        self.batches_served = 0
+        self.splits_completed = 0
+        self.assigned_total = 0
+        self.acks = 0
+        self.reassigned = 0
+        self.epochs_completed = 0
+        self.last_epoch_min_visits = 0
+        self.registered: set[int] = set()
+
+    def counters(self) -> dict:
+        """The per-tenant stats row (caller holds the server lock)."""
+        return {
+            "epoch": self.epoch,
+            "pending": len(self.pending),
+            "assigned": len(self.assigned),
+            "completed": len(self.completed),
+            "registered_workers": len(self.registered),
+            "batches_served": self.batches_served,
+            "splits_completed": self.splits_completed,
+            "assigned_total": self.assigned_total,
+            "acks": self.acks,
+            "reassigned": self.reassigned,
+            "stale_marked": self.stale_marked,
+            "epochs_completed": self.epochs_completed,
+            "last_epoch_min_visits": self.last_epoch_min_visits,
+        }
+
+
 class DataServiceServer:
     """TCP data server on the unified server core (r17): one dispatcher
     state machine registered as a handler on ``parallel/server_core.py``
@@ -200,6 +256,7 @@ class DataServiceServer:
         cache_splits: int = 4,
         info_extra: dict | None = None,
         handler_workers: int = 8,
+        tenant_quotas: dict | None = None,
     ):
         if not splits:
             raise ValueError("data service needs at least one split")
@@ -219,28 +276,18 @@ class DataServiceServer:
         # a reconnect detects a restarted (assignment-state-lost) server.
         self._incarnation = int.from_bytes(os.urandom(4), "little") | 1
         self._lock = threading.Lock()
-        self._epoch = 0
-        self._pending: deque[int] = deque(self._epoch_order(0))
-        self._assigned: dict[int, tuple[int, float]] = {}  # split -> (worker, t)
-        self._worker_split: dict[int, int] = {}  # worker -> unacked split
-        self._completed: set[int] = set()
-        self._visits = {i: 0 for i in range(len(self._splits))}
-        self._last_seen: dict[int, float] = {}
-        # Workers declared departed by the membership layer (r14): their
-        # assigned splits reassign IMMEDIATELY on the next GET_SPLIT
-        # instead of waiting out the liveness window.  Any later op from
-        # the worker clears the mark (it came back; at-least-once absorbs
-        # the duplicate delivery).
-        self._stale_members: set[int] = set()
-        self._stale_marked = 0
-        self._batches_served = 0
-        self._splits_completed = 0
-        self._assigned_total = 0  # assignments handed out (r13 dtxobs)
-        self._acks = 0  # split completions acknowledged (r13 dtxobs)
-        self._reassigned = 0
-        self._epochs_completed = 0
-        self._last_epoch_min_visits = 0
-        self._registered: set[int] = set()
+        # Per-tenant dispatcher jobs (r20): each tenant iterates the SHARED
+        # split set as its own job — own epoch/pending/assignment/liveness
+        # state (including the r14 stale-member marks, so one tenant's
+        # membership churn can never trigger another tenant's
+        # reassignment).  The default job always exists: untagged frames
+        # are the default tenant by construction, and single-tenant
+        # behavior is byte-identical to pre-tenant servers.
+        self._jobs: dict[str, _TenantJob] = {
+            tenancy.DEFAULT_TENANT: _TenantJob(
+                len(self._splits), self._epoch_order(0)
+            ),
+        }
         self._cache: OrderedDict[int, list] = OrderedDict()
         self._cache_cap = max(1, cache_splits)
         self.shutdown_requested = threading.Event()
@@ -250,12 +297,13 @@ class DataServiceServer:
         # dispatcher state machine plus one registered handler.
         self._core = server_core.ServerCore(
             port=port, loopback_only=loopback_only, name="dsvc",
-            workers=handler_workers,
+            workers=handler_workers, tenant_quotas=tenant_quotas,
         )
         self._core.add_service(server_core.Service(
             "dsvc", self._handle,
             control_ops=_DSVC_CONTROL_OPS,
             counts_fn=self._counts_request,
+            tenant_of=_tenant_of_request,
             error_status=ERR,
             # No DSVC request carries a payload: a frame announcing more
             # than this is a corrupt/hostile peer and drops at header
@@ -326,11 +374,13 @@ class DataServiceServer:
             batches.append(encode_batch(self._decode(raw) if self._decode else raw))
         with self._lock:
             self._cache[si] = batches
-            # Capacity adapts to the number of splits concurrently ASSIGNED:
-            # with more active workers than the configured floor, a fixed
-            # cap would thrash — every interleaved GET_BATCH re-decoding a
-            # whole shard to serve one batch.
-            cap = max(self._cache_cap, len(self._assigned) + 1)
+            # Capacity adapts to the number of splits concurrently ASSIGNED
+            # (across every tenant's job — the decode cache is the shared
+            # resource): with more active workers than the configured
+            # floor, a fixed cap would thrash — every interleaved
+            # GET_BATCH re-decoding a whole shard to serve one batch.
+            live = sum(len(j.assigned) for j in self._jobs.values())
+            cap = max(self._cache_cap, live + 1)
             while len(self._cache) > cap:
                 self._cache.popitem(last=False)
         return batches
@@ -338,145 +388,165 @@ class DataServiceServer:
     def _num_batches(self, si: int) -> int:
         return len(self._split_batches(si))
 
-    # -- dispatcher state machine (all under self._lock) ---------------------
+    # -- dispatcher state machine (all under self._lock; one _TenantJob
+    # per tenant — the shared-split multiplexing point, r20) ------------------
 
-    def _ack_locked(self, worker: int, split: int) -> None:
+    def _job_locked(self, tenant: str) -> _TenantJob:
+        """The tenant's dispatcher job, created on first touch (caller
+        holds ``self._lock``).  Every tenant iterates the same split set
+        from epoch 0 with the same deterministic per-epoch order."""
+        j = self._jobs.get(tenant)
+        if j is None:
+            j = self._jobs[tenant] = _TenantJob(
+                len(self._splits), self._epoch_order(0)
+            )
+            log.info("data service: new tenant job %r", tenant)
+        return j
+
+    def _ack_locked(self, j: _TenantJob, worker: int, split: int) -> None:
         """Idempotent completion mark.  Also honors acks a RESTARTED server
         never assigned (the old incarnation did): the split is pulled out of
         pending so visited work is not re-served."""
-        if not (0 <= split < len(self._splits)) or split in self._completed:
+        if not (0 <= split < len(self._splits)) or split in j.completed:
             return
-        holder = self._assigned.get(split)
+        holder = j.assigned.get(split)
         if holder is not None and holder[0] != worker:
             return  # someone else owns it now (post-failover): their ack counts
-        self._assigned.pop(split, None)
-        if self._worker_split.get(worker) == split:
-            del self._worker_split[worker]
+        j.assigned.pop(split, None)
+        if j.worker_split.get(worker) == split:
+            del j.worker_split[worker]
         try:
-            self._pending.remove(split)
+            j.pending.remove(split)
         except ValueError:
             pass
-        self._completed.add(split)
-        self._visits[split] = max(self._visits[split], 1)
-        self._splits_completed += 1
-        self._acks += 1
-        self._maybe_roll_locked()
+        j.completed.add(split)
+        j.visits[split] = max(j.visits[split], 1)
+        j.splits_completed += 1
+        j.acks += 1
+        self._maybe_roll_locked(j)
 
-    def _maybe_roll_locked(self) -> None:
-        if len(self._completed) < len(self._splits):
+    def _maybe_roll_locked(self, j: _TenantJob) -> None:
+        if len(j.completed) < len(self._splits):
             return
-        self._last_epoch_min_visits = min(self._visits.values())
-        self._epochs_completed += 1
-        self._epoch += 1
-        self._completed.clear()
-        self._assigned.clear()
-        self._worker_split.clear()
-        self._visits = {i: 0 for i in range(len(self._splits))}
-        self._pending = deque(self._epoch_order(self._epoch))
-        log.info("data service: epoch rolled to %d", self._epoch)
+        j.last_epoch_min_visits = min(j.visits.values())
+        j.epochs_completed += 1
+        j.epoch += 1
+        j.completed.clear()
+        j.assigned.clear()
+        j.worker_split.clear()
+        j.visits = {i: 0 for i in range(len(self._splits))}
+        j.pending = deque(self._epoch_order(j.epoch))
+        log.info("data service: epoch rolled to %d", j.epoch)
 
-    def _assign_locked(self, worker: int, split: int) -> None:
-        self._assigned[split] = (worker, time.monotonic())
-        self._worker_split[worker] = split
-        self._visits[split] += 1
-        self._assigned_total += 1
+    def _assign_locked(self, j: _TenantJob, worker: int, split: int) -> None:
+        j.assigned[split] = (worker, time.monotonic())
+        j.worker_split[worker] = split
+        j.visits[split] += 1
+        j.assigned_total += 1
 
     def _handle_get_split(
-        self, worker: int, ack: int, client_epoch: int | None, strict: bool
+        self, tenant: str, worker: int, ack: int,
+        client_epoch: int | None, strict: bool,
     ):
         now = time.monotonic()
         with self._lock:
-            self._last_seen[worker] = now
-            self._stale_members.discard(worker)  # it's back: unmark
-            if ack >= 0 and (client_epoch is None or client_epoch == self._epoch):
+            j = self._job_locked(tenant)
+            j.last_seen[worker] = now
+            j.stale_members.discard(worker)  # it's back: unmark
+            if ack >= 0 and (client_epoch is None or client_epoch == j.epoch):
                 # Epoch-tagged acks: an ack for a split assigned in a
                 # PREVIOUS epoch (a worker that stalled past reassignment
                 # while the epoch rolled) must not mark the NEW epoch's
                 # pending copy completed with zero deliveries — ignoring it
                 # re-serves the split instead (at-least-once preserved).
-                self._ack_locked(worker, ack)
-            if strict and client_epoch != self._epoch:
-                return EPOCH_ROLLED, {"epoch": self._epoch}
+                self._ack_locked(j, worker, ack)
+            if strict and client_epoch != j.epoch:
+                return EPOCH_ROLLED, {"epoch": j.epoch}
             # Replay safety: an unacked assignment is re-answered, so a
             # response lost mid-drop cannot strand a split on this worker.
-            held = self._worker_split.get(worker)
-            if held is not None and held not in self._completed:
-                return held, {"epoch": self._epoch, "num_batches": None, "split": held}
-            if self._pending:
-                s = self._pending.popleft()
-                self._assign_locked(worker, s)
-                return s, {"epoch": self._epoch, "num_batches": None, "split": s}
+            held = j.worker_split.get(worker)
+            if held is not None and held not in j.completed:
+                return held, {"epoch": j.epoch, "num_batches": None, "split": held}
+            if j.pending:
+                s = j.pending.popleft()
+                self._assign_locked(j, worker, s)
+                return s, {"epoch": j.epoch, "num_batches": None, "split": s}
             # Nothing pending: reassign only a STALE assignee's split (a
             # lost worker must not wedge the epoch); otherwise wait.  A
             # worker the membership layer declared departed (expired
             # lease, r14) is stale IMMEDIATELY — the elastic leave path
-            # skips the liveness window entirely.
-            for s, (w, t0) in self._assigned.items():
-                if w in self._stale_members or now - max(
-                    self._last_seen.get(w, 0.0), t0
+            # skips the liveness window entirely.  Staleness is scoped to
+            # THIS tenant's job: another tenant's membership churn cannot
+            # reassign here (r20 isolation).
+            for s, (w, t0) in j.assigned.items():
+                if w in j.stale_members or now - max(
+                    j.last_seen.get(w, 0.0), t0
                 ) > self._reassign_after_s:
-                    if self._worker_split.get(w) == s:
+                    if j.worker_split.get(w) == s:
                         # The stale worker no longer holds it: were it to
                         # come back, its GET_SPLIT must not re-answer s.
-                        del self._worker_split[w]
-                    self._assign_locked(worker, s)
-                    self._reassigned += 1
+                        del j.worker_split[w]
+                    self._assign_locked(j, worker, s)
+                    j.reassigned += 1
                     faults.log_event(
                         "dsvc_reassign", split=s, from_worker=w, to_worker=worker,
                     )
-                    return s, {"epoch": self._epoch, "num_batches": None, "split": s}
-            return WAIT, {"epoch": self._epoch}
+                    return s, {"epoch": j.epoch, "num_batches": None, "split": s}
+            return WAIT, {"epoch": j.epoch}
 
-    def mark_worker_stale(self, worker: int) -> None:
+    def mark_worker_stale(self, worker: int, tenant: str | None = None) -> None:
         """Membership hook (r14): a worker whose lease EXPIRED is departed
         NOW — its assigned splits become reassignable on the next
         GET_SPLIT, without waiting out ``reassign_after_s``.  Idempotent;
-        any later op from the worker clears the mark."""
+        any later op from the worker clears the mark.  ``tenant`` scopes
+        the mark to one tenant's job (r20: a tenant-tagged lease expiry
+        must never reassign another tenant's splits); ``None`` — the
+        pre-tenant signature — marks the worker in every job."""
         with self._lock:
-            if worker not in self._stale_members:
-                self._stale_members.add(worker)
-                self._stale_marked += 1
-        faults.log_event("dsvc_member_stale", worker=worker)
+            jobs = (
+                list(self._jobs.values()) if tenant is None
+                else [self._job_locked(tenant)]
+            )
+            for j in jobs:
+                if worker not in j.stale_members:
+                    j.stale_members.add(worker)
+                    j.stale_marked += 1
+        faults.log_event("dsvc_member_stale", worker=worker, tenant=tenant)
 
-    def _handle_claim(self, worker: int, split: int):
+    def _handle_claim(self, tenant: str, worker: int, split: int):
         with self._lock:
-            self._last_seen[worker] = time.monotonic()
-            self._stale_members.discard(worker)
+            j = self._job_locked(tenant)
+            j.last_seen[worker] = time.monotonic()
+            j.stale_members.discard(worker)
             if not (0 <= split < len(self._splits)):
                 return ERR, {}
-            if split in self._completed:
-                return CLAIM_DONE, {"epoch": self._epoch}
-            holder = self._assigned.get(split)
+            if split in j.completed:
+                return CLAIM_DONE, {"epoch": j.epoch}
+            holder = j.assigned.get(split)
             if holder is not None and holder[0] != worker:
-                return CLAIM_TAKEN, {"epoch": self._epoch}
+                return CLAIM_TAKEN, {"epoch": j.epoch}
             try:
-                self._pending.remove(split)
+                j.pending.remove(split)
             except ValueError:
                 pass
             if holder is None:
-                self._assign_locked(worker, split)
-            return OK, {"epoch": self._epoch, "num_batches": None, "split": split}
+                self._assign_locked(j, worker, split)
+            return OK, {"epoch": j.epoch, "num_batches": None, "split": split}
 
     def stats(self) -> dict:
         with self._lock:
+            # Top-level dispatcher counters are the DEFAULT tenant's job —
+            # the pre-tenant shape every existing consumer (tests, dtxtop,
+            # loadsim verdicts) reads; a single-tenant server reports
+            # exactly what it always did.  The per-tenant breakdown rides
+            # in "tenants" (every job, default included).
             out = {
                 "service": "dsvc",
                 "role": faults.current_role(),
                 "incarnation": self._incarnation,
-                "epoch": self._epoch,
                 "num_splits": len(self._splits),
-                "pending": len(self._pending),
-                "assigned": len(self._assigned),
-                "completed": len(self._completed),
-                "registered_workers": len(self._registered),
-                "batches_served": self._batches_served,
-                "splits_completed": self._splits_completed,
-                "assigned_total": self._assigned_total,
-                "acks": self._acks,
-                "reassigned": self._reassigned,
-                "stale_marked": self._stale_marked,
-                "epochs_completed": self._epochs_completed,
-                "last_epoch_min_visits": self._last_epoch_min_visits,
+                **self._jobs[tenancy.DEFAULT_TENANT].counters(),
+                "tenants": {t: j.counters() for t, j in self._jobs.items()},
             }
         # The uniform runtime-accounting shape (r17): requests/live_conns
         # come from the shared server core, so the counters mean the same
@@ -505,18 +575,24 @@ class DataServiceServer:
     # becomes a LOUD per-op ERR on the client (the core's posture).
 
     def _handle(self, conn, op: int, name: str, a: int, b: int, payload):
+        # The tenant rides the name operand (",t=<tenant>", r20) — absent
+        # on untagged (pre-tenant) clients, which land on the default
+        # tenant's job with byte-identical frames.
+        name, tenant = tenancy.untag_name(name)
         if op == DSVC_REGISTER:
-            if a >= 0:
-                # Negative worker ids are metadata-only probes (source
-                # resolution, tooling): they must not count as training
-                # workers in the dispatcher's liveness/stats tables.
-                with self._lock:
-                    self._registered.add(a)
-                    self._last_seen[a] = time.monotonic()
-                    self._stale_members.discard(a)
+            with self._lock:
+                j = self._job_locked(tenant)
+                if a >= 0:
+                    # Negative worker ids are metadata-only probes (source
+                    # resolution, tooling): they must not count as training
+                    # workers in the dispatcher's liveness/stats tables.
+                    j.registered.add(a)
+                    j.last_seen[a] = time.monotonic()
+                    j.stale_members.discard(a)
+                epoch = j.epoch
             info = {
                 "incarnation": self._incarnation,
-                "epoch": self._epoch,
+                "epoch": epoch,
                 "num_splits": len(self._splits),
                 "batch_size": self._batch,
                 **self._info_extra,
@@ -532,33 +608,37 @@ class DataServiceServer:
                 tail = name[len("epoch="):]
                 strict = tail.endswith(",strict")
                 client_epoch = int(tail[: -len(",strict")] if strict else tail)
-            status, info = self._handle_get_split(a, b, client_epoch, strict)
+            status, info = self._handle_get_split(
+                tenant, a, b, client_epoch, strict
+            )
             if status >= 0 and info.get("num_batches") is None:
                 info["num_batches"] = self._num_batches(status)
             return status, [json.dumps(info).encode()]
         if op == DSVC_CLAIM_SPLIT:
-            status, info = self._handle_claim(a, b)
+            status, info = self._handle_claim(tenant, a, b)
             if status == OK and info.get("num_batches") is None:
                 info["num_batches"] = self._num_batches(b)
             return status, [json.dumps(info).encode()]
         if op == DSVC_GET_BATCH:
             if not (0 <= a < len(self._splits)):
                 return ERR, None
-            if name:
-                with self._lock:
-                    self._last_seen[int(name)] = time.monotonic()
-                    self._stale_members.discard(int(name))
+            with self._lock:
+                j = self._job_locked(tenant)
+                if name:
+                    j.last_seen[int(name)] = time.monotonic()
+                    j.stale_members.discard(int(name))
             batches = self._split_batches(a)
             if b >= len(batches) or b < 0:
                 return END_OF_SPLIT, None
             with self._lock:
-                self._batches_served += 1
+                j.batches_served += 1
             return OK, batches[b]
         if op == DSVC_HEARTBEAT:
             with self._lock:
-                self._last_seen[a] = time.monotonic()
-                self._stale_members.discard(a)
-                epoch = self._epoch
+                j = self._job_locked(tenant)
+                j.last_seen[a] = time.monotonic()
+                j.stale_members.discard(a)
+                epoch = j.epoch
             return epoch, None
         if op == DSVC_STATS:
             return OK, [json.dumps(self.stats()).encode()]
@@ -601,9 +681,17 @@ class DataServiceClient:
         self, host: str, port: int, *, worker_id: int = 0,
         op_timeout_s: float | None = 30.0, reconnect_deadline_s: float = 60.0,
         backoff_s: float = 0.25, role: str | None = None,
+        tenant: str = tenancy.DEFAULT_TENANT,
     ):
         self._host, self._port = host, port
         self.worker_id = worker_id
+        # The tenant every request of this client is tagged with (r20):
+        # the default tenant tags nothing, so a pre-tenant server sees
+        # byte-identical frames.
+        self.tenant = (
+            tenant if tenant == tenancy.DEFAULT_TENANT
+            else tenancy.check_tenant(tenant)
+        )
         self._op_timeout = op_timeout_s
         self._reconnect_deadline = reconnect_deadline_s
         self._backoff = backoff_s
@@ -706,6 +794,13 @@ class DataServiceClient:
         or None when the response carries none."""
         if self._sock is None:
             raise ConnectionError("not connected")
+        # The ONE client-side tagging point (r20): every data-plane op of
+        # a non-default tenant carries its tenant in the name operand.
+        # Never HELLO — the tag is a v5 construct and HELLO is the frame
+        # that discovers the peer's version (same reasoning as the
+        # deadline stamp below).
+        if self.tenant != tenancy.DEFAULT_TENANT and op != DSVC_HELLO:
+            name = tenancy.tag_name(name, self.tenant)
         try:
             eff_deadline = (
                 deadline_s if deadline_s is not None else self._op_timeout
@@ -963,6 +1058,7 @@ class RemoteDatasetSource:
         self, spec: str, *, worker_id: int = 0,
         op_timeout_s: float | None = 30.0, reconnect_deadline_s: float = 60.0,
         role: str | None = None, poll_s: float = 0.05,
+        tenant: str = tenancy.DEFAULT_TENANT,
     ):
         host, port = parse_spec(spec)
         self.spec = spec
@@ -971,6 +1067,7 @@ class RemoteDatasetSource:
         self._client = DataServiceClient(
             host, port, worker_id=worker_id, op_timeout_s=op_timeout_s,
             reconnect_deadline_s=reconnect_deadline_s, role=role,
+            tenant=tenant,
         )
         self._client.on_reincarnation(self._reclaim)
         self._epoch = int(self._client.server_info["epoch"])
@@ -1131,6 +1228,7 @@ class RemoteDatasetSource:
 def serve_from_dir(
     data_dir: str, *, batch_size: int, seed: int = 0, augment: bool = True,
     port: int = 0, loopback_only: bool = True, cache_splits: int = 4,
+    tenant_quotas: dict | None = None,
 ) -> DataServiceServer:
     """A server over a ``shard-*.npz`` directory: last shard held out as the
     eval chunk (same convention as ``streams.resolve_image_source``), the
@@ -1154,6 +1252,7 @@ def serve_from_dir(
         port=port,
         loopback_only=loopback_only,
         cache_splits=cache_splits,
+        tenant_quotas=tenant_quotas,
         # Advertised so consumers can sanity-check their own seed/augment
         # request against what this pipeline actually runs (streams.py
         # warns on mismatch — the server's settings win).
@@ -1166,6 +1265,7 @@ def host_data_service_task(
     loopback_only: bool = True,
     ps_addrs: list[tuple[str, int]] | None = None,
     lease_poll_s: float = 2.0, ps_layout_version: int = 0,
+    tenant_quotas: dict | None = None,
 ) -> int:
     """Dedicated data-service task body (``--job_name=data_service``): host
     the server until a client signals DSVC_SHUTDOWN (or the supervisor
@@ -1182,7 +1282,7 @@ def host_data_service_task(
     dispatcher's own liveness window."""
     server = serve_from_dir(
         data_dir, batch_size=batch_size, seed=seed, port=port,
-        loopback_only=loopback_only,
+        loopback_only=loopback_only, tenant_quotas=tenant_quotas,
     )
     faults.arm_process_faults(
         request_count_fn=server.request_count, leave_fn=server.stop,
@@ -1194,10 +1294,12 @@ def host_data_service_task(
         def _member_left(m: dict) -> None:
             # Worker member ids carry their numeric wid as a trailing
             # index ("worker3"); members without one have no dispatcher
-            # state to reassign.
+            # state to reassign.  The mark is scoped to the departed
+            # member's tenant (r20): one tenant's lease expiry can never
+            # reassign another tenant's splits.
             wid = membership.member_index(m["member"])
             if wid is not None:
-                server.mark_worker_stale(wid)
+                server.mark_worker_stale(wid, tenant=m.get("tenant"))
 
         try:
             # follow_epoch (r15): a live PS reshard moves the lease

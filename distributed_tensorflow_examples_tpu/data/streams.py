@@ -45,6 +45,7 @@ def resolve_image_source(
     seed: int,
     num_classes: int,
     name: str = "dataset",
+    tenant: str = "default",
 ) -> ImageSource:
     if data_dir and data_dir.startswith("dsvc://"):
         from . import data_service
@@ -54,7 +55,9 @@ def resolve_image_source(
         # raw and decoded here like the on-disk branches.  worker_id=-1:
         # a metadata-only probe must never count as a training worker in
         # the dispatcher's liveness tables.
-        probe = data_service.RemoteDatasetSource(data_dir, worker_id=-1)
+        probe = data_service.RemoteDatasetSource(
+            data_dir, worker_id=-1, tenant=tenant
+        )
         try:
             raw_eval = probe.eval_chunk()
             if raw_eval is None:
@@ -117,6 +120,7 @@ def train_iter(
     augment: bool = True,
     worker: int | None = None,
     n_workers: int = 1,
+    tenant: str = "default",
 ) -> Iterator[dict[str, np.ndarray]]:
     """Training batches of ``batch_size`` from the resolved source.
 
@@ -132,7 +136,12 @@ def train_iter(
         # double-buffered prefetch hides the wire under local compute.
         # The SERVER's pipeline settings win over this call's arguments —
         # every mismatch warns, none is silent.
-        remote = data_service.RemoteDatasetSource(src.remote_spec, worker_id=w)
+        # r20: the claim stream runs under the caller's tenant — split
+        # assignment, epoch position and liveness all live in THIS
+        # tenant's dispatcher job on the shared server.
+        remote = data_service.RemoteDatasetSource(
+            src.remote_spec, worker_id=w, tenant=tenant
+        )
         info = remote.server_info
         server_bs = int(info.get("batch_size", batch_size))
         if server_bs != batch_size:

@@ -43,6 +43,7 @@
 #include <sys/uio.h>
 #include <unistd.h>
 
+#include <array>
 #include <atomic>
 #include <chrono>
 #include <cstdint>
@@ -544,9 +545,23 @@ Object* find(Server* s, const std::string& name, uint8_t kind) {
   return &it->second;
 }
 
-void cancel_all(Server* s) {
+// Tenant key prefix (r20, mirror of Python wire.TENANT_KEY_PREFIX): a
+// tenant's objects live under "t.<tenant>.<name>"; bare names are the
+// default tenant.  The server stays one flat key space — tenancy is a
+// naming convention it only consults for the CANCEL_ALL filter and the
+// STATS per-tenant breakdown.
+constexpr char kTenantKeyPrefix[] = "t.";
+
+// Cancel blocked waiters, optionally restricted to keys under `prefix`
+// (the CANCEL_ALL request name, r20): "" cancels the whole space — the
+// pre-tenant wire behavior, and what the default tenant sends — while a
+// "t.<tenant>." prefix confines the wake-and-fail to that tenant's
+// objects, so one tenant's teardown can never disturb another's waiters.
+void cancel_all(Server* s, const std::string& prefix = std::string()) {
   std::lock_guard<std::mutex> lock(s->mu);
   for (auto& kv : s->objects) {
+    if (!prefix.empty() && kv.first.compare(0, prefix.size(), prefix) != 0)
+      continue;
     switch (kv.second.kind) {
       case 'a': acc_cancel(kv.second.handle); break;
       case 't': tq_cancel(kv.second.handle); break;
@@ -1040,13 +1055,37 @@ std::string build_lease_json(Server* s) {
 // pre-r13 counters reachable only object-by-object, folded into one
 // table).  All fields are numeric except the service tag, so no JSON
 // string escaping is ever needed.
+// Tenant attribution of a key (r20): "t.<tenant>.<rest>" with a legal
+// tenant id (1..32 chars of [A-Za-z0-9_-] — the Python-side validation
+// mirrored) names the tenant; any other shape is the default tenant.
+// Charset-checked HERE because the id is emitted into STATS JSON verbatim.
+std::string tenant_of_key(const std::string& key) {
+  const size_t plen = sizeof(kTenantKeyPrefix) - 1;
+  if (key.compare(0, plen, kTenantKeyPrefix) != 0) return "default";
+  const size_t dot = key.find('.', plen);
+  if (dot == std::string::npos || dot == plen || dot - plen > 32 ||
+      dot + 1 >= key.size())
+    return "default";
+  for (size_t i = plen; i < dot; ++i) {
+    const char c = key[i];
+    const bool ok = (c >= 'A' && c <= 'Z') || (c >= 'a' && c <= 'z') ||
+                    (c >= '0' && c <= '9') || c == '_' || c == '-';
+    if (!ok) return "default";
+  }
+  return key.substr(plen, dot - plen);
+}
+
 std::string build_stats_json(Server* s) {
   int64_t acc_ded = 0, acc_drop = 0, gq_ded = 0, gq_drop = 0;
   size_t n_obj = 0;
+  // Per-tenant footprint (r20): {tenant: [objects, leases]} off the key
+  // prefixes — the breakdown dtxtop's tenants section scrapes.
+  std::map<std::string, std::array<int64_t, 2>> tenants;
   {
     std::lock_guard<std::mutex> lock(s->mu);
     n_obj = s->objects.size();
     for (const auto& kv : s->objects) {
+      tenants[tenant_of_key(kv.first)][0]++;
       if (kv.second.kind == 'a') {
         acc_ded += acc_deduped(kv.second.handle);
         acc_drop += acc_dropped(kv.second.handle);
@@ -1061,7 +1100,23 @@ std::string build_stats_json(Server* s) {
     std::lock_guard<std::mutex> lk(s->lease_mu);
     prune_leases_locked(s, std::chrono::steady_clock::now());
     n_leases = static_cast<int64_t>(s->leases.size());
+    for (const auto& kv : s->leases) tenants[tenant_of_key(kv.first)][1]++;
   }
+  std::string tjson = "{";
+  {
+    bool tfirst = true;
+    for (const auto& [t, c] : tenants) {
+      char tb[128];
+      int tn = std::snprintf(
+          tb, sizeof(tb), "%s\"%s\":{\"objects\":%lld,\"leases\":%lld}",
+          tfirst ? "" : ",", t.c_str(), static_cast<long long>(c[0]),
+          static_cast<long long>(c[1]));
+      if (tn > 0 && tn < static_cast<int>(sizeof(tb)))
+        tjson.append(tb, static_cast<size_t>(tn));
+      tfirst = false;
+    }
+  }
+  tjson += "}";
   int64_t rs_pending, rs_committed;
   {
     std::lock_guard<std::mutex> lk(s->reshard_mu);
@@ -1082,7 +1137,7 @@ std::string build_stats_json(Server* s) {
       "\"reshard_pending\":%lld,\"reshard_committed\":%lld,"
       "\"shed_total\":%lld,\"queue_deadline_drops\":%lld,"
       "\"acc_deduped\":%lld,\"acc_dropped\":%lld,"
-      "\"gq_deduped\":%lld,\"gq_dropped\":%lld}",
+      "\"gq_deduped\":%lld,\"gq_dropped\":%lld,\"tenants\":",
       s->shard_id, s->shard_count,
       static_cast<long long>(s->layout_version),
       static_cast<long long>(s->incarnation),
@@ -1112,7 +1167,10 @@ std::string build_stats_json(Server* s) {
       static_cast<long long>(acc_ded), static_cast<long long>(acc_drop),
       static_cast<long long>(gq_ded), static_cast<long long>(gq_drop));
   if (n < 0 || n >= static_cast<int>(sizeof(buf))) return "{}";
-  return std::string(buf, static_cast<size_t>(n));
+  std::string out(buf, static_cast<size_t>(n));
+  out += tjson;
+  out += "}";
+  return out;
 }
 
 // State-mutating ops a replicated server forwards to its peer (param-store
@@ -1588,7 +1646,10 @@ void serve_conn_impl(Server* s, int fd) {
         break;
       }
       case CANCEL_ALL:
-        cancel_all(s);
+        // The request name is a key-prefix filter (r20): "" = the whole
+        // space (pre-tenant clients send exactly that), "t.<tenant>." =
+        // that tenant's objects only.
+        cancel_all(s, name);
         status = 0;
         break;
       case ACC_GET:

@@ -130,6 +130,13 @@ class AsyncPSConfig:
     #: How long a retired old-layout task waits out its remaining client
     #: connections (drain) before exiting anyway.
     reshard_drain_s: float = 20.0
+    #: Multi-tenancy (r20): the tenant this RUN belongs to.  Every PS
+    #: object the run creates lives under the tenant's key namespace and
+    #: every lease it registers is tenant-scoped, so several runs share
+    #: one PS tier without their params, reshards, or membership views
+    #: ever touching.  "default" = the untagged pre-r20 wire posture
+    #: (byte-identical frames).
+    tenant: str = "default"
 
 
 class AsyncPSTrainer:
@@ -562,6 +569,7 @@ class RemotePSChief(AsyncPSTrainer):
             op_timeout_s=cfg.ps_op_timeout_s,
             reconnect_deadline_s=cfg.ps_reconnect_deadline_s,
             wire_dtype=cfg.ps_wire_dtype,
+            tenant=cfg.tenant,
         )
         role = faults.current_role() or "chief0"
         self.ps_replicas = int(ps_replicas)
@@ -980,7 +988,9 @@ class RemotePSChief(AsyncPSTrainer):
         or ``membership_leases`` off)."""
         from . import membership
 
-        return membership.live_members(self._group.coordinator, "worker")
+        return membership.live_members(
+            self._group.coordinator, "worker", tenant=self.cfg.tenant
+        )
 
     def _flat_params(self) -> np.ndarray:
         return np.concatenate(
@@ -1538,6 +1548,7 @@ def remote_worker_loop(
         op_timeout_s=cfg.ps_op_timeout_s,
         reconnect_deadline_s=cfg.ps_reconnect_deadline_s,
         wire_dtype=cfg.ps_wire_dtype,
+        tenant=cfg.tenant,
     )
     template = init_fn(jax.random.key(0))
     total, unflatten = ps_shard.flat_param_spec(template)
@@ -1638,6 +1649,7 @@ def remote_worker_loop(
             ttl_s=cfg.lease_ttl_s, role=role,
             op_timeout_s=cfg.ps_op_timeout_s,
             reconnect_deadline_s=cfg.ps_reconnect_deadline_s,
+            tenant=cfg.tenant,
         )
         # A ``leave`` fault (graceful departure) releases the lease on
         # its way out, so the registry records a departure, not a lapse.

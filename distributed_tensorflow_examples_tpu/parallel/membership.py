@@ -45,7 +45,7 @@ import threading
 import time
 
 from ..utils import faults, telemetry
-from . import ps_service
+from . import ps_service, tenancy
 
 #: LEASE_ACQUIRE statuses (native/ps_server.cc contract).
 LEASE_NEW = 1  # newly acquired — fresh member, or re-acquire after expiry
@@ -60,13 +60,22 @@ _OBS_LAPSES = telemetry.REGISTRY.counter("membership/lapses")
 _OBS_HB_ERRORS = telemetry.REGISTRY.counter("membership/heartbeat_errors")
 
 
-def pack_member(member: str, kind: str = "", addr: str = "") -> str:
+def pack_member(
+    member: str, kind: str = "", addr: str = "",
+    tenant: str = tenancy.DEFAULT_TENANT,
+) -> str:
     """The wire form of a member identity: ``member|kind|addr``.  ``kind``
     is the role family (``worker``, ``serve``, ...); ``addr`` is the
     member's dialable ``host:port`` when it serves one ('' for pure
-    clients like workers).  Fields must be printable ASCII without
-    ``|``/``"``/``\\`` — the server emits the string into LEASE_LIST JSON
-    verbatim, so a malformed identity must fail HERE, loudly."""
+    clients like workers).  A non-default ``tenant`` (r20) scopes the
+    member field itself (``t.<tenant>.<member>`` via tenancy.qualify) —
+    the registry stays one flat opaque-string space, tenancy rides the
+    identity exactly like PS object keys, and the default tenant's packed
+    form is byte-identical to the pre-tenant wire.  Fields must be
+    printable ASCII without ``|``/``"``/``\\`` — the server emits the
+    string into LEASE_LIST JSON verbatim, so a malformed identity must
+    fail HERE, loudly."""
+    member = tenancy.qualify(tenant, member)
     for field, what in ((member, "member"), (kind, "kind"), (addr, "addr")):
         # isprintable() additionally rejects control bytes (\n, \t, NUL —
         # e.g. a role leaked from a shell with a trailing newline): the
@@ -132,23 +141,34 @@ def coordinator_addrs(
 
 def unpack_member(name: str) -> dict:
     """Inverse of :func:`pack_member`; tolerates a bare (unstructured)
-    member string from foreign acquirers."""
+    member string from foreign acquirers.  The tenant scope (r20) is
+    split back off the member field: ``member`` is always the BARE id
+    (trailing-digit ``member_index`` and split-reassignment consumers
+    never see the prefix) and ``tenant`` names its namespace."""
     parts = name.split(_SEP)
+    tenant, member = tenancy.split_qualified(parts[0])
     return {
-        "member": parts[0],
+        "member": member,
+        "tenant": tenant,
         "kind": parts[1] if len(parts) > 1 else "",
         "addr": parts[2] if len(parts) > 2 else "",
     }
 
 
-def parse_leases(doc: dict, kind: str | None = None) -> list[dict]:
+def parse_leases(
+    doc: dict, kind: str | None = None, tenant: str | None = None,
+) -> list[dict]:
     """The parsed live set from a ``PSClient.lease_list()`` document:
     member identity fields plus the registry's ttl/age/renewal numbers,
-    optionally filtered to one role family."""
+    optionally filtered to one role family and/or one tenant (None = all
+    tenants — the observability scrape; a tenant-scoped consumer passes
+    its own so another tenant's members are invisible to it)."""
     out = []
     for entry in doc.get("leases", []):
         m = unpack_member(entry.get("m", ""))
         if kind is not None and m["kind"] != kind:
+            continue
+        if tenant is not None and m["tenant"] != tenant:
             continue
         m.update(
             ttl_ms=int(entry.get("ttl_ms", 0)),
@@ -159,9 +179,12 @@ def parse_leases(doc: dict, kind: str | None = None) -> list[dict]:
     return out
 
 
-def live_members(client: ps_service.PSClient, kind: str | None = None) -> list[dict]:
+def live_members(
+    client: ps_service.PSClient, kind: str | None = None,
+    tenant: str | None = None,
+) -> list[dict]:
     """One registry scrape over an existing client."""
-    return parse_leases(client.lease_list(), kind)
+    return parse_leases(client.lease_list(), kind, tenant)
 
 
 def membership_role(role: str | None = None) -> str:
@@ -207,9 +230,11 @@ class LeaseHeartbeat:
         role: str | None = None,
         op_timeout_s: float | None = 5.0,
         reconnect_deadline_s: float = 30.0,
+        tenant: str = tenancy.DEFAULT_TENANT,
     ):
-        self.name = pack_member(member, kind, addr)
+        self.name = pack_member(member, kind, addr, tenant=tenant)
         self.member = member
+        self.tenant = tenant
         self.ttl_s = max(0.3, float(ttl_s))
         self.role = membership_role(role)
         self.enabled = True
@@ -343,8 +368,15 @@ class LeaseWatcher:
         reconnect_deadline_s: float = 10.0,
         follow_epoch: bool = False,
         layout_version: int = 0,
+        tenant: str | None = None,
     ):
         self.kind = kind
+        # Tenant scope (r20): None = watch ALL tenants (the observability
+        # posture, and the pre-tenant behavior); a tenant id restricts the
+        # live set to that namespace — members of other tenants never
+        # produce join/leave edges here, which is what keeps one tenant's
+        # churn from triggering another tenant's split reassignment.
+        self.tenant = tenant
         self.poll_s = max(0.05, float(poll_s))
         self.on_join = on_join
         self.on_leave = on_leave
@@ -416,9 +448,11 @@ class LeaseWatcher:
         if self.follow_epoch:
             self._follow_epoch_once()
         try:
+            # Keyed by (tenant, member): two tenants may both run a
+            # "worker0" and must not shadow each other in the known set.
             live = {
-                m["member"]: m
-                for m in live_members(self._client, self.kind)
+                (m["tenant"], m["member"]): m
+                for m in live_members(self._client, self.kind, self.tenant)
             }
         except (ps_service.PSError, OSError):
             self.poll_errors += 1

@@ -80,7 +80,7 @@ import numpy as np
 
 from .. import native
 from ..utils import faults, telemetry
-from . import retry, wire
+from . import retry, tenancy, wire
 
 # Op codes — aliases into the ONE registry (wire.PS_OPS, the single Python
 # definition site; tools/dtxlint pins it against native/ps_server.cc's
@@ -367,11 +367,20 @@ class PSClient:
         expect_layout: int = 0,
         addrs: list[tuple[str, int]] | None = None,
         control_ops_are_fault_points: bool = False,
+        tenant: str = tenancy.DEFAULT_TENANT,
     ):
         if wire_dtype not in WIRE_DTYPES:
             raise ValueError(
                 f"wire_dtype {wire_dtype!r} not in {sorted(WIRE_DTYPES)}"
             )
+        # Multi-tenancy (r20): every object-key op this client issues is
+        # qualified under ``t.<tenant>.`` at the single call() choke point
+        # (tenancy.qualify — the default tenant is the identity, keeping
+        # pre-tenant clients byte-identical on the wire).
+        self.tenant = (
+            tenant if tenant == tenancy.DEFAULT_TENANT
+            else tenancy.check_tenant(tenant)
+        )
         self._addrs = list(addrs) if addrs else [(host, port)]
         if (host, port) != self._addrs[0]:
             raise ValueError(
@@ -717,6 +726,16 @@ class PSClient:
 
     # -- recovery -----------------------------------------------------------
 
+    def _qual(self, op: int, name: str) -> str:
+        """Tenant-qualify an object key (r20): identity for the default
+        tenant and for control/lease ops — only the object-key op families
+        (tenancy.PS_SCOPED_OP_CODES) carry tenant-scoped names."""
+        if self.tenant == tenancy.DEFAULT_TENANT:
+            return name
+        if op in tenancy.PS_SCOPED_OP_CODES:
+            return tenancy.qualify(self.tenant, name)
+        return name
+
     def _register_ensure(self, op: int, name: str, a: int, b: int) -> None:
         self._ensures.append((op, name, a, b))
 
@@ -725,10 +744,13 @@ class PSClient:
         server (restart lost every object) gets them re-created on
         reconnect.  Returns the status.  Only a SUCCESSFUL create is
         remembered — a rejected one (type/name clash) must not poison the
-        reincarnation replay for the client's healthy objects."""
+        reincarnation replay for the client's healthy objects.  The ensure
+        list records the tenant-QUALIFIED name: the reincarnation replay
+        goes through _attempt (below call()'s qualification point), so the
+        stored name must already be the wire-level key."""
         status, _ = self.call(op, name, a, b)
         if status >= 0:
-            self._register_ensure(op, name, a, b)
+            self._register_ensure(op, self._qual(op, name), a, b)
         return status
 
     def on_reincarnation(self, fn) -> None:
@@ -919,6 +941,10 @@ class PSClient:
         framed as 4-byte units (the RESHARD_BEGIN record shape) — sent
         verbatim, never dtype-converted, so a bf16 connection ships the
         same bytes as an f32 one."""
+        # Tenant qualification (r20): the ONE place a PS object key gets
+        # its ``t.<tenant>.`` prefix — every helper object (accumulator,
+        # queues, param store) passes bare names through here.
+        name = self._qual(op, name)
         # Encode once, outside the retry loop: a replay re-sends the same
         # wire bytes without re-converting (bf16) or re-checking layout.
         wire_payload = (
@@ -1161,7 +1187,12 @@ class PSClient:
         return status, bytes(blob).rstrip(b" ") if blob else b""
 
     def cancel_all(self) -> None:
-        self.call(_CANCEL_ALL)
+        """Cancel blocked waiters on THIS client's tenant namespace: the
+        request name is a key-prefix filter (r20) — empty for the default
+        tenant (the whole space, the documented pre-tenant behavior), the
+        ``t.<tenant>.`` prefix otherwise, so one tenant's teardown/reseed
+        can never wake-and-fail another tenant's waiters."""
+        self.call(_CANCEL_ALL, tenancy.tenant_prefix(self.tenant))
 
 
 def _check(status: int, what: str) -> int:

@@ -97,7 +97,7 @@ import time
 from collections import deque
 from typing import Callable
 
-from . import wire
+from . import tenancy, wire
 
 log = logging.getLogger("dtx.server_core")
 
@@ -151,13 +151,20 @@ class Service:
                               peer cannot monopolize the dispatch queue.
     ``retry_after_ms``        the backoff hint shed answers carry
                               (``wire.retry_later_status``).
+    ``tenant_of``             multi-tenancy (r20): ``fn(op, name, a, b)
+                              -> tenant`` attributes each data-plane
+                              frame to its tenant (off the key prefix /
+                              name tag the service's wire carries); None
+                              = every frame is the default tenant.  The
+                              tenant keys the core's weighted-fair
+                              dispatch and per-tenant quotas.
     """
 
     __slots__ = (
         "name", "handler", "control_ops", "counts_fn", "error_status",
         "accept_dtypes", "max_payload", "on_disconnect",
         "queue_deadline_s", "max_inflight_per_conn", "retry_after_ms",
-        "hello_extra",
+        "hello_extra", "tenant_of",
     )
 
     def __init__(
@@ -171,6 +178,7 @@ class Service:
         max_inflight_per_conn: int = 16,
         retry_after_ms: int = 50,
         hello_extra: Callable | None = None,
+        tenant_of: Callable | None = None,
     ):
         if name not in wire.SERVICE_IDS:
             raise ValueError(
@@ -194,6 +202,7 @@ class Service:
         # version word, r19): called per HELLO on the selector thread, so
         # it must be cheap and never raise.
         self.hello_extra = hello_extra
+        self.tenant_of = tenant_of
 
 
 class CoreConn:
@@ -276,6 +285,7 @@ class ServerCore:
         accept_backoff_s: float = 0.2, max_buffered_bytes: int = 256 << 20,
         slow_reader_grace_s: float = 30.0, bind_retry_s: float = 5.0,
         max_dispatch_depth: int = 512,
+        tenant_quotas: dict[str, tenancy.TenantQuota] | None = None,
     ):
         self.name = name
         self._services: dict[str, Service] = {}
@@ -298,16 +308,37 @@ class ServerCore:
         self._shed_total = 0
         self._shed_dispatch_full = 0
         self._shed_inflight_cap = 0
+        self._shed_quota = 0
         self._queue_deadline_drops = 0
         self._conns: dict[int, CoreConn] = {}
         self._dirty: queue.SimpleQueue = queue.SimpleQueue()
         # Two dispatch lanes under one condition: control-plane frames ride
         # the PRIORITY deque (never shed, preferred by every worker, owned
-        # outright by the dedicated control worker), data-plane frames the
-        # bounded regular one.
+        # outright by the dedicated control worker); data-plane frames ride
+        # PER-TENANT deques (r20) drained by STRIDE scheduling — each pop
+        # advances the winning tenant's virtual time by 1/weight, so under
+        # contention a weight-2 tenant drains twice as fast as a weight-1
+        # tenant, an idle tenant costs nothing, and a newly-busy tenant
+        # re-enters at the current virtual clock (no burst catch-up).  The
+        # core-wide dispatch bound (``max_dispatch_depth``) spans ALL
+        # tenant deques; ``tenant_quotas`` layers per-tenant in-flight /
+        # queued caps on top (a tenant at quota is shed RETRY_LATER while
+        # other tenants' traffic flows).  Pre-tenant posture is exactly
+        # one "default" deque — byte-identical behavior.
         self._tasks_cond = threading.Condition()
-        self._tasks: deque = deque()
+        self._tenant_tasks: dict[str, deque] = {}
+        self._tenant_vtime: dict[str, float] = {}
+        self._vclock = 0.0
+        self._ntasks = 0  # queued data-plane frames across all tenants
         self._ptasks: deque = deque()
+        self._tenant_quotas = dict(tenant_quotas or {})
+        # Per-tenant accounting (guarded by self._lock): request/shed
+        # counters + live in-flight, keyed lazily as tenants appear.
+        self._tenant_counters: dict[str, dict] = {}
+        # (conn.fd, seq) -> tenant for every admitted-undispatched or
+        # dispatched-unanswered frame, so the reply path can decrement
+        # the right tenant's in-flight count.
+        self._task_tenant: dict[tuple[int, int], str] = {}
         self._workers_stop = False
         self._stop_flag = False
         self._draining = False
@@ -396,10 +427,36 @@ class ServerCore:
         with self._lock:
             return len(self._conns)
 
+    def _tenant_counter_locked(self, tenant: str) -> dict:
+        """The per-tenant counter row (created on first sight); caller
+        holds ``self._lock``."""
+        tc = self._tenant_counters.get(tenant)
+        if tc is None:
+            tc = self._tenant_counters[tenant] = {
+                "requests": 0,
+                "inflight": 0,
+                "shed_total": 0,
+                "shed_inflight_cap": 0,
+                "shed_dispatch_full": 0,
+                "shed_quota": 0,
+                "queue_deadline_drops": 0,
+            }
+        return tc
+
     def core_stats(self) -> dict:
         """The uniform runtime-accounting shape every service's STATS
         answer folds in (one definition of what the counters mean)."""
         with self._lock:
+            tenants = {}
+            for t, tc in self._tenant_counters.items():
+                row = dict(tc)
+                dq = self._tenant_tasks.get(t)
+                row["queued"] = len(dq) if dq else 0
+                q = self._tenant_quotas.get(t)
+                row["weight"] = q.weight if q else 1.0
+                row["max_inflight"] = q.max_inflight if q else 0
+                row["max_dispatch"] = q.max_dispatch if q else 0
+                tenants[t] = row
             return {
                 "requests": self._requests,
                 "live_conns": len(self._conns),
@@ -409,7 +466,7 @@ class ServerCore:
                 "handler_errors": self._handler_errors,
                 "dropped_slow_readers": self._dropped_slow,
                 "worker_threads": self._n_workers,
-                "dispatch_depth": len(self._tasks) + len(self._ptasks),
+                "dispatch_depth": self._ntasks + len(self._ptasks),
                 "max_dispatch_depth": self._max_dispatch_depth,
                 # Admission-control sheds (r18), by cause; shed_total is
                 # their sum — the externally gated "requests answered
@@ -417,8 +474,12 @@ class ServerCore:
                 "shed_total": self._shed_total,
                 "shed_dispatch_full": self._shed_dispatch_full,
                 "shed_inflight_cap": self._shed_inflight_cap,
+                "shed_quota": self._shed_quota,
                 "queue_deadline_drops": self._queue_deadline_drops,
                 "draining": 1 if self._draining else 0,
+                # Per-tenant breakdown (r20): the same shed vocabulary,
+                # per namespace — what dtxtop's tenants section renders.
+                "tenants": tenants,
             }
 
     # -- lifecycle ------------------------------------------------------------
@@ -444,7 +505,7 @@ class ServerCore:
                 )
             if (
                 not busy
-                and not self._tasks
+                and not self._ntasks
                 and not self._ptasks
                 and (self._listener_retired or not self._started)
             ):
@@ -748,26 +809,64 @@ class ServerCore:
             counted = not control and (
                 svc.counts_fn is None or svc.counts_fn(op, name, a, b)
             )
+            # Tenant attribution (r20): the service's tenant_of reads the
+            # tenant off the frame (key prefix / name tag); anything it
+            # cannot attribute — including a buggy hook — is the default
+            # tenant, never a dropped frame.
+            tenant = tenancy.DEFAULT_TENANT
+            if not control and svc.tenant_of is not None:
+                try:
+                    tenant = svc.tenant_of(op, name, a, b) or tenant
+                except Exception:  # noqa: BLE001 — attribution must not kill I/O
+                    pass
             shed = None
             with self._lock:
+                tc = self._tenant_counter_locked(tenant) if not control else None
                 if counted:
                     self._requests += 1
+                    tc["requests"] += 1
                 if not control:
                     # Admission: control ops bypass every bound (priority
                     # class — never shed), data-plane frames must fit the
-                    # per-connection in-flight cap and the core-wide
-                    # dispatch bound.
+                    # per-connection in-flight cap, the core-wide dispatch
+                    # bound, and the tenant's own quotas (r20) — a tenant
+                    # at quota sheds while other tenants' traffic flows.
+                    quota = self._tenant_quotas.get(tenant)
+                    dq = self._tenant_tasks.get(tenant)
                     if conn.inflight >= svc.max_inflight_per_conn:
                         self._shed_inflight_cap += 1
                         self._shed_total += 1
+                        tc["shed_inflight_cap"] += 1
+                        tc["shed_total"] += 1
                         shed = svc.retry_after_ms
-                    elif len(self._tasks) >= self._max_dispatch_depth:
+                    elif self._ntasks >= self._max_dispatch_depth:
                         self._shed_dispatch_full += 1
                         self._shed_total += 1
+                        tc["shed_dispatch_full"] += 1
+                        tc["shed_total"] += 1
+                        shed = svc.retry_after_ms
+                    elif quota is not None and (
+                        (
+                            quota.max_inflight
+                            and tc["inflight"] >= quota.max_inflight
+                        )
+                        or (
+                            quota.max_dispatch
+                            and dq is not None
+                            and len(dq) >= quota.max_dispatch
+                        )
+                    ):
+                        self._shed_quota += 1
+                        self._shed_total += 1
+                        tc["shed_quota"] += 1
+                        tc["shed_total"] += 1
                         shed = svc.retry_after_ms
                 if shed is None:
                     self._dispatched += 1
                     conn.inflight += 1
+                    if tc is not None:
+                        tc["inflight"] += 1
+                        self._task_tenant[(conn.fd, seq)] = tenant
             if shed is not None:
                 self._queue_reply(
                     conn, seq, wire.retry_later_status(shed), None,
@@ -783,9 +882,23 @@ class ServerCore:
                 stamped_s = deadline_ms / 1e3
                 budget = stamped_s if budget is None else min(budget, stamped_s)
             t_shed = None if budget is None else time.monotonic() + budget
-            task = (conn, svc, seq, t_shed, (op, name, a, b, payload))
+            task = (conn, svc, seq, t_shed, tenant, (op, name, a, b, payload))
             with self._tasks_cond:
-                (self._ptasks if control else self._tasks).append(task)
+                if control:
+                    self._ptasks.append(task)
+                else:
+                    dq = self._tenant_tasks.get(tenant)
+                    if dq is None:
+                        dq = self._tenant_tasks[tenant] = deque()
+                        self._tenant_vtime.setdefault(tenant, 0.0)
+                    if not dq:
+                        # Re-entering tenant starts at the current virtual
+                        # clock: idle time earns no burst credit.
+                        self._tenant_vtime[tenant] = max(
+                            self._tenant_vtime[tenant], self._vclock
+                        )
+                    dq.append(task)
+                    self._ntasks += 1
                 # notify_all, not notify: a single notify can be consumed
                 # by the CONTROL-ONLY worker, which cannot take a regular
                 # task and would strand it until the 0.5s wait timeout.
@@ -837,6 +950,11 @@ class ServerCore:
             conn.out_bytes += total
             if dispatched:
                 conn.inflight -= 1
+                t = self._task_tenant.pop((conn.fd, seq), None)
+                if t is not None:
+                    tc = self._tenant_counters.get(t)
+                    if tc is not None and tc["inflight"] > 0:
+                        tc["inflight"] -= 1
             while conn.next_out in conn.parked:
                 conn.out.extend(conn.parked.pop(conn.next_out))
                 conn.next_out += 1
@@ -845,12 +963,16 @@ class ServerCore:
 
     def _shed_task(self, task, *, cause: str) -> None:
         """Answer one queued task RETRY_LATER without running its handler
-        (the queue-deadline drop path; counted by cause)."""
-        conn, svc, seq, _t_shed, _req = task
+        (the queue-deadline drop path; counted by cause, globally and on
+        the owning tenant's row)."""
+        conn, svc, seq, _t_shed, tenant, _req = task
         with self._lock:
             self._shed_total += 1
+            tc = self._tenant_counter_locked(tenant)
+            tc["shed_total"] += 1
             if cause == "queue_deadline":
                 self._queue_deadline_drops += 1
+                tc["queue_deadline_drops"] += 1
         self._queue_reply(
             conn, seq, wire.retry_later_status(svc.retry_after_ms), None,
             dispatched=True,
@@ -869,17 +991,21 @@ class ServerCore:
         self._next_deadline_sweep = now + 1.0
         expired: list = []
         with self._tasks_cond:
-            if not self._tasks:
+            if not self._ntasks:
                 return
-            keep: deque = deque()
-            for task in self._tasks:
-                t_shed = task[3]
-                if t_shed is not None and now > t_shed:
-                    expired.append(task)
-                else:
-                    keep.append(task)
-            if expired:
-                self._tasks = keep
+            for tenant, dq in self._tenant_tasks.items():
+                if not dq:
+                    continue
+                keep: deque = deque()
+                for task in dq:
+                    t_shed = task[3]
+                    if t_shed is not None and now > t_shed:
+                        expired.append(task)
+                    else:
+                        keep.append(task)
+                if len(keep) != len(dq):
+                    self._tenant_tasks[tenant] = keep
+            self._ntasks -= len(expired)
         for task in expired:
             self._shed_task(task, cause="queue_deadline")
 
@@ -979,6 +1105,14 @@ class ServerCore:
             conn.out.clear()
             conn.parked.clear()
             conn.out_bytes = 0
+            # Release the dead connection's per-tenant in-flight slots —
+            # its replies will never come back through _queue_reply (and
+            # the fd may be reused by a future connection's key space).
+            stale = [k for k in self._task_tenant if k[0] == conn.fd]
+            for k in stale:
+                tc = self._tenant_counters.get(self._task_tenant.pop(k))
+                if tc is not None and tc["inflight"] > 0:
+                    tc["inflight"] -= 1
         if conn.events:
             try:
                 self._sel.unregister(conn.sock)
@@ -998,17 +1132,40 @@ class ServerCore:
 
     # -- the worker pool ------------------------------------------------------
 
+    def _pop_fair_locked(self):
+        """Stride-scheduled pop across the tenant deques (caller holds
+        ``_tasks_cond``): the non-empty tenant with the smallest virtual
+        time wins, and its clock advances by 1/weight — proportional
+        share under contention, zero cost while idle.  None = no
+        data-plane work queued."""
+        best = None
+        for t, dq in self._tenant_tasks.items():
+            if dq and (
+                best is None or self._tenant_vtime[t] < self._tenant_vtime[best]
+            ):
+                best = t
+        if best is None:
+            return None
+        quota = self._tenant_quotas.get(best)
+        self._tenant_vtime[best] += 1.0 / (quota.weight if quota else 1.0)
+        self._vclock = self._tenant_vtime[best]
+        self._ntasks -= 1
+        return self._tenant_tasks[best].popleft()
+
     def _next_task(self, control_only: bool):
         """Pop the next task: the priority lane first (every worker), the
-        regular lane only for regular workers.  None = shutting down."""
+        weighted-fair tenant lanes only for regular workers.  None =
+        shutting down."""
         with self._tasks_cond:
             while True:
                 if self._workers_stop:
                     return None
                 if self._ptasks:
                     return self._ptasks.popleft()
-                if not control_only and self._tasks:
-                    return self._tasks.popleft()
+                if not control_only:
+                    task = self._pop_fair_locked()
+                    if task is not None:
+                        return task
                 self._tasks_cond.wait(timeout=0.5)
 
     def _worker(self, control_only: bool = False) -> None:
@@ -1016,7 +1173,7 @@ class ServerCore:
             item = self._next_task(control_only)
             if item is None:
                 return
-            conn, svc, seq, t_shed, (op, name, a, b, payload) = item
+            conn, svc, seq, t_shed, _tenant, (op, name, a, b, payload) = item
             if conn.closed:
                 continue
             if t_shed is not None and time.monotonic() > t_shed:

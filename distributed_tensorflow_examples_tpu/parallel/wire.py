@@ -288,6 +288,37 @@ CONTROL_OPS: dict[str, frozenset[str]] = {
     "msrv": frozenset({"HELLO", "STATS"}),
 }
 
+# Multi-tenancy (r20 dtxtenant): tenancy is a KEY-PREFIX protocol, not a
+# new op family — a tenant's PS objects live under ``t.<tenant>.<name>``
+# and its lease identities under ``t.<tenant>.<member>``, so v<=4 frames
+# from untagged (pre-tenant) clients stay byte-identical and simply land
+# in the ``default`` tenant (whose keys carry NO prefix at all).  The
+# prefix below is the ONE wire-level definition: ``parallel/tenancy.py``
+# builds every qualified key from it, ``native/ps_server.cc`` mirrors it
+# as ``kTenantKeyPrefix`` (for the per-tenant STATS breakdown and the
+# prefix-filtered CANCEL_ALL), and ``tools/dtxlint``'s tenant pass pins
+# the two and refuses prefix construction anywhere else.
+TENANT_KEY_PREFIX = "t."
+
+#: PS ops whose ``name`` operand is a TENANT-SCOPED OBJECT KEY — the ops
+#: :meth:`ps_service.PSClient.call` qualifies with the caller's tenant
+#: prefix.  Everything else (HELLO/STATS/PING/INCARNATION, the lease ops
+#: — whose names are member docs, tenant-scoped inside ``pack_member`` —
+#: the reshard/replication control surface, and CANCEL_ALL, whose name is
+#: a raw prefix FILTER) passes its name through untouched.  Declared as a
+#: literal so dtxlint's tenant pass can validate every entry against
+#: PS_OPS and pin the qualification site against this set.
+TENANT_SCOPED_OPS: dict[str, frozenset[str]] = {
+    "ps": frozenset({
+        "ACC_GET", "ACC_APPLY", "ACC_TAKE", "ACC_SET_STEP", "ACC_DROPPED",
+        "ACC_APPLY_TAGGED", "ACC_DEDUPED", "ACC_RESET_WORKER",
+        "TQ_GET", "TQ_PUSH", "TQ_POP",
+        "GQ_GET", "GQ_PUSH", "GQ_POP", "GQ_SET_MIN", "GQ_DROPPED",
+        "GQ_PUSH_TAGGED", "GQ_DEDUPED", "GQ_RESET_WORKER",
+        "PSTORE_GET_OBJ", "PSTORE_SET", "PSTORE_GET", "PSTORE_GET_IF_NEWER",
+    }),
+}
+
 #: Protocol state machines (r16): the legal op orderings each wire's
 #: conversation must respect, declared as pure DATA (dict/list/str
 #: literals only) so ``tools/dtxlint``'s protocol pass can both validate

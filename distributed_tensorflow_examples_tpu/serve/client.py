@@ -39,7 +39,7 @@ import time
 
 import numpy as np
 
-from ..parallel import retry, wire
+from ..parallel import retry, tenancy, wire
 from ..utils import faults, telemetry
 from ..utils.metrics import LatencyRecorder
 from .model_server import (
@@ -97,9 +97,16 @@ class ServeClient:
     def __init__(
         self, host: str, port: int, *, op_timeout_s: float | None = 30.0,
         reconnect_deadline_s: float = 60.0, backoff_s: float = 0.25,
-        role: str | None = None,
+        role: str | None = None, tenant: str = tenancy.DEFAULT_TENANT,
     ):
         self._host, self._port = host, port
+        # The tenant every request of this client is tagged with (r20):
+        # the default tenant tags nothing — byte-identical frames against
+        # any pre-tenant replica.
+        self.tenant = (
+            tenant if tenant == tenancy.DEFAULT_TENANT
+            else tenancy.check_tenant(tenant)
+        )
         self._op_timeout = op_timeout_s
         self._reconnect_deadline = reconnect_deadline_s
         self._backoff = backoff_s
@@ -168,6 +175,12 @@ class ServeClient:
         codec) sent zero-copy via scatter/gather ``sendmsg``."""
         if self._sock is None:
             raise ConnectionError("not connected")
+        # The ONE client-side tagging point (r20): every data-plane op of
+        # a non-default tenant carries its tenant in the name operand —
+        # never HELLO, the version-discovery frame (same reasoning as the
+        # deadline stamp below).
+        if self.tenant != tenancy.DEFAULT_TENANT and op != wire.HELLO_OP:
+            name = tenancy.tag_name(name, self.tenant)
         try:
             self._sock.settimeout(self._op_timeout)
             nbytes = wire.encoded_nbytes(payload_bufs) if payload_bufs else 0
@@ -442,9 +455,17 @@ class ServePool:
         self, addrs: list[tuple[str, int]], *, role: str | None = None,
         op_timeout_s: float | None = 10.0, eject_s: float = 1.0,
         deadline_s: float = 60.0, backoff_s: float = 0.05,
+        tenant: str = tenancy.DEFAULT_TENANT,
     ):
         if not addrs:
             raise ValueError("need at least one replica address")
+        # The pool's tenant (r20): forwarded to every per-replica client,
+        # so each predict is tagged and the replicas' admission control /
+        # accounting attribute this pool's traffic to it.
+        self.tenant = (
+            tenant if tenant == tenancy.DEFAULT_TENANT
+            else tenancy.check_tenant(tenant)
+        )
         self.addrs = list(addrs)
         self.role = role if role is not None else (
             (faults.current_role() or "client") + "_sv"
@@ -556,7 +577,7 @@ class ServePool:
         c = ServeClient(
             host, port, op_timeout_s=self._op_timeout,
             reconnect_deadline_s=0.0,  # the POOL is the recovery layer
-            role=self.role,
+            role=self.role, tenant=self.tenant,
         )
         with self._lock:
             # Two threads can race past the None check and both dial;

@@ -49,7 +49,7 @@ import time
 
 import numpy as np
 
-from ..parallel import ps_shard, server_core, wire
+from ..parallel import ps_shard, server_core, tenancy, wire
 from ..utils import faults, telemetry
 from ..utils.metrics import LatencyRecorder, MetricsWriter
 from . import batcher as batcher_lib
@@ -75,6 +75,13 @@ SRV_DECODE_CLOSE = wire.SRV_OPS["DECODE_CLOSE"]
 _SRV_CONTROL_OPS = frozenset(
     wire.SRV_OPS[n] for n in wire.CONTROL_OPS["msrv"]
 )
+
+
+def _tenant_of_request(op: int, name: str, a: int, b: int) -> str:
+    """The server core's per-tenant admission attribution (r20): the
+    tenant rides the ``name`` operand as a ``,t=<tenant>`` tag — absent
+    (= the default tenant) on every untagged client's frames."""
+    return tenancy.untag_name(name)[1]
 
 # Response statuses (wire.SRV_STATUS aliases).  PREDICT success answers the
 # served model_step (>= 0) as the status — the per-response staleness stamp
@@ -246,6 +253,8 @@ class ModelReplicaServer:
         decode_fns: tuple | None = None, decode_slots: int = 4,
         decode_max_len: int = 512, decode_max_sessions: int = 64,
         session_idle_s: float = 60.0,
+        tenant: str = tenancy.DEFAULT_TENANT,
+        tenant_quotas: dict | None = None,
     ):
         import jax
 
@@ -257,12 +266,23 @@ class ModelReplicaServer:
         self.role = role if role is not None else (
             faults.current_role() or "serve0"
         )
+        # The tenant this replica serves FOR (r20): scopes its PS param
+        # namespace (hot-tracking pulls the tenant's own ``params``
+        # object), its registry model namespace and pin identity, and its
+        # membership lease.  The default tenant changes nothing.
+        self.tenant = (
+            tenant if tenant == tenancy.DEFAULT_TENANT
+            else tenancy.check_tenant(tenant)
+        )
         self._op_timeout_s = op_timeout_s
         self._reconnect_deadline_s = reconnect_deadline_s
         # Registry pin (r19): a pinned replica serves one immutable
         # version for its whole lifetime; version 0 means hot-tracking.
+        # The registry namespace is tenant-qualified (r20): tenant
+        # ``runa``'s model ``m`` is the registry entry ``t.runa.m`` — two
+        # tenants' models can share a bare name without sharing bytes.
         self.model_version = int(model_version or 0)
-        self.model_name = model_name
+        self.model_name = tenancy.qualify(self.tenant, model_name)
         self._registry = (
             registry_lib.ModelRegistry(registry_dir) if registry_dir else None
         )
@@ -285,6 +305,7 @@ class ModelReplicaServer:
                 ps_addrs, role=self.role, op_timeout_s=op_timeout_s,
                 reconnect_deadline_s=reconnect_deadline_s,
                 replicas=ps_replicas, layout_version=layout_version,
+                tenant=self.tenant,
             )
             self._layout = self._group.layout_for(total)
             self._pstore = ps_shard.ShardedParamStore(
@@ -326,7 +347,7 @@ class ModelReplicaServer:
             self._model = (int(step), jax.device_put(self._unflatten(flat)))
             self._registry.pin(
                 self.model_name, self.model_version, self.role,
-                ttl_s=self._pin_ttl_s,
+                ttl_s=self._pin_ttl_s, tenant=self.tenant,
             )
             self._next_pin_renew = time.monotonic() + self._pin_ttl_s / 3
         self._incarnation = int.from_bytes(os.urandom(4), "little") | 1
@@ -379,7 +400,7 @@ class ModelReplicaServer:
         # bounded by the batcher's admission control, not by threads.
         self._core = server_core.ServerCore(
             port=port, loopback_only=loopback_only, name="msrv",
-            workers=handler_workers,
+            workers=handler_workers, tenant_quotas=tenant_quotas,
         )
         # Shed answers carry a backoff HINT (r18): roughly two batch
         # windows — the time a queue slot takes to free under load — so
@@ -388,6 +409,7 @@ class ModelReplicaServer:
         self._core.add_service(server_core.Service(
             SERVICE, self._handle,
             control_ops=_SRV_CONTROL_OPS,
+            tenant_of=_tenant_of_request,
             error_status=ERR,
             # PREDICT batches are the only request payloads; bound them
             # at the write-buffer bound rather than the frame ceiling.
@@ -424,6 +446,7 @@ class ModelReplicaServer:
                 ttl_s=lease_ttl_s, role=self.role,
                 op_timeout_s=op_timeout_s,
                 reconnect_deadline_s=reconnect_deadline_s,
+                tenant=self.tenant,
             )
         self._refresher = threading.Thread(
             target=self._refresh_loop, daemon=True, name="msrv-refresh"
@@ -489,7 +512,8 @@ class ModelReplicaServer:
             # served version while in-flight work could still touch it.
             try:
                 self._registry.unpin(
-                    self.model_name, self.model_version, self.role
+                    self.model_name, self.model_version, self.role,
+                    tenant=self.tenant,
                 )
             except Exception:  # noqa: BLE001 — unpin is best-effort cleanup
                 log.warning("registry unpin failed", exc_info=True)
@@ -518,6 +542,7 @@ class ModelReplicaServer:
             group = ps_shard.ShardedPSClients.for_record(
                 rec, role=self.role, op_timeout_s=self._op_timeout_s,
                 reconnect_deadline_s=self._reconnect_deadline_s,
+                tenant=self.tenant,
             )
             layout = group.layout_for(self._layout.num_elems)
             pstore = ps_shard.ShardedParamStore(group, "params", layout)
@@ -591,7 +616,7 @@ class ModelReplicaServer:
                     try:
                         self._registry.pin(
                             self.model_name, self.model_version, self.role,
-                            ttl_s=self._pin_ttl_s,
+                            ttl_s=self._pin_ttl_s, tenant=self.tenant,
                         )
                     except Exception:  # noqa: BLE001 — retried next renew
                         self._refresh_errors += 1
@@ -691,6 +716,7 @@ class ModelReplicaServer:
                 # carry — dtxtop's per-version rollup keys off this).
                 "model_version": self.model_version,
                 "model_name": self.model_name,
+                "tenant": self.tenant,
                 "pinned": self._pinned,
                 # The uniform runtime-accounting shape (r17): requests /
                 # live_conns come from the shared server core, same
@@ -702,6 +728,10 @@ class ModelReplicaServer:
                 "shed_total": core["shed_total"],
                 "queue_deadline_drops": core["queue_deadline_drops"],
                 "core": core,
+                # Per-tenant admission/accounting rows (r20) surface
+                # top-level like the other two services', so dtxtop's
+                # tenants section reads one shape everywhere.
+                "tenants": core["tenants"],
                 "predict_rows": self._predicts,
                 "overloads": self._overloads,
                 "refreshes": self._refreshes,
@@ -930,6 +960,7 @@ def host_serve_task(
     registry_dir: str | None = None, model_name: str = "default",
     model_version: int | None = None, decode_fns: tuple | None = None,
     decode_slots: int = 4, decode_max_len: int = 512,
+    tenant: str = tenancy.DEFAULT_TENANT, tenant_quotas: dict | None = None,
 ) -> int:
     """Dedicated serve-task body (``--job_name=serve``): host one replica
     until a client signals SRV_SHUTDOWN (or the supervisor dies).  Arms
@@ -954,6 +985,7 @@ def host_serve_task(
         registry_dir=registry_dir, model_name=model_name,
         model_version=model_version, decode_fns=decode_fns,
         decode_slots=decode_slots, decode_max_len=decode_max_len,
+        tenant=tenant, tenant_quotas=tenant_quotas,
     )
     faults.arm_process_faults(
         request_count_fn=server.request_count,

@@ -40,6 +40,8 @@ import time
 
 import numpy as np
 
+from ..parallel import tenancy
+
 log = logging.getLogger("dtx.registry")
 
 #: Manifest schema version (tests pin it).
@@ -261,15 +263,23 @@ class ModelRegistry:
 
     def pin(
         self, name: str, version: int, owner: str, *, ttl_s: float = 60.0,
+        tenant: str = tenancy.DEFAULT_TENANT,
     ) -> None:
         """Pin a version on behalf of ``owner`` (a serving replica's
         role): refresh on the replica's poll cadence — an expired pin no
         longer protects, so a crashed replica cannot block GC forever
-        (the same self-healing posture as membership leases)."""
+        (the same self-healing posture as membership leases).
+
+        The pin file is keyed by the TENANT-QUALIFIED owner (r20): two
+        tenants' replicas sharing both a snapshot and a role name (e.g.
+        both pinning the shared base model as ``serve0``) hold two
+        distinct pins — one tenant's unpin/GC sweep can never unprotect
+        the version out from under the other tenant's live replica."""
         if not _NAME_RE.match(owner):
             raise RegistryError(
                 f"pin owner {owner!r} must match {_NAME_RE.pattern}"
             )
+        owner = tenancy.qualify(tenant, owner)
         self.manifest(name, version)  # pinning an unpublished version is a bug
         pins = self._pins_dir(name, version)
         os.makedirs(pins, exist_ok=True)
@@ -278,7 +288,11 @@ class ModelRegistry:
             {"owner": owner, "expires_unix": time.time() + float(ttl_s)},
         )
 
-    def unpin(self, name: str, version: int, owner: str) -> None:
+    def unpin(
+        self, name: str, version: int, owner: str, *,
+        tenant: str = tenancy.DEFAULT_TENANT,
+    ) -> None:
+        owner = tenancy.qualify(tenant, owner)
         try:
             os.unlink(os.path.join(self._pins_dir(name, version), f"{owner}.json"))
         except OSError:

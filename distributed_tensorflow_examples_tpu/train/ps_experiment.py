@@ -188,7 +188,21 @@ def _ps_cfg(FLAGS, mode: str, n_workers: int):
         # r14 elasticity knobs (getattr for embedded callers, as above).
         membership_leases=bool(getattr(FLAGS, "membership_leases", True)),
         lease_ttl_s=float(getattr(FLAGS, "lease_ttl_s", 10.0) or 10.0),
+        # r20 multi-tenancy: the run's tenant namespace (getattr for
+        # embedded callers).  tenancy.check_tenant inside the clients
+        # rejects a typo'd --tenant loudly at dial time.
+        tenant=getattr(FLAGS, "tenant", "default") or "default",
     )
+
+
+def _tenant_quotas(FLAGS):
+    """--tenant_quotas parsed to the ServerCore quota table (r20), or None.
+    A malformed spec fails the SERVER launch loudly here — never silently
+    serving with fairness off."""
+    from ..parallel import tenancy
+
+    spec = getattr(FLAGS, "tenant_quotas", "") or ""
+    return tenancy.parse_quotas(spec) if spec else None
 
 
 def _resolve_listen_all(FLAGS, host: str, flag: str = "--ps_hosts") -> bool:
@@ -401,6 +415,7 @@ def run_ps_cluster_task(
             ps_layout_version=int(
                 getattr(FLAGS, "ps_layout_version", 0) or 0
             ),
+            tenant_quotas=_tenant_quotas(FLAGS),
         )
         print(f"DSVC_DONE port={bound}")
         return None
@@ -491,6 +506,11 @@ def run_ps_cluster_task(
                 if getattr(FLAGS, "log_dir", None)
                 else None
             ),
+            # r20: the replica serves ITS tenant's model namespace (PS
+            # params + registry pins + lease all tenant-scoped) while the
+            # quota table admission-controls every tenant that dials it.
+            tenant=getattr(FLAGS, "tenant", "default") or "default",
+            tenant_quotas=_tenant_quotas(FLAGS),
         )
         print(f"SERVE_DONE port={bound}")
         return None

@@ -360,6 +360,32 @@ def define_legacy_cluster_flags():
         "still keep the lease alive.",
     )
     _define(
+        "string",
+        "tenant",
+        "default",
+        "Multi-tenancy (r20): the tenant this task belongs to.  Every PS "
+        "object the run creates lives under the 't.<tenant>.' key "
+        "namespace, its membership leases / data-service job / served "
+        "model are tenant-scoped, and the shared servers account and "
+        "admission-control its traffic per tenant — several runs share "
+        "one PS/data/serve plane without ever touching each other's "
+        "state.  'default' = untagged (byte-identical pre-r20 wire).  "
+        "See RUNBOOK 'Multi-tenancy'.",
+    )
+    _define(
+        "string",
+        "tenant_quotas",
+        "",
+        "Multi-tenancy (r20), SERVER tasks (ps/data_service/serve): "
+        "per-tenant weighted-fair dispatch weights and quota caps, "
+        "'tenant=weight[:max_inflight[:max_dispatch]],...' (e.g. "
+        "'runa=3,runb=1:64:8').  Dispatch capacity is divided "
+        "weight-proportionally under contention (stride scheduling); a "
+        "tenant past a hard cap gets typed RETRY_LATER answers while "
+        "other tenants flow.  Unlisted tenants get weight 1, no caps.  "
+        "Empty = every tenant weight 1, uncapped.",
+    )
+    _define(
         "integer",
         "replicas_to_aggregate",
         0,

@@ -52,13 +52,16 @@ def main(argv):
         seed=FLAGS.seed,
         num_classes=10,
         name="cifar10",
+        tenant=getattr(FLAGS, "tenant", "default") or "default",
     )
     ds = src.ds
 
     def worker_stream(w, bs, n_workers):
         """Per-emulated-worker data shard (worker w plays host w)."""
         return data.streams.train_iter(
-            src, batch_size=bs, seed=FLAGS.seed, worker=w, n_workers=n_workers
+            src, batch_size=bs, seed=FLAGS.seed, worker=w,
+            n_workers=n_workers,
+            tenant=getattr(FLAGS, "tenant", "default") or "default",
         )
 
     cfg = models.cnn.Config()
@@ -107,7 +110,10 @@ def main(argv):
         flags=FLAGS,
     )
     exp.run(
-        data.streams.train_iter(src, batch_size=FLAGS.batch_size, seed=FLAGS.seed)
+        data.streams.train_iter(
+            src, batch_size=FLAGS.batch_size, seed=FLAGS.seed,
+            tenant=getattr(FLAGS, "tenant", "default") or "default",
+        )
     )
     metrics = exp.evaluate(ds.test)
     exp.finish(test_accuracy=metrics.get("accuracy", 0.0))

@@ -71,6 +71,7 @@ def main(argv):
         seed=FLAGS.seed,
         num_classes=FLAGS.num_classes,
         name="imagenet",
+        tenant=getattr(FLAGS, "tenant", "default") or "default",
     )
     ds = src.ds
 
@@ -92,7 +93,10 @@ def main(argv):
         flags=FLAGS,
     )
     exp.run(
-        data.streams.train_iter(src, batch_size=FLAGS.batch_size, seed=FLAGS.seed)
+        data.streams.train_iter(
+            src, batch_size=FLAGS.batch_size, seed=FLAGS.seed,
+            tenant=getattr(FLAGS, "tenant", "default") or "default",
+        )
     )
 
     def eval_fn(params, mstate, batch):

@@ -62,6 +62,8 @@ _WIRE_PY = textwrap.dedent(
         "dsvc": frozenset({"HELLO"}),
         "msrv": frozenset({"HELLO"}),
     }
+    TENANT_KEY_PREFIX = "t."
+    TENANT_SCOPED_OPS = {"ps": frozenset({"PSTORE_GET"})}
     WIRE_PROTOCOLS = {
         "hello-first": {
             "kind": "first_op", "services": ["dsvc", "msrv"], "op": "HELLO",
@@ -93,6 +95,7 @@ _PS_SERVER_CC = textwrap.dedent(
     constexpr int kReplRefused = -6;
     constexpr int kReplDiverged = -7;
     constexpr int kTagWorkerShift = 40;
+    constexpr char kTenantKeyPrefix[] = "t.";
     enum Op : int {
       PING = 15,
       PSTORE_GET = 18,
@@ -999,6 +1002,76 @@ def test_flag_drift_detects_undefined_flag_access(tmp_path):
     findings = run_pass(tmp_path, "flag_drift", {"use/consume.py": use})
     undef = [f for f in findings if f.code == "flag-undefined"]
     assert [f.symbol for f in undef] == ["mystery_knob"]
+
+
+def test_tenant_detects_raw_prefix_fstring(tmp_path):
+    """The one-injection proof: a hand-built ``f"t.{...}"`` key in a
+    service module (bypassing tenancy.qualify) is refused."""
+    msrv = _MSRV_PY + '\ndef bad_key(tenant, name):\n' \
+        '    return f"t.{tenant}.{name}"\n'
+    findings = run_pass(tmp_path, "tenant", {"pkg/serve/model_server.py": msrv})
+    scope = [f for f in findings if f.code == "tenant-scope"]
+    assert len(scope) == 1 and scope[0].path.endswith("model_server.py")
+
+
+def test_tenant_detects_raw_tag_literal(tmp_path):
+    dsvc = _DSVC_PY + '\nTAG = ",t="\n'
+    findings = run_pass(tmp_path, "tenant", {"pkg/data/data_service.py": dsvc})
+    assert [f.code for f in findings] == ["tenant-scope"]
+
+
+def test_tenant_detects_prefix_reference_outside_tenancy(tmp_path):
+    ps = _PS_SERVICE_PY + '\n_P = wire.TENANT_KEY_PREFIX\n'
+    findings = run_pass(tmp_path, "tenant", {"pkg/parallel/ps_service.py": ps})
+    assert [f.code for f in findings] == ["tenant-scope"]
+    assert findings[0].symbol == "TENANT_KEY_PREFIX"
+
+
+def test_tenant_detects_cpp_prefix_drift(tmp_path):
+    cc = _PS_SERVER_CC.replace(
+        'kTenantKeyPrefix[] = "t."', 'kTenantKeyPrefix[] = "T."'
+    )
+    findings = run_pass(tmp_path, "tenant", {"pkg/native/ps_server.cc": cc})
+    assert [f.code for f in findings] == ["tenant-prefix-drift"]
+
+
+def test_tenant_detects_missing_cpp_prefix(tmp_path):
+    cc = _PS_SERVER_CC.replace(
+        'constexpr char kTenantKeyPrefix[] = "t.";\n', ""
+    )
+    findings = run_pass(tmp_path, "tenant", {"pkg/native/ps_server.cc": cc})
+    assert [f.code for f in findings] == ["tenant-cpp-prefix-missing"]
+
+
+def test_tenant_detects_unknown_scoped_op(tmp_path):
+    wire = _WIRE_PY.replace(
+        'frozenset({"PSTORE_GET"})', 'frozenset({"PSTORE_NOPE"})'
+    )
+    findings = run_pass(tmp_path, "tenant", {"pkg/parallel/wire.py": wire})
+    assert [f.code for f in findings] == ["tenant-scoped-op-unknown"]
+    assert findings[0].symbol == "PSTORE_NOPE"
+
+
+def test_tenant_detects_missing_registry(tmp_path):
+    wire = _WIRE_PY.replace('TENANT_KEY_PREFIX = "t."\n', "").replace(
+        'TENANT_SCOPED_OPS = {"ps": frozenset({"PSTORE_GET"})}\n', ""
+    )
+    findings = run_pass(tmp_path, "tenant", {"pkg/parallel/wire.py": wire})
+    assert codes(findings) == {"tenant-registry-missing"}
+    assert {f.symbol for f in findings} == {
+        "TENANT_KEY_PREFIX", "TENANT_SCOPED_OPS",
+    }
+
+
+def test_tenant_docstring_mentions_are_clean(tmp_path):
+    """Prose about the protocol (module/function docstrings naming
+    ``,t=<tenant>`` shapes) is not key construction."""
+    msrv = _MSRV_PY + '\ndef doc_only():\n' \
+        '    """The tenant rides the name operand as a ``,t=<tenant>``\n' \
+        '    tag; keys live under ``t.<tenant>.<name>``."""\n' \
+        '    return None\n'
+    findings = run_pass(tmp_path, "tenant", {"pkg/serve/model_server.py": msrv})
+    assert findings == []
 
 
 def test_flag_drift_absl_builtin_access_is_clean(tmp_path):

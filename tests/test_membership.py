@@ -126,9 +126,20 @@ def test_lease_ops_do_not_advance_request_counter(ps_port):
 def test_member_pack_unpack_round_trip():
     name = membership.pack_member("serve3", "serve", "10.0.0.7:7201")
     m = membership.unpack_member(name)
-    assert m == {"member": "serve3", "kind": "serve", "addr": "10.0.0.7:7201"}
+    assert m == {
+        "member": "serve3", "kind": "serve", "addr": "10.0.0.7:7201",
+        "tenant": "default",
+    }
     # Foreign/bare member strings degrade, never raise.
     assert membership.unpack_member("legacy")["kind"] == ""
+    # Tenant-scoped members round-trip: the tenant rides the member field
+    # as a key prefix and unpacks back out to the bare name.
+    qname = membership.pack_member(
+        "w0", "worker", "10.0.0.8:7100", tenant="runa"
+    )
+    q = membership.unpack_member(qname)
+    assert q["member"] == "w0" and q["tenant"] == "runa"
+    assert q["kind"] == "worker"
 
 
 # ----------------------------------------------------------------------------

@@ -126,6 +126,32 @@ def test_registry_gc_honors_keep_last_n_and_pins(tmp_path):
         reg.gc("default", keep_last_n=0)
 
 
+def test_pins_are_tenant_namespaced_regression(tmp_path):
+    """The r20 fix: two tenants' replicas sharing a snapshot AND a role
+    name hold two DISTINCT pins — one tenant's unpin (or pin expiry)
+    must never unprotect the version out from under the other's live
+    replica.  Pre-fix both wrote pins/serve0.json and the second unpin
+    deleted the first tenant's protection."""
+    reg = ModelRegistry(str(tmp_path))
+    for i in range(3):
+        _publish(reg, float(i), step=i)
+    reg.pin("default", 1, "serve0", ttl_s=60.0, tenant="runa")
+    reg.pin("default", 1, "serve0", ttl_s=60.0, tenant="runb")
+    owners = reg.pinned_by("default", 1)
+    assert sorted(owners) == ["t.runa.serve0", "t.runb.serve0"]
+    # Tenant A releases; tenant B's pin must still protect v1.
+    reg.unpin("default", 1, "serve0", tenant="runa")
+    assert reg.pinned_by("default", 1) == ["t.runb.serve0"]
+    # keep_last_n=1 keeps v3; v1 survives on runb's pin alone; v2 goes.
+    assert reg.gc("default", keep_last_n=1) == [2]
+    assert reg.versions("default") == [1, 3]
+    # An untagged pin is the default tenant: three namespaces coexist.
+    reg.pin("default", 1, "serve0", ttl_s=60.0)
+    assert sorted(reg.pinned_by("default", 1)) == [
+        "serve0", "t.runb.serve0"
+    ]
+
+
 def test_registry_publish_from_checkpoint_bridge(tmp_path):
     """The train/checkpoint.py bridge: the newest checkpoint's params
     flatten with the shared leaf order and publish as a version."""

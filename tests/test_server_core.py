@@ -43,6 +43,7 @@ from distributed_tensorflow_examples_tpu.data import data_service as dsvc_lib
 from distributed_tensorflow_examples_tpu.parallel import (
     ps_service,
     server_core,
+    tenancy,
     wire,
 )
 
@@ -1144,4 +1145,166 @@ def test_oversize_frame_announcement_drops_the_connection():
             _read_resp(s)
         s.close()
     finally:
+        core.stop()
+
+
+# ----------------------------------------------------------------------------
+# Per-tenant admission: weighted-fair dispatch + quotas (r20)
+# ----------------------------------------------------------------------------
+
+
+def _tenant_core(release: threading.Event, order: list, **core_kw):
+    """One-worker core whose handler blocks until ``release`` and records
+    each dispatched request's tenant — the dispatch-order probe for the
+    stride scheduler.  Tenants ride the dsvc name tag."""
+    lock = threading.Lock()
+    core = server_core.ServerCore(name="tshed", workers=1, **core_kw)
+
+    def handle(conn, op, name, a, b, payload):
+        release.wait(30.0)
+        with lock:
+            order.append(tenancy.untag_name(name)[1])
+        return a, None
+
+    core.add_service(server_core.Service(
+        "dsvc", handle,
+        tenant_of=lambda op, name, a, b: tenancy.untag_name(name)[1],
+        retry_after_ms=90,
+    ))
+    return core.start()
+
+
+def _wait_tenant_queued(core, tenant, n, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        row = core.core_stats()["tenants"].get(tenant)
+        if row and row["queued"] >= n:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"{tenant} never reached {n} queued: {core.core_stats()['tenants']}"
+    )
+
+
+def test_weighted_fair_dispatch_follows_the_stride_weights():
+    """Under saturation a 3:1 weight split dispatches 3:1: of the first 8
+    backlogged requests served, EXACTLY 6 are the heavy tenant's — the
+    stride invariant, independent of arrival/tie order."""
+    release = threading.Event()
+    order: list[str] = []
+    core = _tenant_core(
+        release, order,
+        tenant_quotas={"runa": tenancy.TenantQuota(weight=3.0)},
+    )
+    sa = sb = w = None
+    try:
+        w = _dial(core.port, "dsvc")
+        _send_req(w, 64, name=tenancy.tag_name("", "wedge"))  # occupies the worker
+        time.sleep(0.1)
+        sa = _dial(core.port, "dsvc")
+        sb = _dial(core.port, "dsvc")
+        for i in range(8):
+            _send_req(sa, 64, name=tenancy.tag_name("", "runa"), a=i)
+            _send_req(sb, 64, name=tenancy.tag_name("", "runb"), a=i)
+        _wait_tenant_queued(core, "runa", 8)
+        _wait_tenant_queued(core, "runb", 8)
+        release.set()
+        for s in (w, sa, sb):
+            s.settimeout(20.0)
+        _read_resp(w)
+        for _ in range(8):
+            _read_resp(sa)
+            _read_resp(sb)
+        # order[0] is the wedge; the next 8 are the contested window.
+        window = order[1:9]
+        assert window.count("runa") == 6 and window.count("runb") == 2, order
+        stats = core.core_stats()
+        assert stats["tenants"]["runa"]["weight"] == 3.0
+        assert stats["tenants"]["runa"]["requests"] == 8
+        assert stats["shed_total"] == 0
+    finally:
+        release.set()
+        for s in (w, sa, sb):
+            if s is not None:
+                s.close()
+        core.stop()
+
+
+def test_tenant_quota_sheds_only_the_capped_tenant():
+    """A tenant at its in-flight cap answers typed RETRY_LATER (hint
+    included) while the other tenant's identical traffic flows — and the
+    cause lands in the per-tenant ``shed_quota`` counter, not the
+    neighbors'."""
+    release = threading.Event()
+    order: list[str] = []
+    core = _tenant_core(
+        release, order,
+        tenant_quotas={"runa": tenancy.TenantQuota(max_inflight=2)},
+    )
+    sa = sb = w = None
+    try:
+        w = _dial(core.port, "dsvc")
+        _send_req(w, 64, name=tenancy.tag_name("", "wedge"))
+        time.sleep(0.1)
+        sa = _dial(core.port, "dsvc")
+        sb = _dial(core.port, "dsvc")
+        for i in range(5):
+            _send_req(sa, 64, name=tenancy.tag_name("", "runa"), a=i)
+        for i in range(3):
+            _send_req(sb, 64, name=tenancy.tag_name("", "runb"), a=i)
+        assert _wait_stat(core, "shed_quota", 3) == 3
+        sa.settimeout(20.0)
+        sb.settimeout(20.0)
+        # The shed answers arrive NOW, while the worker is still wedged,
+        # in sequence order behind runa's two admitted requests' replies —
+        # so release first, then read runa's stream in order.
+        release.set()
+        statuses_a = [_read_resp(sa)[0] for _ in range(5)]
+        assert statuses_a[:2] == [0, 1]  # the two admitted requests served
+        for st in statuses_a[2:]:
+            assert wire.retry_after_ms(st) == 90  # the service hint
+        # The neighbor tenant flowed untouched.
+        assert [_read_resp(sb)[0] for _ in range(3)] == [0, 1, 2]
+        stats = core.core_stats()
+        assert stats["tenants"]["runa"]["shed_quota"] == 3
+        assert stats["tenants"]["runa"]["max_inflight"] == 2
+        assert stats["tenants"]["runb"]["shed_total"] == 0
+        assert stats["shed_quota"] == 3 and stats["shed_total"] == 3
+    finally:
+        release.set()
+        for s in (w, sa, sb):
+            if s is not None:
+                s.close()
+        core.stop()
+
+
+def test_tenant_dispatch_quota_caps_the_queue_not_the_neighbors():
+    """``max_dispatch`` bounds how much BACKLOG one tenant may queue:
+    excess sheds at parse time while an uncapped tenant queues freely."""
+    release = threading.Event()
+    order: list[str] = []
+    core = _tenant_core(
+        release, order,
+        tenant_quotas={"runa": tenancy.TenantQuota(max_dispatch=1)},
+    )
+    sa = sb = w = None
+    try:
+        w = _dial(core.port, "dsvc")
+        _send_req(w, 64, name=tenancy.tag_name("", "wedge"))
+        time.sleep(0.1)
+        sa = _dial(core.port, "dsvc")
+        sb = _dial(core.port, "dsvc")
+        for i in range(4):
+            _send_req(sa, 64, name=tenancy.tag_name("", "runa"), a=i)
+            _send_req(sb, 64, name=tenancy.tag_name("", "runb"), a=i)
+        assert _wait_stat(core, "shed_quota", 3) == 3
+        stats = core.core_stats()
+        assert stats["tenants"]["runa"]["shed_quota"] == 3
+        assert stats["tenants"]["runb"]["queued"] == 4
+        release.set()
+    finally:
+        release.set()
+        for s in (w, sa, sb):
+            if s is not None:
+                s.close()
         core.stop()

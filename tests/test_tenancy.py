@@ -1,0 +1,336 @@
+"""dtxtenant — the multi-tenant cluster substrate (r20 tentpole).
+
+What is pinned here, per the acceptance criteria:
+
+- **One key helper** — ``tenancy.qualify`` is the only way a tenant
+  reaches the PS object space: prefix protocol, identity for the default
+  tenant, loud validation for malformed tenant ids.
+- **Namespace isolation** — two tenants using the SAME object names on
+  one native PS never see each other's state, and one tenant's
+  ``cancel_all`` (the reseed/reshard big hammer) wakes only its own
+  blocked waiters.
+- **Untagged back-compat** — a pre-tenant client IS the default tenant:
+  bare names, no tag, byte-identical frames (the default tenant's
+  qualify/tag are the identity), fully interoperable with a
+  tenant-aware peer running as ``default``.
+- **Lease scoping** — membership identities carry their tenant; a
+  tenant-scoped consumer sees only its own members while the
+  observability scrape (``tenant=None``) sees everyone.
+- **Data-plane multiplexing** — one data-service dispatcher runs one
+  assignment job per tenant over the SHARED split set: each tenant
+  drains a full epoch, and one tenant's staleness/reassignment churn
+  never reassigns another tenant's splits.
+
+Per-tenant weighted-fair dispatch and quota shedding are pinned at the
+runtime layer in tests/test_server_core.py; the e2e per-tenant SLO gate
+is tools/loadsim.py --scenario=multitenant.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_examples_tpu.data import data_service as dsvc
+from distributed_tensorflow_examples_tpu.parallel import (
+    membership,
+    ps_service,
+    tenancy,
+)
+
+
+# ----------------------------------------------------------------------------
+# tenancy helpers — the one key protocol
+# ----------------------------------------------------------------------------
+
+
+def test_qualify_is_identity_for_the_default_tenant():
+    # THE back-compat contract: untagged clients are the default tenant,
+    # and the default tenant changes no bytes anywhere.
+    assert tenancy.qualify(tenancy.DEFAULT_TENANT, "params") == "params"
+    assert tenancy.qualify("runa", "params") == "t.runa.params"
+    assert tenancy.qualify("runa", "") == ""  # empty name stays empty
+
+
+def test_split_and_tenant_of_round_trip():
+    assert tenancy.split_qualified("t.runa.params") == ("runa", "params")
+    assert tenancy.split_qualified("params") == (
+        tenancy.DEFAULT_TENANT, "params"
+    )
+    assert tenancy.tenant_of("t.runb.gq") == "runb"
+    # A key that merely LOOKS prefixed but has no valid tenant id stays
+    # a default-tenant key (e.g. a user object literally named "t.x").
+    assert tenancy.tenant_of("t.!bad.name") == tenancy.DEFAULT_TENANT
+
+
+def test_tag_name_round_trips_including_bare():
+    for base in ("epoch=3,strict", ""):
+        tagged = tenancy.tag_name(base, "runa")
+        got_base, got_tenant = tenancy.untag_name(tagged)
+        assert (got_base, got_tenant) == (base, "runa")
+    # Untagged operands parse as the default tenant, unchanged.
+    assert tenancy.untag_name("epoch=0") == ("epoch=0", tenancy.DEFAULT_TENANT)
+    assert tenancy.tag_name("x", tenancy.DEFAULT_TENANT) == "x"
+
+
+def test_check_tenant_rejects_malformed_ids():
+    for bad in ("", "has.dot", "has space", "a" * 33, "uniçode"):
+        with pytest.raises(ValueError):
+            tenancy.check_tenant(bad)
+    assert tenancy.check_tenant("run_a-1") == "run_a-1"
+
+
+def test_parse_quotas_round_trip_and_validation():
+    q = tenancy.parse_quotas("runa=3,runb=1:64:8")
+    assert q["runa"].weight == 3.0 and q["runa"].max_inflight == 0
+    assert q["runb"] == tenancy.TenantQuota(
+        weight=1.0, max_inflight=64, max_dispatch=8
+    )
+    for bad in ("runa", "runa=0", "=3", "bad.id=1", "runa=1:x"):
+        with pytest.raises(ValueError):
+            tenancy.parse_quotas(bad)
+
+
+# ----------------------------------------------------------------------------
+# Native PS: namespace isolation
+# ----------------------------------------------------------------------------
+
+
+def _ps_client(port, tenant=tenancy.DEFAULT_TENANT, role="t0"):
+    return ps_service.PSClient(
+        "127.0.0.1", port, op_timeout_s=10.0, reconnect_deadline_s=20.0,
+        role=role, tenant=tenant,
+    )
+
+
+def test_same_object_name_is_isolated_per_tenant():
+    """Two tenants publish under the SAME name on one server; each reads
+    back only its own state, and the default tenant sees neither."""
+    port = ps_service.start_server(0)
+    ca = _ps_client(port, "runa", role="a0")
+    cb = _ps_client(port, "runb", role="b0")
+    cd = _ps_client(port, role="d0")
+    try:
+        sa = ps_service.RemoteParamStore(ca, "params", 4)
+        sb = ps_service.RemoteParamStore(cb, "params", 4)
+        sa.set(1, np.full(4, 1.0, np.float32))
+        sb.set(7, np.full(4, 2.0, np.float32))
+        step_a, va = sa.get()
+        step_b, vb = sb.get()
+        assert (step_a, step_b) == (1, 7)
+        assert float(va[0]) == 1.0 and float(vb[0]) == 2.0
+        # The key protocol is PURE prefixing: a default-tenant client
+        # addressing the qualified name reaches the same object (this is
+        # what makes dtxtop's cross-tenant observability possible).
+        sd = ps_service.RemoteParamStore(cd, "t.runa.params", 4)
+        step_d, vd = sd.get()
+        assert step_d == 1 and float(vd[0]) == 1.0
+    finally:
+        for c in (ca, cb, cd):
+            c.close()
+
+
+def test_native_stats_carry_a_per_tenant_breakdown():
+    port = ps_service.start_server(0)
+    ca = _ps_client(port, "runa", role="a0")
+    cd = _ps_client(port, role="d0")
+    try:
+        ps_service.RemoteParamStore(ca, "params", 4).set(
+            1, np.zeros(4, np.float32)
+        )
+        ps_service.RemoteParamStore(cd, "params", 4).set(
+            1, np.zeros(4, np.float32)
+        )
+        st = cd.stats()
+        assert "tenants" in st
+        assert st["tenants"]["runa"]["objects"] >= 1
+        assert st["tenants"]["default"]["objects"] >= 1
+    finally:
+        ca.close()
+        cd.close()
+
+
+def test_cancel_all_wakes_only_the_issuing_tenants_waiters():
+    """The reseed/reshard hammer is tenant-scoped: tenant A's CANCEL_ALL
+    releases A's blocked pop and leaves B's untouched (each waiter on its
+    OWN client — one PSClient must never be shared across threads with a
+    blocked op in flight)."""
+    port = ps_service.start_server(0)
+    wait_a = _ps_client(port, "runa", role="aw")
+    wait_b = _ps_client(port, "runb", role="bw")
+    ctl_a = _ps_client(port, "runa", role="ac")
+    ctl_b = _ps_client(port, "runb", role="bc")
+    results: dict[str, object] = {}
+
+    def popper(key, client):
+        tq = ps_service.RemoteTokenQueue(client, "tok")
+        results[key] = tq.pop(timeout_s=20.0)
+
+    ta = threading.Thread(target=popper, args=("a", wait_a), daemon=True)
+    tb = threading.Thread(target=popper, args=("b", wait_b), daemon=True)
+    try:
+        ta.start()
+        tb.start()
+        time.sleep(0.3)  # both parked server-side
+        ctl_a.cancel_all()
+        ta.join(timeout=10.0)
+        assert not ta.is_alive() and results["a"] is None  # A cancelled
+        # B is NOT woken by A's sweep: still parked...
+        tb.join(timeout=0.5)
+        assert tb.is_alive(), "tenant B's waiter was cancelled by tenant A"
+        # ...and completes normally when B's own plane produces a token.
+        ps_service.RemoteTokenQueue(ctl_b, "tok").push(5)
+        tb.join(timeout=10.0)
+        assert not tb.is_alive() and results["b"] == 5
+    finally:
+        for c in (ctl_a, ctl_b, wait_a, wait_b):
+            c.close()
+
+
+# ----------------------------------------------------------------------------
+# Lease scoping
+# ----------------------------------------------------------------------------
+
+
+def test_leases_scope_per_tenant_and_scrape_sees_all():
+    port = ps_service.start_server(0)
+    hb_a = membership.LeaseHeartbeat(
+        [("127.0.0.1", port)], "worker0", kind="worker",
+        addr="127.0.0.1:1", ttl_s=5.0, tenant="runa", role="a_lm",
+    )
+    hb_d = membership.LeaseHeartbeat(
+        [("127.0.0.1", port)], "worker1", kind="worker",
+        addr="127.0.0.1:2", ttl_s=5.0, role="d_lm",
+    )
+    c = _ps_client(port, role="obs")
+    try:
+        mine = membership.live_members(c, "worker", tenant="runa")
+        assert [m["member"] for m in mine] == ["worker0"]
+        assert mine[0]["tenant"] == "runa"
+        other = membership.live_members(c, "worker", tenant="default")
+        assert [m["member"] for m in other] == ["worker1"]
+        # The observability scrape (tenant=None) sees both.
+        every = membership.live_members(c, "worker")
+        assert {m["member"] for m in every} == {"worker0", "worker1"}
+    finally:
+        hb_a.close()
+        hb_d.close()
+        c.close()
+
+
+# ----------------------------------------------------------------------------
+# Data service: one dispatcher, one assignment job per tenant
+# ----------------------------------------------------------------------------
+
+
+def _splits(n=3, rows=8):
+    return [
+        {
+            "image": np.full((rows, 4), i, np.uint8),
+            "label": np.arange(rows, dtype=np.int64),
+        }
+        for i in range(n)
+    ]
+
+
+def _drain_epoch(client, worker):
+    """Split ids handed to ``worker`` for one full epoch on ``client``."""
+    got, ack = [], -1
+    while True:
+        s, _ = client.call(dsvc.DSVC_GET_SPLIT, name="epoch=0,strict", a=worker, b=ack)
+        if s == dsvc.EPOCH_ROLLED:
+            break
+        if s == dsvc.WAIT:
+            ack = -1
+            time.sleep(0.02)
+            continue
+        assert s >= 0
+        got.append(s)
+        ack = s
+    return got
+
+
+def test_each_tenant_drains_its_own_full_epoch():
+    """Both tenants iterate the SHARED splits as independent jobs: each
+    sees every split exactly once per epoch, concurrently, and the
+    server's stats carry the per-tenant breakdown (top level = the
+    default job, the pre-tenant shape)."""
+    srv = dsvc.DataServiceServer(_splits(3), batch_size=4, seed=0, shuffle=False)
+    ca = dsvc.DataServiceClient(
+        "127.0.0.1", srv.port, worker_id=0, role="a0_ds", tenant="runa"
+    )
+    cb = dsvc.DataServiceClient(
+        "127.0.0.1", srv.port, worker_id=0, role="b0_ds", tenant="runb"
+    )
+    try:
+        assert sorted(_drain_epoch(ca, 0)) == [0, 1, 2]
+        assert sorted(_drain_epoch(cb, 0)) == [0, 1, 2]
+        st = srv.stats()
+        assert st["tenants"]["runa"]["epochs_completed"] == 1
+        assert st["tenants"]["runb"]["epochs_completed"] == 1
+        # Top-level counters remain the DEFAULT job's (untouched here).
+        assert st["epochs_completed"] == 0
+    finally:
+        ca.close()
+        cb.close()
+        srv.stop()
+
+
+def test_stale_mark_reassigns_only_the_named_tenants_splits():
+    """Tenant A's membership churn (the lease-expiry path calls
+    ``mark_worker_stale(wid, tenant)``) reassigns A's in-flight split and
+    leaves B's identical assignment untouched."""
+    srv = dsvc.DataServiceServer(_splits(2), batch_size=4, seed=0, shuffle=False)
+    ca0 = dsvc.DataServiceClient(
+        "127.0.0.1", srv.port, worker_id=0, role="sa0_ds", tenant="runa"
+    )
+    ca1 = dsvc.DataServiceClient(
+        "127.0.0.1", srv.port, worker_id=1, role="sa1_ds", tenant="runa"
+    )
+    cb1 = dsvc.DataServiceClient(
+        "127.0.0.1", srv.port, worker_id=1, role="sb1_ds", tenant="runb"
+    )
+    try:
+        s_a0, _ = ca0.call(dsvc.DSVC_GET_SPLIT, name="epoch=0", a=0, b=-1)
+        s_b1, _ = cb1.call(dsvc.DSVC_GET_SPLIT, name="epoch=0", a=1, b=-1)
+        assert s_a0 >= 0 and s_b1 >= 0
+        # Worker 1 of tenant A leaves (per the lease registry): only
+        # tenant A's tables are touched — and only worker 1's state.
+        srv.mark_worker_stale(1, tenant="runa")
+        st = srv.stats()
+        assert st["tenants"]["runa"]["stale_marked"] == 1
+        assert st["tenants"]["runb"]["stale_marked"] == 0
+        # B's worker 1 keeps its assignment: re-claiming it is idempotent
+        # OK, not CLAIM_TAKEN/reassigned.
+        st_claim, _ = cb1.call(dsvc.DSVC_CLAIM_SPLIT, a=1, b=s_b1)
+        assert st_claim == dsvc.OK
+    finally:
+        for c in (ca0, ca1, cb1):
+            c.close()
+        srv.stop()
+
+
+def test_untagged_client_is_the_default_tenant_job():
+    """A pre-tenant (untagged) client and an explicit ``tenant=default``
+    client share ONE job — the back-compat identity, end to end."""
+    srv = dsvc.DataServiceServer(_splits(2), batch_size=4, seed=0, shuffle=False)
+    legacy = dsvc.DataServiceClient(
+        "127.0.0.1", srv.port, worker_id=0, role="l0_ds"
+    )
+    tagged = dsvc.DataServiceClient(
+        "127.0.0.1", srv.port, worker_id=1, role="t1_ds", tenant="default"
+    )
+    try:
+        s0, _ = legacy.call(dsvc.DSVC_GET_SPLIT, name="epoch=0", a=0, b=-1)
+        s1, _ = tagged.call(dsvc.DSVC_GET_SPLIT, name="epoch=0", a=1, b=-1)
+        # Same job: the two workers got DISJOINT splits of one epoch.
+        assert sorted((s0, s1)) == [0, 1]
+        assert set(srv.stats()["tenants"]) == {"default"}
+    finally:
+        legacy.close()
+        tagged.close()
+        srv.stop()

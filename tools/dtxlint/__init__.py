@@ -10,7 +10,7 @@ package machine-checks those invariants so the unified-runtime and
 replication refactors (ROADMAP items 1–2) can move fast without silently
 breaking the wire.
 
-Seven passes (each a module exposing ``run(cfg) -> list[Finding]``):
+Eight passes (each a module exposing ``run(cfg) -> list[Finding]``):
 
 - ``wire_conformance`` — extracts the protocol registries from
   ``parallel/wire.py`` (Python AST) and the ``enum Op`` / ``constexpr`` /
@@ -43,6 +43,11 @@ Seven passes (each a module exposing ``run(cfg) -> list[Finding]``):
 - ``flag_drift`` — every flag defined in ``utils/flags.py`` is referenced
   outside its definition and mentioned in RUNBOOK.md; no undefined flag is
   referenced anywhere.
+- ``tenant`` (r20) — the multi-tenant key protocol: ``wire.TENANT_KEY_PREFIX``
+  and ``TENANT_SCOPED_OPS`` are the one registry (entries validated against
+  the op tables, the C++ ``kTenantKeyPrefix`` mirror pinned), and any raw
+  ``t.``-prefix / ``,t=``-tag construction outside ``parallel/tenancy.py``
+  is refused — ``tenancy.qualify()`` is the one legal key constructor.
 
 CLI: ``python -m tools.dtxlint [--json] [--baseline FILE] [--root DIR]
 [--pass NAME] [--changed [--base REF]]``.  Exit 0 iff no non-suppressed
@@ -64,7 +69,7 @@ JSON_SCHEMA_VERSION = 1
 
 PASS_NAMES = (
     "wire", "control", "protocol", "concurrency", "lifecycle",
-    "fault_coverage", "flag_drift",
+    "fault_coverage", "flag_drift", "tenant",
 )
 
 
@@ -129,6 +134,10 @@ class LintConfig:
     # in run_passes, so pre-r16 LintConfig call sites keep working.
     protocol_dirs: list[Path] | None = None
     lifecycle_dirs: list[Path] | None = None
+    # tenant (r20).  None -> resolved the same way (tenancy.py next to
+    # wire.py; the scanned dirs from the service-module parents).
+    tenancy_py: Path | None = None
+    tenant_dirs: list[Path] | None = None
 
     @classmethod
     def default(cls, root: str | os.PathLike) -> "LintConfig":
@@ -165,6 +174,8 @@ class LintConfig:
                 pkg / "parallel", pkg / "serve", pkg / "data", pkg / "train",
             ],
             lifecycle_dirs=[pkg / "serve", pkg / "parallel", pkg / "data"],
+            tenancy_py=pkg / "parallel" / "tenancy.py",
+            tenant_dirs=[pkg / "parallel", pkg / "serve", pkg / "data"],
         )
 
     def rel(self, path: Path) -> str:
@@ -218,6 +229,13 @@ def _resolve(cfg: LintConfig) -> LintConfig:
         cfg.protocol_dirs = list(seen)
     if cfg.lifecycle_dirs is None:
         cfg.lifecycle_dirs = list(cfg.concurrency_dirs)
+    if cfg.tenancy_py is None:
+        cfg.tenancy_py = Path(cfg.wire_py).parent / "tenancy.py"
+    if cfg.tenant_dirs is None:
+        seen: dict[Path, None] = {}
+        for p in (cfg.ps_service_py, cfg.msrv_py, cfg.dsvc_py):
+            seen.setdefault(Path(p).parent)
+        cfg.tenant_dirs = list(seen)
     return cfg
 
 
@@ -256,6 +274,9 @@ def pass_inputs(cfg: LintConfig) -> dict[str, list[Path]]:
         "flag_drift": [
             cfg.flags_py, cfg.runbook_md, *cfg.flag_reference_dirs,
         ],
+        "tenant": [
+            cfg.wire_py, cfg.ps_server_cc, cfg.tenancy_py, *cfg.tenant_dirs,
+        ],
     }
 
 
@@ -293,7 +314,7 @@ def run_passes(
 
     from . import (  # noqa: F401
         concurrency, control_plane, fault_coverage, flag_drift, lifecycle,
-        protocol, wire_conformance,
+        protocol, tenant, wire_conformance,
     )
 
     cfg = _resolve(cfg)
@@ -305,6 +326,7 @@ def run_passes(
         "lifecycle": lifecycle.run,
         "fault_coverage": fault_coverage.run,
         "flag_drift": flag_drift.run,
+        "tenant": tenant.run,
     }
     if only is not None:
         if only not in passes:

@@ -1,0 +1,261 @@
+"""Pass: tenant-namespace conformance (r20 dtxtenant).
+
+Multi-tenancy is a KEY-PREFIX protocol: a tenant's PS objects and lease
+identities live under ``t.<tenant>.<name>`` (``wire.TENANT_KEY_PREFIX``),
+and dsvc/msrv requests tag the tenant into the ``name`` operand as
+``,t=<tenant>``.  The whole isolation story rests on EVERY construction
+of those shapes going through ``parallel/tenancy.py``'s helpers
+(``qualify``/``tenant_prefix``/``tag_name``) — a hand-built ``f"t.{...}"``
+anywhere else bypasses tenant-id validation and the default-tenant
+identity rule, and is exactly the drift this pass refuses:
+
+- ``tenant-registry-missing``   wire.py lacks ``TENANT_KEY_PREFIX`` (a
+                                string) or a parseable
+                                ``TENANT_SCOPED_OPS`` dict.
+- ``tenant-scoped-op-unknown``  ``TENANT_SCOPED_OPS`` names an op its
+                                service's op registry does not define —
+                                the qualification site would silently
+                                skip it.
+- ``tenant-cpp-prefix-missing`` no ``constexpr char kTenantKeyPrefix[]``
+                                in ps_server.cc (the C++ mirror the
+                                per-tenant STATS breakdown and the
+                                prefix-filtered CANCEL_ALL read).
+- ``tenant-prefix-drift``       the C++ prefix differs from the Python
+                                one — every cross-language attribution
+                                would split.
+- ``tenant-scope``              a raw tenant key/tag construction outside
+                                ``tenancy.py``: a string literal (or
+                                f-string head) building the ``t.`` key
+                                prefix or the ``,t=`` name tag, or a
+                                direct ``TENANT_KEY_PREFIX`` reference —
+                                all of it must go through the tenancy
+                                helpers.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from . import Finding, LintConfig
+from .wire_conformance import module_int_dicts
+
+PASS = "tenant"
+
+_CC_PREFIX_RE = re.compile(
+    r"constexpr\s+char\s+kTenantKeyPrefix\[\]\s*=\s*\"([^\"]*)\""
+)
+
+#: Service key -> the wire.py op-registry dict its TENANT_SCOPED_OPS
+#: names must resolve in.
+_SERVICE_REGISTRY = {"ps": "PS_OPS", "dsvc": "DSVC_OPS", "msrv": "SRV_OPS"}
+
+#: The name-operand tag markers (``tenancy._TAG_SEP``/``_TAG_BARE``).
+#: Deliberately restated here AS THE LINT: any literal in a scanned
+#: module that builds one of these shapes is a finding, including a
+#: would-be second definition of the markers themselves.
+_TAG_SEP = ",t="
+_TAG_BARE = "t="
+
+
+def _wire_tenant_registry(
+    wire_py: Path,
+) -> tuple[str | None, dict[str, list[str]] | None]:
+    """``(TENANT_KEY_PREFIX, {service: [op names]})`` from wire.py —
+    either None if absent/unparseable."""
+    tree = ast.parse(wire_py.read_text())
+    prefix: str | None = None
+    scoped: dict[str, list[str]] | None = None
+    for node in tree.body:
+        tgt = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            tgt = node.targets[0].id
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and node.value is not None:
+            tgt = node.target.id
+        if tgt == "TENANT_KEY_PREFIX":
+            v = node.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str) and \
+                    v.value:
+                prefix = v.value
+        elif tgt == "TENANT_SCOPED_OPS":
+            v = node.value
+            if not isinstance(v, ast.Dict):
+                continue
+            out: dict[str, list[str]] = {}
+            ok = True
+            for k, val in zip(v.keys, v.values):
+                if not (isinstance(k, ast.Constant) and
+                        isinstance(k.value, str)):
+                    ok = False
+                    break
+                if isinstance(val, ast.Call) and \
+                        isinstance(val.func, ast.Name) and \
+                        val.func.id in ("frozenset", "set") and \
+                        len(val.args) == 1:
+                    val = val.args[0]
+                if not isinstance(val, (ast.Set, ast.Tuple, ast.List)):
+                    ok = False
+                    break
+                names = []
+                for e in val.elts:
+                    if not (isinstance(e, ast.Constant) and
+                            isinstance(e.value, str)):
+                        ok = False
+                        break
+                    names.append(e.value)
+                if not ok:
+                    break
+                out[k.value] = names
+            if ok:
+                scoped = out
+    return prefix, scoped
+
+
+def _docstring_ids(tree: ast.AST) -> set[int]:
+    """ids of the Constant nodes that are module/class/function
+    docstrings (prose, not key construction)."""
+    out: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) and \
+                    isinstance(body[0].value, ast.Constant) and \
+                    isinstance(body[0].value.value, str):
+                out.add(id(body[0].value))
+    return out
+
+
+def _is_raw_tenant_literal(value: str, prefix: str) -> bool:
+    """A string literal that BUILDS a tenant key prefix or name tag —
+    the shapes only tenancy.py may construct."""
+    return (
+        value.startswith(prefix)
+        or value == _TAG_SEP or value.startswith(_TAG_SEP)
+        or value == _TAG_BARE or (
+            value.startswith(_TAG_BARE) and "=" not in value[len(_TAG_BARE):]
+        )
+    )
+
+
+def _scan_file(
+    path: Path, rel: str, prefix: str, findings: list[Finding]
+) -> None:
+    try:
+        tree = ast.parse(path.read_text())
+    except SyntaxError:
+        return
+    doc_ids = _docstring_ids(tree)
+    # An f-string's literal chunks are Constant nodes ast.walk also
+    # visits; the JoinedStr branch below owns those (one finding per
+    # f-string, anchored at its head).
+    fstr_ids = {
+        id(v)
+        for n in ast.walk(tree) if isinstance(n, ast.JoinedStr)
+        for v in n.values
+    }
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if id(node) in doc_ids or id(node) in fstr_ids:
+                continue
+            if _is_raw_tenant_literal(node.value, prefix):
+                findings.append(Finding(
+                    PASS, "tenant-scope", rel, node.value[:40],
+                    f"raw tenant key/tag literal {node.value[:40]!r} — "
+                    "every tenant-prefixed key or name tag must be built "
+                    "through tenancy.qualify()/tenant_prefix()/tag_name()",
+                    line=node.lineno,
+                ))
+        elif isinstance(node, ast.JoinedStr) and node.values:
+            head = node.values[0]
+            if isinstance(head, ast.Constant) and \
+                    isinstance(head.value, str) and \
+                    _is_raw_tenant_literal(head.value, prefix):
+                findings.append(Finding(
+                    PASS, "tenant-scope", rel, head.value[:40],
+                    f"f-string builds a tenant key/tag ({head.value!r}...) "
+                    "— use tenancy.qualify()/tenant_prefix()/tag_name()",
+                    line=node.lineno,
+                ))
+        elif isinstance(node, ast.Name) and node.id == "TENANT_KEY_PREFIX":
+            findings.append(Finding(
+                PASS, "tenant-scope", rel, "TENANT_KEY_PREFIX",
+                "TENANT_KEY_PREFIX referenced outside tenancy.py — key "
+                "construction from the raw prefix bypasses tenant-id "
+                "validation; use the tenancy helpers",
+                line=node.lineno,
+            ))
+        elif isinstance(node, ast.Attribute) and \
+                node.attr == "TENANT_KEY_PREFIX":
+            findings.append(Finding(
+                PASS, "tenant-scope", rel, "TENANT_KEY_PREFIX",
+                "TENANT_KEY_PREFIX referenced outside tenancy.py — key "
+                "construction from the raw prefix bypasses tenant-id "
+                "validation; use the tenancy helpers",
+                line=node.lineno,
+            ))
+
+
+def run(cfg: LintConfig) -> list[Finding]:
+    findings: list[Finding] = []
+    wire_rel = cfg.rel(cfg.wire_py)
+    prefix, scoped = _wire_tenant_registry(cfg.wire_py)
+    if prefix is None:
+        findings.append(Finding(
+            PASS, "tenant-registry-missing", wire_rel, "TENANT_KEY_PREFIX",
+            "wire.py must define TENANT_KEY_PREFIX as a non-empty string "
+            "literal — the one wire-level tenant key prefix",
+        ))
+    if scoped is None:
+        findings.append(Finding(
+            PASS, "tenant-registry-missing", wire_rel, "TENANT_SCOPED_OPS",
+            "wire.py must define TENANT_SCOPED_OPS as a literal "
+            "{service: frozenset({op names})} dict — the registry of ops "
+            "whose name operand is tenant-qualified",
+        ))
+    if scoped is not None:
+        registries = module_int_dicts(cfg.wire_py)
+        for service, names in scoped.items():
+            reg_name = _SERVICE_REGISTRY.get(service)
+            reg = registries.get(reg_name, {}) if reg_name else {}
+            for name in names:
+                if name not in reg:
+                    findings.append(Finding(
+                        PASS, "tenant-scoped-op-unknown", wire_rel, name,
+                        f"TENANT_SCOPED_OPS[{service!r}] names {name!r}, "
+                        f"which {reg_name or 'no known registry'} does not "
+                        "define — the qualification site would skip it",
+                    ))
+    # C++ mirror: the prefix the native STATS breakdown and the
+    # prefix-filtered CANCEL_ALL attribute keys with.
+    cc_text = cfg.ps_server_cc.read_text()
+    m = _CC_PREFIX_RE.search(cc_text)
+    if m is None:
+        findings.append(Finding(
+            PASS, "tenant-cpp-prefix-missing", cfg.rel(cfg.ps_server_cc),
+            "kTenantKeyPrefix",
+            "ps_server.cc must define constexpr char kTenantKeyPrefix[] — "
+            "the C++ mirror of wire.TENANT_KEY_PREFIX",
+        ))
+    elif prefix is not None and m.group(1) != prefix:
+        findings.append(Finding(
+            PASS, "tenant-prefix-drift", cfg.rel(cfg.ps_server_cc),
+            "kTenantKeyPrefix",
+            f"kTenantKeyPrefix {m.group(1)!r} != wire.TENANT_KEY_PREFIX "
+            f"{prefix!r} — per-tenant attribution would split across "
+            "languages",
+        ))
+    # The scope scan: the one-constructor rule over the service packages.
+    pfx = prefix or "t."
+    skip = {Path(cfg.wire_py).resolve()}
+    if cfg.tenancy_py is not None:
+        skip.add(Path(cfg.tenancy_py).resolve())
+    for d in cfg.tenant_dirs or []:
+        for path in sorted(Path(d).rglob("*.py")):
+            if path.resolve() in skip:
+                continue
+            _scan_file(path, cfg.rel(path), pfx, findings)
+    return findings

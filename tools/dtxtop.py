@@ -420,6 +420,41 @@ def snapshot(
                         "breaker_fast_fails")
         },
     }
+    # Per-tenant rollup (r20): one row per tenant namespace across every
+    # plane — admission counters from the Python cores (dsvc/msrv),
+    # object/lease footprint from the native PS, dispatcher progress from
+    # the data service, leased members from the registry.  A pre-tenant
+    # cluster rolls up as one "default" row.
+    tenants: dict[str, dict] = {}
+
+    def _trow(t: str) -> dict:
+        return tenants.setdefault(t, {
+            "requests": 0, "inflight": 0, "queued": 0,
+            "shed_total": 0, "shed_quota": 0,
+            "ps_objects": 0, "ps_leases": 0,
+            "dsvc_batches": 0, "dsvc_epochs": 0,
+            "members": 0,
+        })
+
+    for r in ps_rows:
+        for t, d in r["stats"].get("tenants", {}).items():
+            row = _trow(t)
+            row["ps_objects"] += int(d.get("objects", 0))
+            row["ps_leases"] += int(d.get("leases", 0))
+    for r in dsvc_rows + serve_rows:
+        for t, d in r["stats"].get("core", {}).get("tenants", {}).items():
+            row = _trow(t)
+            for k in ("requests", "inflight", "queued",
+                      "shed_total", "shed_quota"):
+                row[k] += int(d.get(k, 0))
+    for r in dsvc_rows:
+        for t, d in r["stats"].get("tenants", {}).items():
+            row = _trow(t)
+            row["dsvc_batches"] += int(d.get("batches_served", 0))
+            row["dsvc_epochs"] += int(d.get("epochs_completed", 0))
+    for m in members:
+        _trow(m.get("tenant", "default"))["members"] += 1
+    summary["tenants"] = tenants
     summary["members"] = {
         "total": len(members),
         "workers": sorted(
@@ -541,6 +576,20 @@ def render(snap: dict, prev: dict | None = None) -> str:
             f"sheds={d['sheds']}"
             for v, d in bv.items()
         ))
+    # Per-tenant breakdown (r20): rendered whenever any non-default
+    # tenant exists (a single-tenant cluster keeps its pre-r20 frame).
+    tns = su.get("tenants", {})
+    if any(t != "default" for t in tns):
+        for t in sorted(tns):
+            d = tns[t]
+            lines.append(
+                f"tenant {t:<12} reqs={d['requests']} "
+                f"shed={d['shed_total']}(quota={d['shed_quota']}) "
+                f"inflight={d['inflight']} queued={d['queued']} | "
+                f"ps obj={d['ps_objects']} leases={d['ps_leases']} | "
+                f"dsvc batches={d['dsvc_batches']} "
+                f"epochs={d['dsvc_epochs']} | members={d['members']}"
+            )
     rs = su["ps"].get("reshard", {})
     if rs.get("committed") or rs.get("pending"):
         lines.append(

@@ -52,6 +52,14 @@ during the burst, zero lease expirations (control ops are never shed),
 p99 back under a bounded multiple of baseline within ``--recovery_bound_s``
 of burst end (the no-metastability proof), training step monotone and
 advancing throughout.  See ``run_overload``.
+
+r20 (``--scenario=multitenant``): the noisy-neighbor isolation
+acceptance — two tenants' training runs share ONE PS tier and ONE serve
+pool; tenant ``runa`` goes 4x-noisy mid-run while tenant ``runb``'s paced
+SLO traffic must stay spotless.  Gates: the per-tenant quotas shed ONLY
+``runa``, ``runb`` never fails a predict and its p99 stays bounded, both
+tenants' PS namespaces and leased members stay disjointly visible to
+dtxtop's per-tenant rollup.  See ``run_multitenant``.
 """
 
 from __future__ import annotations
@@ -112,6 +120,21 @@ RESHARD_PHASES = {
 OVERLOAD_PHASES = {
     "burst_start": 0.35,
     "burst_end": 0.65,
+}
+
+#: Multitenant-scenario timeline (r20, ``--scenario=multitenant``), as
+#: fractions of the load window: two tenants' training runs (``runa``,
+#: ``runb``) share ONE PS tier and ONE serve pool; tenant ``runb``'s paced
+#: SLO traffic establishes a baseline, then tenant ``runa`` goes 4x-noisy
+#: (unpaced closed-loop clients) for the middle of the window, then stops.
+#: The isolation proof: the serve cores' per-tenant quotas shed ONLY
+#: ``runa`` (``shed_quota`` trips on its rows and stays zero on
+#: ``runb``'s), ``runb``'s paced traffic never fails a predict and its
+#: noisy-window p99 stays under a bounded multiple of its own baseline,
+#: and both tenants' PS namespaces stay disjointly visible to dtxtop.
+MULTITENANT_PHASES = {
+    "noise_start": 0.35,
+    "noise_end": 0.65,
 }
 
 #: Canary-scenario timeline (r19, ``--scenario=canary``), as fractions of
@@ -189,7 +212,7 @@ class LoadGenerator:
         self, ps_addrs, serve_addrs, *, qps: float | None, threads: int = 16,
         deadline_s: float = 60.0, role: str = "loadsim_sv",
         op_timeout_s: float | None = 10.0, rows: int = 4,
-        pool_per_thread: bool = False,
+        pool_per_thread: bool = False, tenant: str = "default",
     ):
         from distributed_tensorflow_examples_tpu import serve
 
@@ -199,6 +222,7 @@ class LoadGenerator:
         self._deadline_s = deadline_s
         self._op_timeout_s = op_timeout_s
         self._pool_per_thread = bool(pool_per_thread)
+        self.tenant = tenant
         self.role = role
         self.ok = 0
         self.failed = 0
@@ -211,7 +235,7 @@ class LoadGenerator:
         self._stop = threading.Event()
         self.pool = serve.ServePool(
             list(serve_addrs), role=role, deadline_s=deadline_s,
-            op_timeout_s=op_timeout_s,
+            op_timeout_s=op_timeout_s, tenant=tenant,
         )
         # No PS addresses = static pool only (the burst-child processes:
         # a 10s burst needs no elastic discovery).
@@ -238,7 +262,7 @@ class LoadGenerator:
             pool = serve.ServePool(
                 list(self._serve_addrs), role=f"{self.role}{tid}",
                 deadline_s=self._deadline_s,
-                op_timeout_s=self._op_timeout_s,
+                op_timeout_s=self._op_timeout_s, tenant=self.tenant,
             )
         x = np.zeros((self.rows, 784), np.float32)
         period = None if self.qps is None else n_threads / self.qps
@@ -962,6 +986,281 @@ def run_overload(args) -> int:
     return 0 if verdict["slo_pass"] else 1
 
 
+def run_multitenant(args) -> int:
+    """The multi-tenancy acceptance scenario (``--scenario=multitenant``,
+    r20): boot ONE shared PS tier + ONE serve pool, run TWO independent
+    training stacks over it (``--tenant=runa`` and ``--tenant=runb`` —
+    each its own chief + workers publishing namespaced params/leases),
+    hold paced tenant-``runb`` SLO load on the serve pool, then slam it
+    with a 4x unpaced tenant-``runa`` noise fleet for the middle of the
+    window.  The serve replicas run ``--tenant_quotas`` (default:
+    ``runa`` weight 1 with tight in-flight/dispatch caps, ``runb``
+    weight 3, uncapped).
+
+    SLO verdict (``multitenant_slo``):
+
+    - ``b_zero_failed`` — tenant ``runb``'s paced traffic never fails a
+      logical predict, through the whole noise window;
+    - ``b_p99_bounded`` — ``runb``'s p99 DURING the noise stays under
+      ``--mt_p99_factor`` x its own baseline (abs floor
+      ``--mt_p99_floor_ms``): the quota + weighted-fair dispatch keep the
+      noisy neighbor from inflating the SLO tenant's tail;
+    - ``a_quota_tripped`` / ``b_not_shed`` — the per-tenant quota shed
+      ONLY ``runa`` (its ``shed_quota`` > 0; ``runb``'s ``shed_total``
+      == 0 on the dtxtop per-tenant rollup);
+    - ``namespace_isolated`` — both tenants' rows in the rollup carry
+      their own PS objects and leased members (disjoint ``t.<tenant>.*``
+      namespaces on the SHARED tier);
+    - ``zero_lease_expirations``, monotone strictly-advancing step.
+    """
+    from distributed_tensorflow_examples_tpu.utils import faults
+    from tools import dtxtop
+
+    faults.set_role("loadsim")
+    logdir = args.logdir or tempfile.mkdtemp(prefix="dtx-loadsim-mt-")
+    os.makedirs(logdir, exist_ok=True)
+    n_ps = args.ps_shards * args.ps_replicas
+    ports = free_ports(n_ps + args.serve_replicas)
+    ps_ports, serve_ports = ports[:n_ps], ports[n_ps:]
+    ps_addrs = [("127.0.0.1", p) for p in ps_ports]
+    serve_addrs = [("127.0.0.1", p) for p in serve_ports]
+    base = [
+        "--sync_replicas=false",
+        "--batch_size=64",
+        "--train_steps=1000000",  # outlives the window; loadsim tears down
+        "--hidden_units=64",
+        f"--ps_hosts={','.join(f'127.0.0.1:{p}' for p in ps_ports)}",
+        f"--ps_shards={args.ps_shards}",
+        f"--ps_replicas={args.ps_replicas}",
+        f"--worker_hosts={','.join(f'127.0.0.1:{7000 + i}' for i in range(args.workers))}",
+        f"--serve_hosts={','.join(f'127.0.0.1:{p}' for p in serve_ports)}",
+        "--ps_restarts=3",
+        f"--lease_ttl_s={args.lease_ttl_s}",
+        "--log_every_steps=50",
+    ]
+    env = dict(os.environ)
+    env.pop("DTX_FAULT_ROLE", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["DTX_FAULT_PLAN"] = ""  # the noisy neighbor IS the fault
+    procs: dict[str, subprocess.Popen] = {}
+
+    def spawn(name: str, job: str, index: int, extra=()) -> None:
+        procs[name] = launch_task(
+            args.example, base + list(extra), job, index, logdir, env,
+            log_name=name,
+        )
+
+    verdict: dict = {
+        "schema_version": VERDICT_SCHEMA_VERSION,
+        "metric": "loadsim_multitenant_slo",  # perf_gate baseline auto-select
+        "qps_target": args.qps,
+        "gen_threads": args.gen_threads,
+        "duration_s": args.duration_s,
+        "tenant_quotas": args.mt_quotas,
+        "noise_threads": args.mt_noise_threads,
+        "noise_procs": args.mt_noise_procs,
+        "mt_p99_factor": args.mt_p99_factor,
+        "logdir": logdir,
+    }
+    gen = None
+    noise_children: list[subprocess.Popen] = []
+    step_series: list[tuple[float, int]] = []
+    scrape_fail = 0
+    last_summary: dict = {}
+
+    def scrape() -> None:
+        nonlocal scrape_fail, last_summary
+        try:
+            snap = dtxtop.snapshot(
+                ps_addrs, ps_shards=args.ps_shards,
+                ps_replicas=args.ps_replicas, timeout_s=3.0,
+            )
+            steps = snap["summary"]["serve"]["model_steps"]
+            step_series.append(
+                (time.monotonic(), max(steps) if steps else -1)
+            )
+            last_summary = snap["summary"]
+        except Exception:  # noqa: BLE001 — a saturated scrape may miss
+            scrape_fail += 1
+
+    try:
+        # ONE shared PS tier (untenanted: shared infrastructure), then a
+        # full training stack PER TENANT over it, then the shared serve
+        # pool — replicas are tenant runb's (they hot-track runb's
+        # namespaced params) and carry the per-tenant admission quotas.
+        for i in range(n_ps):
+            spawn(f"ps{i}", "ps", i)
+        if not wait_ps_ready(ps_addrs, args.ready_wait_s):
+            raise RuntimeError(f"PS tasks never came up (logs: {logdir})")
+        for t in ("runa", "runb"):
+            spawn(f"{t}_chief0", "chief", 0, extra=[f"--tenant={t}"])
+            for i in range(args.workers):
+                spawn(f"{t}_worker{i}", "worker", i, extra=[f"--tenant={t}"])
+        for i in range(args.serve_replicas):
+            spawn(
+                f"serve{i}", "serve", i,
+                extra=["--tenant=runb",
+                       f"--tenant_quotas={args.mt_quotas}"],
+            )
+        if not wait_serve_ready(serve_addrs, args.ready_wait_s):
+            raise RuntimeError(
+                f"serve replicas never pulled a model (logs: {logdir})"
+            )
+
+        # The SLO tenant's paced generator: every predict rides tenant
+        # runb's namespace tag, so the serve cores attribute (and
+        # weighted-fair schedule) it as runb.
+        gen = LoadGenerator(
+            ps_addrs, serve_addrs, qps=args.qps, threads=args.gen_threads,
+            deadline_s=max(30.0, args.duration_s), tenant="runb",
+        )
+        gen.start()
+        t0 = time.monotonic()
+        t_noise_on = t0 + MULTITENANT_PHASES["noise_start"] * args.duration_s
+        t_noise_off = t0 + MULTITENANT_PHASES["noise_end"] * args.duration_s
+        t_end = t0 + args.duration_s
+
+        # Phase 1 — baseline: runb's healthy p99, the bound the noisy
+        # window is judged against.
+        while time.monotonic() < t_noise_on:
+            scrape()
+            time.sleep(1.0)
+        baseline = gen.snap_window()
+        verdict["baseline"] = baseline
+
+        # Phase 2 — noise: unpaced tenant-runa clients in SEPARATE
+        # processes (the real N-clients noisy-neighbor shape; the
+        # orchestrator's GIL must not cap the offered load).  Short
+        # logical deadlines: a shed runa predict fails fast through its
+        # retry budget — runa failures are EXPECTED (that is the quota
+        # working) and not gated.
+        noise_s = t_noise_off - time.monotonic()
+        per_proc = max(1, args.mt_noise_threads // args.mt_noise_procs)
+        noise_children += [
+            subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--scenario=burst_child",
+                 "--burst_serve_hosts="
+                 + ",".join(f"127.0.0.1:{p}" for p in serve_ports),
+                 f"--gen_threads={per_proc}",
+                 f"--burst_rows={args.burst_rows}",
+                 "--burst_tenant=runa",
+                 f"--duration_s={noise_s:.1f}"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, env=env, cwd=ROOT,
+            )
+            for _ in range(args.mt_noise_procs)
+        ]
+        faults.log_event(
+            "loadsim_mt_noise_on", procs=args.mt_noise_procs,
+            threads=per_proc,
+        )
+        while any(c.poll() is None for c in noise_children):
+            scrape()
+            time.sleep(1.0)
+            if time.monotonic() > t_noise_off + 60.0:
+                for c in noise_children:
+                    c.kill()
+                break
+        noisy = gen.snap_window()
+        verdict["noisy"] = noisy
+        faults.log_event("loadsim_mt_noise_off")
+        noise_ok = noise_failed = 0
+        for c in noise_children:
+            try:
+                out, _ = c.communicate(timeout=10.0)
+                st = json.loads(out.strip().splitlines()[-1])
+                noise_ok += st["predict_ok"]
+                noise_failed += st["predict_failed"]
+            except Exception:  # noqa: BLE001 — a killed child reports 0
+                noise_failed += 1
+        verdict["noise_ok"] = noise_ok
+        verdict["noise_failed"] = noise_failed
+
+        # Phase 3 — tail: the noise is gone; runb keeps flowing and the
+        # final scrapes carry the per-tenant rollup the gates read.
+        while time.monotonic() < t_end:
+            scrape()
+            time.sleep(1.0)
+        verdict["tail"] = gen.snap_window()
+        verdict["window_s"] = round(time.monotonic() - t0, 1)
+    finally:
+        for c in noise_children:
+            if c.poll() is None:  # an exception mid-noise: don't orphan
+                c.kill()
+        load = gen.stop() if gen is not None else {
+            "predict_ok": 0, "predict_failed": -1, "errors": ["never ran"],
+            "p50_ms": 0.0, "p90_ms": 0.0, "p99_ms": 0.0,
+        }
+        for name, p in procs.items():
+            if p.poll() is None:
+                p.send_signal(
+                    signal.SIGTERM
+                    if name.startswith(("ps", "serve"))
+                    else signal.SIGKILL
+                )
+        deadline = time.monotonic() + 15.0
+        for p in procs.values():
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+            getattr(p, "_dtx_logf").close()
+
+    verdict.update(load)
+    verdict["scrape_failures"] = scrape_fail
+    verdict.update(analyze_steps(step_series, {"noise": 0.0}))
+    tenants = last_summary.get("tenants", {})
+    verdict["tenants"] = tenants
+    verdict["leases_expired"] = last_summary.get("ps", {}).get(
+        "leases_expired", -1
+    )
+    runa = tenants.get("runa", {})
+    runb = tenants.get("runb", {})
+    baseline = verdict.get("baseline", {"ok": 0, "failed": -1, "p99_ms": 0.0})
+    noisy = verdict.get("noisy", {"ok": 0, "failed": -1, "p99_ms": 1e9})
+    p99_target = max(
+        args.mt_p99_factor * baseline["p99_ms"], args.mt_p99_floor_ms
+    )
+    verdict["noisy_p99_target_ms"] = round(p99_target, 3)
+    gates = {
+        # The SLO tenant is spotless END TO END: its quota weight + the
+        # noisy tenant's caps mean the noise never costs runb a predict.
+        "b_zero_failed": load["predict_failed"] == 0,
+        "b_baseline_served": baseline["ok"] > 0 and baseline["failed"] == 0,
+        # Bounded interference: runb's p99 under the noise stays within
+        # the factor of its own baseline (abs floor for very fast boxes).
+        "b_p99_bounded": noisy["ok"] > 0 and noisy["p99_ms"] <= p99_target,
+        # The noise fleet genuinely offered load (a no-show noise phase
+        # proves nothing about isolation).
+        "noise_offered": noise_ok + noise_failed > 0,
+        # The per-tenant quota tripped on the noisy tenant ONLY: runa's
+        # rollup row shows quota sheds, runb's shows NO sheds of any
+        # kind — admission pressure never crossed the tenant boundary.
+        "a_quota_tripped": runa.get("shed_quota", 0) > 0,
+        "b_not_shed": runb.get("shed_total", -1) == 0,
+        # Namespace isolation on the SHARED tier: each tenant's own
+        # params objects and leased members, visible per-tenant.
+        "namespace_isolated": (
+            runa.get("ps_objects", 0) >= 1 and runb.get("ps_objects", 0) >= 1
+            and runa.get("members", 0) >= 1 and runb.get("members", 0) >= 1
+        ),
+        # Control-plane priority held for BOTH tenants: no live member's
+        # lease expired under the noise.
+        "zero_lease_expirations": verdict["leases_expired"] == 0,
+        "step_monotone": verdict["step_monotone"],
+        "step_advanced": verdict["step_advanced"],
+    }
+    verdict["gates"] = gates
+    verdict["slo_pass"] = all(gates.values())
+    verdict["loadsim_p99_ms"] = load["p99_ms"]
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(verdict, f, indent=2)
+    print(json.dumps(verdict))
+    return 0 if verdict["slo_pass"] else 1
+
+
 def run_canary(args) -> int:
     """The rolling-deploy acceptance scenario (``--scenario=canary``, r19):
     boot a real multi-process train-and-serve cluster whose serve replicas
@@ -1315,6 +1614,7 @@ def run_burst_child(args) -> int:
         [], serve_addrs, qps=None, threads=args.gen_threads,
         deadline_s=3.0, role="loadsim_burst_sv", op_timeout_s=3.0,
         rows=args.burst_rows, pool_per_thread=True,
+        tenant=args.burst_tenant,
     )
     gen.start()
     time.sleep(args.duration_s)
@@ -1354,7 +1654,10 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--scenario",
-        choices=("chaos", "reshard", "overload", "canary", "burst_child"),
+        choices=(
+            "chaos", "reshard", "overload", "canary", "multitenant",
+            "burst_child",
+        ),
         default="chaos",
         help="chaos = the r14 kill/join/leave cycle; reshard = the r15 "
         "live N->N+1->N PS resizing under load (one worker kill); "
@@ -1362,8 +1665,11 @@ def main(argv=None) -> int:
         "control, deadline propagation, retry budgets); canary = the r19 "
         "rolling registry-version flip (stable->canary->promoted with a "
         "kill/join cycle mid-flip, zero failed predicts, canary weight "
-        "honored); burst_child is "
-        "internal (one spawned burst-client process of the overload run)",
+        "honored); multitenant = the r20 noisy-neighbor isolation run "
+        "(two tenants' training stacks on one shared PS/serve plane, "
+        "per-tenant quotas shed ONLY the noisy tenant); burst_child is "
+        "internal (one spawned burst-client process of the "
+        "overload/multitenant runs)",
     )
     ap.add_argument(
         "--canary_weight", type=float, default=0.4,
@@ -1402,6 +1708,40 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--burst_serve_hosts", default="",
         help="internal (burst_child): static serve host list to hammer",
+    )
+    ap.add_argument(
+        "--burst_tenant", default="default",
+        help="internal (burst_child): tenant id the burst clients tag "
+        "their predicts with (the multitenant scenario's noisy tenant)",
+    )
+    ap.add_argument(
+        "--mt_quotas", default="runa=1:8:4,runb=3",
+        help="multitenant scenario: the serve replicas' --tenant_quotas — "
+        "by default the noisy tenant runa gets weight 1 with 8 in-flight "
+        "/ 4 queued caps per replica, the SLO tenant runb weight 3 "
+        "uncapped",
+    )
+    ap.add_argument(
+        "--mt_noise_threads", type=int, default=64,
+        help="multitenant scenario: unpaced tenant-runa noise clients "
+        "(4x the paced 16 by default) slammed at the shared serve pool "
+        "mid-run",
+    )
+    ap.add_argument(
+        "--mt_noise_procs", type=int, default=4,
+        help="multitenant scenario: noise-client PROCESSES the threads "
+        "are spread over (one GIL must not cap the offered load)",
+    )
+    ap.add_argument(
+        "--mt_p99_factor", type=float, default=3.0,
+        help="multitenant scenario: runb's noisy-window p99 must stay "
+        "under this multiple of its own baseline p99",
+    )
+    ap.add_argument(
+        "--mt_p99_floor_ms", type=float, default=150.0,
+        help="multitenant scenario: absolute floor on the noisy-window "
+        "p99 target (a very fast baseline must not make isolation "
+        "unprovable)",
     )
     ap.add_argument(
         "--burst_rows", type=int, default=64,
@@ -1465,6 +1805,8 @@ def main(argv=None) -> int:
         return run_overload(args)
     if args.scenario == "canary":
         return run_canary(args)
+    if args.scenario == "multitenant":
+        return run_multitenant(args)
     if args.scenario == "burst_child":
         return run_burst_child(args)
 

@@ -230,6 +230,19 @@ def steps_plan() -> list[dict]:
                   "--qps", "50", "--duration_s", "60",
                   "--p99_bound_ms", "2500"],
              timeout=900, cpu_ok=True),
+        # Multi-tenant isolation acceptance (r20): two tenants' training
+        # stacks share one PS tier + serve pool; the noisy tenant
+        # 4x-overloads the pool mid-run and is shed ONLY via its
+        # per-tenant quota while the SLO tenant never fails a predict
+        # and keeps a bounded p99 — plus disjoint per-tenant namespaces
+        # on dtxtop's rollup and zero lease expirations.  JAX-on-CPU, so
+        # cpu_ok; verdict gated against
+        # tools/loadsim_multitenant_baseline.json by perf_gate (metric
+        # loadsim_multitenant_slo).
+        dict(name="loadsim_multitenant",
+             cmd=[PY, "tools/loadsim.py", "--scenario", "multitenant",
+                  "--qps", "100", "--duration_s", "30"],
+             timeout=900, cpu_ok=True),
     ]
     return plan
 

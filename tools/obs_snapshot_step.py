@@ -32,6 +32,8 @@ REQUIRED_KEYS = {
         "requests", "incarnation", "shard_id", "shard_count", "live_conns",
         "fwd_ok", "fwd_refused", "repl_syncs_served", "mirror_applies",
         "acc_deduped", "gq_deduped", "diverged",
+        # r20 multi-tenancy: the per-tenant object/lease footprint.
+        "tenants",
         # r18 admission control: the shed counters every service exports
         # in the same top-level shape (dtxtop + the overload SLO read
         # them uniformly).
@@ -41,6 +43,8 @@ REQUIRED_KEYS = {
         "requests", "incarnation", "epoch", "batches_served",
         "assigned_total", "acks", "reassigned", "registry",
         "shed_total", "queue_deadline_drops",
+        # r20 multi-tenancy: the per-tenant dispatcher-job breakdown.
+        "tenants",
     ),
     "serve": (
         "requests", "incarnation", "model_step", "predict_rows",
@@ -51,6 +55,8 @@ REQUIRED_KEYS = {
         # hot-tracking) dtxtop's version column and per-version rollup
         # key off — pinned here so the stamp cannot silently vanish.
         "model_version",
+        # r20 multi-tenancy: the per-tenant admission counters.
+        "tenants",
     ),
 }
 
@@ -137,6 +143,10 @@ def main() -> int:
         )
         problems = missing_counters(snap)
         su = snap["summary"]
+        # The aggregated per-tenant section (r20) must exist and carry
+        # the default tenant this single-tenant boot ran as.
+        if "default" not in su.get("tenants", {}):
+            problems.append("summary: missing tenants rollup")
         ok = not problems and su["roles_ok"] == su["roles_total"]
         for p in problems:
             print(f"obs_snapshot: {p}", file=sys.stderr)

@@ -99,6 +99,18 @@ BASELINES = {
     # dtxtop's per-version rollup mid-flip.  Gate-set shrink detection as
     # with the other loadsim verdicts.
     "loadsim_canary_slo": "loadsim_canary_baseline.json",
+    # r20 multi-tenant isolation acceptance (tools/loadsim.py
+    # --scenario=multitenant): binary slo_pass over the noisy-neighbor
+    # gate set — two tenants' training stacks on one shared PS/serve
+    # plane, the noisy tenant 4x-overloads the pool mid-run and is shed
+    # ONLY via its per-tenant quota (shed_quota > 0 on its dtxtop rollup
+    # row, zero sheds of any kind on the SLO tenant's), the SLO tenant
+    # never fails a predict and its noisy-window p99 stays under a
+    # bounded multiple of its own baseline, both tenants' PS namespaces
+    # and members stay disjointly visible, zero lease expirations, step
+    # monotone.  Gate-set shrink detection as with the other loadsim
+    # verdicts.
+    "loadsim_multitenant_slo": "loadsim_multitenant_baseline.json",
     # r16 static-analysis wall-time budget (tools/dtxlint_step.py): the
     # lint's repo gate runs inside tier-1, so a pass whose cost silently
     # explodes taxes every future test run — the campaign fails first.
